@@ -1,0 +1,53 @@
+"""gubernator_tpu — a TPU-native distributed rate-limiting framework.
+
+Capabilities match the reference Gubernator service (see SURVEY.md):
+token-bucket / leaky-bucket algorithms, key-ownership sharding, request
+batching, GLOBAL eventually-consistent limits, Gregorian resets,
+pluggable persistence, HTTP/gRPC ingress — redesigned for TPU: bucket
+state as sharded integer columns on a device mesh, whole batches
+evaluated per jitted kernel call, peer traffic as ICI collectives.
+"""
+
+import jax as _jax
+
+# Rate-limit arithmetic is int64 end-to-end (epoch-ms timestamps, 64-bit
+# limits per the proto), so x64 must be on before any array is created.
+_jax.config.update("jax_enable_x64", True)
+
+from .types import (  # noqa: E402
+    Algorithm,
+    Behavior,
+    GetRateLimitsRequest,
+    GetRateLimitsResponse,
+    HealthCheckResponse,
+    PeerInfo,
+    RateLimitRequest,
+    RateLimitResponse,
+    Status,
+    has_behavior,
+    set_behavior,
+    MILLISECOND,
+    SECOND,
+    MINUTE,
+    HOUR,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Algorithm",
+    "Behavior",
+    "Status",
+    "RateLimitRequest",
+    "RateLimitResponse",
+    "GetRateLimitsRequest",
+    "GetRateLimitsResponse",
+    "HealthCheckResponse",
+    "PeerInfo",
+    "has_behavior",
+    "set_behavior",
+    "MILLISECOND",
+    "SECOND",
+    "MINUTE",
+    "HOUR",
+]

@@ -1,0 +1,185 @@
+"""Single-shard bucket store: host slot table + device state columns.
+
+One ShardStore is the TPU-native unit that replaces a reference peer's
+`LRUCache` + mutex + per-request algorithm call (`gubernator.go:335-354`):
+a whole batch of requests is resolved to device slots host-side, then
+evaluated in one jitted kernel call per duplicate-round.
+
+Request order within a batch is preserved for duplicate keys (the k-th
+request for a key sees the state left by the (k-1)-th), matching the
+reference's mutex serialization (gubernator.go:336-337).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..ops import buckets
+from ..types import (
+    Algorithm,
+    Behavior,
+    RateLimitRequest,
+    RateLimitResponse,
+    has_behavior,
+)
+from ..utils import gregorian
+from .slot_table import SlotTable
+
+# Batches are padded to one of these lane counts to bound XLA recompiles.
+_PAD_SIZES = (64, 256, 1024, 4096, 16384, 65536, 262144, 1048576)
+
+
+def _pad_size(n: int) -> int:
+    for p in _PAD_SIZES:
+        if n <= p:
+            return p
+    return ((n + _PAD_SIZES[-1] - 1) // _PAD_SIZES[-1]) * _PAD_SIZES[-1]
+
+
+@dataclass
+class _Prepared:
+    """A request resolved host-side, ready for kernel dispatch."""
+
+    pos: int
+    slot: int
+    exists: bool
+    req: RateLimitRequest
+    greg_expire: int = 0
+    greg_duration: int = 0
+
+
+class ShardStore:
+    """Bucket table for one shard, pinned to (at most) one device."""
+
+    def __init__(self, capacity: int = 50_000, device: Optional[jax.Device] = None):
+        self.capacity = capacity
+        self.table = SlotTable(capacity)
+        self.device = device
+        state = buckets.init_state(capacity)
+        if device is not None:
+            state = jax.device_put(state, device)
+        self.state = state
+        # host mirror of per-slot algorithm, for store-SPI removal detection
+        self.algo_mirror = np.zeros(capacity, dtype=np.int32)
+
+    # ------------------------------------------------------------------
+    def apply(
+        self, requests: Sequence[RateLimitRequest], now_ms: int
+    ) -> List[RateLimitResponse]:
+        """Evaluate a batch; responses come back in request order."""
+        n = len(requests)
+        responses: List[Optional[RateLimitResponse]] = [None] * n
+        prepared: List[_Prepared] = []
+        now_dt = _dt.datetime.fromtimestamp(now_ms / 1000.0, tz=_dt.timezone.utc)
+
+        # now_dt is fixed for the whole batch, so Gregorian math depends
+        # only on req.duration — memoize the (at most 6) distinct values.
+        greg_cache: dict = {}
+
+        for pos, req in enumerate(requests):
+            p = _Prepared(pos=pos, slot=-1, exists=False, req=req)
+            if has_behavior(req.behavior, Behavior.DURATION_IS_GREGORIAN):
+                if req.duration not in greg_cache:
+                    try:
+                        greg_cache[req.duration] = (
+                            gregorian.gregorian_expiration(now_dt, req.duration),
+                            gregorian.gregorian_duration(now_dt, req.duration),
+                        )
+                    except gregorian.GregorianError as e:
+                        greg_cache[req.duration] = e
+                cached = greg_cache[req.duration]
+                if isinstance(cached, gregorian.GregorianError):
+                    responses[pos] = RateLimitResponse(error=str(cached))
+                    continue
+                p.greg_expire, p.greg_duration = cached
+            prepared.append(p)
+
+        # Build rounds incrementally in request order.  A round must have
+        # unique keys AND unique slots (the scatter is race-free only
+        # then); a duplicate flushes the pending round first so the k-th
+        # request for a key observes the (k-1)-th's committed state —
+        # the vectorized equivalent of the reference's mutex
+        # serialization (gubernator.go:336-337).  A slot collision can
+        # only happen when LRU eviction under capacity pressure reuses a
+        # slot already scheduled this round; flushing first preserves
+        # sequential evict-then-create semantics.
+        cur: List[_Prepared] = []
+        seen_keys: set = set()
+        used_slots: set = set()
+
+        def flush():
+            nonlocal cur, seen_keys, used_slots
+            if cur:
+                self._run_round(cur, now_ms, responses)
+            cur, seen_keys, used_slots = [], set(), set()
+
+        for p in prepared:
+            key = p.req.hash_key()
+            if key in seen_keys:
+                flush()
+            p.slot, p.exists = self.table.lookup_or_assign(key, now_ms)
+            if p.slot in used_slots:
+                flush()
+            cur.append(p)
+            seen_keys.add(key)
+            used_slots.add(p.slot)
+        flush()
+
+        return [r if r is not None else RateLimitResponse() for r in responses]
+
+    # ------------------------------------------------------------------
+    def _run_round(
+        self, chunk: List[_Prepared], now_ms: int, responses: List[Optional[RateLimitResponse]]
+    ) -> None:
+        b = len(chunk)
+        padded = _pad_size(b)
+        slot = np.full(padded, -1, dtype=np.int32)
+        exists = np.zeros(padded, dtype=bool)
+        algo = np.zeros(padded, dtype=np.int32)
+        behavior = np.zeros(padded, dtype=np.int32)
+        hits = np.zeros(padded, dtype=np.int64)
+        limit = np.zeros(padded, dtype=np.int64)
+        duration = np.zeros(padded, dtype=np.int64)
+        greg_expire = np.zeros(padded, dtype=np.int64)
+        greg_duration = np.zeros(padded, dtype=np.int64)
+
+        for i, p in enumerate(chunk):
+            slot[i] = p.slot
+            exists[i] = p.exists
+            algo[i] = int(p.req.algorithm)
+            behavior[i] = int(p.req.behavior)
+            hits[i] = p.req.hits
+            limit[i] = p.req.limit
+            duration[i] = p.req.duration
+            greg_expire[i] = p.greg_expire
+            greg_duration[i] = p.greg_duration
+
+        batch = buckets.make_batch(
+            slot, exists, algo, behavior, hits, limit, duration, greg_expire, greg_duration
+        )
+        self.state, out = buckets.apply_batch_jit(self.state, batch, now_ms)
+
+        out_status = np.asarray(out.status)
+        out_rem = np.asarray(out.remaining)
+        out_reset = np.asarray(out.reset_time)
+        out_exp = np.asarray(out.new_expire)
+        out_removed = np.asarray(out.removed)
+
+        self.table.commit(slot[:b], out_exp[:b], out_removed[:b])
+        for i, p in enumerate(chunk):
+            self.algo_mirror[p.slot] = int(p.req.algorithm)
+            responses[p.pos] = RateLimitResponse(
+                status=int(out_status[i]),
+                limit=int(p.req.limit),
+                remaining=int(out_rem[i]),
+                reset_time=int(out_reset[i]),
+            )
+
+    # ------------------------------------------------------------------
+    def size(self) -> int:
+        return len(self.table)

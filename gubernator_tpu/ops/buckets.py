@@ -1,0 +1,385 @@
+"""Vectorized token-bucket / leaky-bucket kernels over struct-of-arrays state.
+
+This is the TPU-native replacement for the reference's per-key, mutex-
+serialized algorithm functions (`algorithms.go:24-180` tokenBucket,
+`algorithms.go:183-336` leakyBucket).  Instead of one Go-map lookup and
+pointer mutation per request, bucket state lives as integer columns on
+device and a whole request batch is evaluated in one jitted, branchless
+program: gather slot rows -> select across the reference's control-flow
+paths with `jnp.where` -> scatter rows back.
+
+Semantics preserved exactly (each cited to the reference):
+  * expired slot == cache miss, recreate in place      (cache.go:138-163)
+  * algorithm switch resets the bucket                 (algorithms.go:54-62,196-204)
+  * RESET_REMAINING: token removes the bucket, leaky refills to limit
+                                                       (algorithms.go:36-47,206-208)
+  * limit hot-change adds the delta to remaining, clamped at 0
+                                                       (algorithms.go:70-78)
+  * token duration hot-change re-derives expiry from CreatedAt and
+    recreates if already expired; stored Duration is NOT updated
+                                                       (algorithms.go:87-105)
+  * hits == 0 is a status query                        (algorithms.go:107-110,280-283)
+  * remaining == 0  -> OVER_LIMIT (token: sticky Status update)
+                                                       (algorithms.go:112-117,260-264)
+  * hits == remaining -> drain to exactly 0            (algorithms.go:119-124,266-271)
+  * hits >  remaining -> OVER_LIMIT without mutating   (algorithms.go:126-130,273-278)
+  * first hit creates the bucket; hits > limit -> OVER_LIMIT
+    (token keeps remaining=limit, leaky keeps 0)       (algorithms.go:161-166,318-323)
+  * leaky leak applied only when >= 1 whole token leaked
+                                                       (algorithms.go:234-241)
+  * leaky remaining clamped to limit                   (algorithms.go:243-245)
+
+Divergences (documented, deliberate):
+  * leaky `remaining` is fixed-point int64 (scale 2**20) instead of Go
+    float64 — TPUs have no native f64.  The leak amount
+    `elapsed * limit / duration` is computed EXACTLY (128-bit integer
+    muldiv) where the reference double-rounds through float64
+    (`rate = duration/limit; leak = elapsed/rate`), so for rates that
+    are not exactly representable in binary (e.g. duration=1000,
+    limit=30) the reference can under-count a leak by one whole token
+    at exact multiples; this implementation is the mathematically exact
+    value.  Bounded by 1 token per leak event; pinned by
+    tests/test_algorithms.py::test_leaky_nonrepresentable_rate.
+  * supported magnitude domain: limit and hits up to 2**43 (the
+    fixed-point scale consumes 20 bits); the reference's float64 loses
+    integer exactness past 2**53 anyway.
+  * the reference sets the leaky expiry to `now * duration` — an obvious
+    bug (algorithms.go:287); we use `now + duration` (the create path's
+    `now + duration`, algorithms.go:326, applied consistently).
+
+Time is an explicit kernel argument (`now_ms`), which is what makes the
+reference's frozen-clock test strategy (functional_test.go:108-167) work
+unchanged here.
+
+Gregorian calendar values cannot be computed on device; the host
+precomputes `greg_expire` / `greg_duration` per request (as the reference
+does inline at algorithms.go:90-95,140-145,216-232) and the kernel
+selects them when the DURATION_IS_GREGORIAN bit is set.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..types import Algorithm, Behavior, Status
+
+# Fixed-point scale for leaky-bucket fractional remaining.
+LEAKY_SCALE_BITS = 20
+LEAKY_SCALE = 1 << LEAKY_SCALE_BITS
+
+_I64 = jnp.int64
+_I32 = jnp.int32
+_U64 = jnp.uint64
+
+
+def _muldiv128(a, b, d):
+    """Exact (floor(a*b/d), a*b mod d) for 0 <= a,b < 2**63, 1 <= d < 2**63.
+
+    `a * b` overflows int64 for legal proto values (elapsed_ms * limit),
+    so the product is formed as a 128-bit (hi, lo) pair from 32x32
+    partials and divided by shift-subtract long division.  The quotient
+    must fit in int64 — guaranteed by callers via a <= d (=> q <= b).
+    128 data-independent iterations; vectorizes cleanly across lanes.
+    """
+    a = a.astype(_U64)
+    b = b.astype(_U64)
+    d = jnp.maximum(d.astype(_U64), jnp.uint64(1))
+    mask = jnp.uint64(0xFFFFFFFF)
+    a_lo, a_hi = a & mask, a >> 32
+    b_lo, b_hi = b & mask, b >> 32
+    ll = a_lo * b_lo
+    mid = a_lo * b_hi + (ll >> 32)  # no overflow: < 2**64
+    mid2 = mid + a_hi * b_lo
+    carry = (mid2 < mid).astype(_U64)
+    lo = (mid2 << 32) | (ll & mask)
+    hi = a_hi * b_hi + (mid2 >> 32) + (carry << 32)
+
+    def body(_, st):
+        r, q, hi, lo = st
+        top = hi >> 63
+        hi = (hi << 1) | (lo >> 63)
+        lo = lo << 1
+        r = (r << 1) | top
+        take = r >= d
+        r = jnp.where(take, r - d, r)
+        q = (q << 1) | take.astype(_U64)
+        return r, q, hi, lo
+
+    z = jnp.zeros_like(a)
+    r, q, _, _ = jax.lax.fori_loop(0, 128, body, (z, z, hi, lo))
+    return q.astype(_I64), r.astype(_I64)
+
+
+class BucketState(NamedTuple):
+    """Struct-of-arrays bucket table for one shard (capacity C).
+
+    Union of the reference's TokenBucketItem / LeakyBucketItem
+    (store.go:11-24) plus CacheItem bookkeeping (cache.go:64-76):
+      algo:      Algorithm per slot
+      limit:     configured limit
+      remaining: token -> whole tokens; leaky -> tokens * LEAKY_SCALE
+      duration:  stored duration (ms)
+      stamp:     token -> CreatedAt; leaky -> UpdatedAt (ms epoch)
+      expire_at: CacheItem.ExpireAt (ms epoch); <= now means the slot is
+                 dead and recyclable (expiry-as-miss)
+      status:    token sticky Status
+    """
+
+    algo: jax.Array  # i32[C]
+    limit: jax.Array  # i64[C]
+    remaining: jax.Array  # i64[C]
+    duration: jax.Array  # i64[C]
+    stamp: jax.Array  # i64[C]
+    expire_at: jax.Array  # i64[C]
+    status: jax.Array  # i32[C]
+
+
+class RequestBatch(NamedTuple):
+    """One device-ready batch of resolved requests (length B, padded).
+
+    `slot` indexes into the BucketState columns; -1 marks a padding lane
+    (scatters drop, responses are garbage and masked host-side).
+    `exists` is the host's claim that the slot currently maps this key;
+    the kernel still validates expiry device-side.
+    """
+
+    slot: jax.Array  # i32[B]
+    exists: jax.Array  # bool[B]
+    algorithm: jax.Array  # i32[B]
+    behavior: jax.Array  # i32[B]
+    hits: jax.Array  # i64[B]
+    limit: jax.Array  # i64[B]
+    duration: jax.Array  # i64[B]
+    greg_expire: jax.Array  # i64[B] (0 unless DURATION_IS_GREGORIAN)
+    greg_duration: jax.Array  # i64[B] (0 unless DURATION_IS_GREGORIAN)
+
+
+class BatchOutput(NamedTuple):
+    """Per-lane responses plus host-mirror bookkeeping."""
+
+    status: jax.Array  # i32[B]
+    limit: jax.Array  # i64[B]
+    remaining: jax.Array  # i64[B]
+    reset_time: jax.Array  # i64[B]
+    new_expire: jax.Array  # i64[B]  slot expire_at after this request
+    removed: jax.Array  # bool[B] token RESET_REMAINING freed the slot
+
+
+def init_state(capacity: int) -> BucketState:
+    """Fresh all-expired bucket table (expire_at=0 => every slot is free)."""
+    return BucketState(
+        algo=jnp.zeros((capacity,), _I32),
+        limit=jnp.zeros((capacity,), _I64),
+        remaining=jnp.zeros((capacity,), _I64),
+        duration=jnp.zeros((capacity,), _I64),
+        stamp=jnp.zeros((capacity,), _I64),
+        expire_at=jnp.zeros((capacity,), _I64),
+        status=jnp.zeros((capacity,), _I32),
+    )
+
+
+def make_batch(
+    slot,
+    exists,
+    algorithm,
+    behavior,
+    hits,
+    limit,
+    duration,
+    greg_expire=None,
+    greg_duration=None,
+) -> RequestBatch:
+    """Convenience constructor coercing host arrays to kernel dtypes."""
+    slot = jnp.asarray(slot, _I32)
+    z = jnp.zeros_like(jnp.asarray(hits, _I64))
+    return RequestBatch(
+        slot=slot,
+        exists=jnp.asarray(exists, bool),
+        algorithm=jnp.asarray(algorithm, _I32),
+        behavior=jnp.asarray(behavior, _I32),
+        hits=jnp.asarray(hits, _I64),
+        limit=jnp.asarray(limit, _I64),
+        duration=jnp.asarray(duration, _I64),
+        greg_expire=z if greg_expire is None else jnp.asarray(greg_expire, _I64),
+        greg_duration=z if greg_duration is None else jnp.asarray(greg_duration, _I64),
+    )
+
+
+def apply_batch(state: BucketState, req: RequestBatch, now_ms) -> "tuple[BucketState, BatchOutput]":
+    """Evaluate one batch against the bucket table.
+
+    Pure function: returns (new_state, responses).  Slots must be unique
+    within the batch (the host splits duplicate-key batches into
+    flush-separated rounds; see ShardStore.apply) so the gather/scatter
+    is race-free.
+    """
+    now = jnp.asarray(now_ms, _I64)
+    C = state.limit.shape[0]
+
+    valid = req.slot >= 0
+    s = jnp.clip(req.slot, 0, C - 1)
+
+    g_algo = state.algo[s]
+    g_limit = state.limit[s]
+    g_rem = state.remaining[s]
+    g_dur = state.duration[s]
+    g_stamp = state.stamp[s]
+    g_exp = state.expire_at[s]
+    g_status = state.status[s]
+
+    # Expiry-as-miss: reference expires strictly (`ExpireAt < now`,
+    # cache.go:151), so a slot at exactly its expiry is still live.
+    live = req.exists & (g_exp >= now)
+    exist = live & (g_algo == req.algorithm)  # algo switch => recreate
+
+    is_tok = req.algorithm == int(Algorithm.TOKEN_BUCKET)
+    greg = (req.behavior & int(Behavior.DURATION_IS_GREGORIAN)) != 0
+    reset_b = (req.behavior & int(Behavior.RESET_REMAINING)) != 0
+    hits = req.hits
+    OVER = jnp.asarray(int(Status.OVER_LIMIT), _I32)
+    UNDER = jnp.asarray(int(Status.UNDER_LIMIT), _I32)
+
+    # ---------------- token bucket, existing item ----------------
+    # RESET_REMAINING is checked before the algorithm-switch cast in the
+    # reference (algorithms.go:36 precedes :54), so it applies to any live
+    # slot regardless of the stored algorithm.
+    tok_reset = live & is_tok & reset_b  # algorithms.go:36-47
+
+    # Limit hot-change: remaining += r.limit - t.limit, clamp 0 (algorithms.go:70-78)
+    t_rem0 = jnp.maximum(g_rem + (req.limit - g_limit), 0)
+
+    # Duration hot-change (algorithms.go:87-105); expiry derives from CreatedAt.
+    dur_changed = g_dur != req.duration
+    exp_from_cfg = jnp.where(greg, req.greg_expire, g_stamp + req.duration)
+    dur_expired = dur_changed & (exp_from_cfg < now)  # => remove + recreate
+    t_exp = jnp.where(dur_changed, exp_from_cfg, g_exp)
+
+    tok_exist = exist & is_tok & ~reset_b & ~dur_expired
+    do_hit = hits > 0
+    can_take = do_hit & (hits <= t_rem0)  # covers == and < ; mutates
+    t_rem1 = jnp.where(can_take, t_rem0 - hits, t_rem0)
+    t_resp_status = jnp.where(
+        do_hit & ((t_rem0 == 0) | (hits > t_rem0)), OVER, g_status
+    )
+    # Sticky status persists only via the remaining==0 path (algorithms.go:112-117)
+    t_new_status = jnp.where(do_hit & (t_rem0 == 0), OVER, g_status)
+
+    # ---------------- token bucket, fresh create ----------------
+    # (selected in sel() as the fallback for token lanes that are neither
+    # tok_reset nor tok_exist: plain miss, algo switch, or dur_expired)
+    c_exp_tok = jnp.where(greg, req.greg_expire, now + req.duration)
+    c_over = hits > req.limit  # algorithms.go:161-166
+    c_rem_tok = jnp.where(c_over, req.limit, req.limit - hits)
+
+    # ---------------- leaky bucket, existing item ----------------
+    lky_exist = exist & ~is_tok
+    l_rem = jnp.where(lky_exist & reset_b, req.limit * LEAKY_SCALE, g_rem)  # :206-208
+
+    rate_num = jnp.where(greg, req.greg_duration, req.duration)
+    dur_eff = jnp.where(greg, req.greg_expire - now, req.duration)
+    lim_safe = jnp.maximum(req.limit, 1)
+
+    elapsed = now - g_stamp
+    rn = jnp.maximum(rate_num, 1)  # duration<=0 degenerates to instant refill
+    el_c = jnp.clip(elapsed, 0, rn)  # leak can't exceed one full refill
+    lim_nn = jnp.maximum(req.limit, 0)
+    # leak = elapsed * limit / duration, overflow-safe (see _muldiv128).
+    leak_whole, leak_rem = _muldiv128(el_c, lim_nn, rn)
+    leak_frac, _ = _muldiv128(leak_rem, jnp.full_like(leak_rem, LEAKY_SCALE), rn)
+    leak_s = leak_whole * LEAKY_SCALE + leak_frac
+    do_leak = leak_whole > 0  # only whole tokens trigger (algorithms.go:238-241)
+    l_rem = jnp.where(do_leak, l_rem + leak_s, l_rem)
+    l_stamp = jnp.where(do_leak, now, g_stamp)
+    l_rem = jnp.where(l_rem // LEAKY_SCALE > req.limit, req.limit * LEAKY_SCALE, l_rem)
+
+    rem_int = l_rem // LEAKY_SCALE
+    l_reset = now + rate_num // lim_safe  # now + int64(rate) (algorithms.go:251)
+
+    at_zero = rem_int == 0  # algorithms.go:260-264 (OVER even for hits==0)
+    exact = ~at_zero & (rem_int == hits)  # algorithms.go:266-271
+    overflow = ~at_zero & ~exact & (hits > rem_int)  # algorithms.go:273-278
+    take = exact | (~at_zero & ~overflow & (hits > 0))
+    l_rem_f = jnp.where(take, l_rem - hits * LEAKY_SCALE, l_rem)
+    l_resp_rem = jnp.where(exact, 0, jnp.where(take, l_rem_f // LEAKY_SCALE, rem_int))
+    l_resp_status = jnp.where(at_zero | overflow, OVER, UNDER)
+    # Expiry refresh only on the plain-subtract path (algorithms.go:287).
+    plain = take & ~exact
+    l_exp = jnp.where(plain, now + dur_eff, g_exp)
+
+    # ---------------- leaky bucket, fresh create ----------------
+    lky_create = ~is_tok & ~exist
+    lc_over = hits > req.limit  # algorithms.go:318-323
+    lc_rem = jnp.where(lc_over, 0, (req.limit - hits) * LEAKY_SCALE)
+    lc_exp = now + dur_eff
+    lc_reset = now + dur_eff // lim_safe  # algorithms.go:315 (integer div)
+
+    # ---------------- merge the five paths ----------------
+    def sel(tok_reset_v, tok_exist_v, tok_create_v, lky_exist_v, lky_create_v):
+        out = jnp.where(
+            is_tok,
+            jnp.where(
+                tok_reset,
+                tok_reset_v,
+                jnp.where(tok_exist, tok_exist_v, tok_create_v),
+            ),
+            jnp.where(lky_exist, lky_exist_v, lky_create_v),
+        )
+        return out
+
+    z64 = jnp.zeros_like(hits)
+    resp_status = sel(
+        UNDER * jnp.ones_like(g_status),
+        t_resp_status,
+        jnp.where(c_over, OVER, UNDER),
+        l_resp_status,
+        jnp.where(lc_over, OVER, UNDER),
+    )
+    resp_rem = sel(
+        req.limit,
+        jnp.where(can_take, t_rem1, t_rem0),
+        c_rem_tok,
+        l_resp_rem,
+        jnp.where(lc_over, z64, req.limit - hits),
+    )
+    resp_reset = sel(z64, t_exp, c_exp_tok, l_reset, lc_reset)
+
+    n_algo = jnp.where(valid, req.algorithm, g_algo)
+    n_limit = sel(g_limit, req.limit, req.limit, req.limit, req.limit)
+    n_rem = sel(g_rem, t_rem1, c_rem_tok, l_rem_f, lc_rem)
+    # Token stored Duration only set at create (algorithms.go:87-105 never
+    # writes t.Duration); leaky existing stores the raw request duration
+    # (algorithms.go:212), leaky create stores the adjusted one (:307).
+    n_dur = sel(g_dur, g_dur, req.duration, req.duration, dur_eff)
+    n_stamp = sel(g_stamp, g_stamp, now, l_stamp, now)
+    n_exp = sel(z64, t_exp, c_exp_tok, l_exp, lc_exp)
+    n_status = sel(UNDER * jnp.ones_like(g_status), t_new_status, UNDER, UNDER, UNDER)
+
+    removed = tok_reset & valid
+
+    # Scatter rows back; padding lanes (slot=-1) drop.
+    drop = dict(mode="drop")
+    new_state = BucketState(
+        algo=state.algo.at[req.slot].set(n_algo, **drop),
+        limit=state.limit.at[req.slot].set(n_limit, **drop),
+        remaining=state.remaining.at[req.slot].set(n_rem, **drop),
+        duration=state.duration.at[req.slot].set(n_dur, **drop),
+        stamp=state.stamp.at[req.slot].set(n_stamp, **drop),
+        expire_at=state.expire_at.at[req.slot].set(n_exp, **drop),
+        status=state.status.at[req.slot].set(n_status, **drop),
+    )
+
+    out = BatchOutput(
+        status=jnp.where(valid, resp_status, UNDER),
+        limit=jnp.where(valid, req.limit, z64),
+        remaining=jnp.where(valid, resp_rem, z64),
+        reset_time=jnp.where(valid, resp_reset, z64),
+        new_expire=jnp.where(valid, n_exp, z64),
+        removed=removed,
+    )
+    return new_state, out
+
+
+apply_batch_jit = jax.jit(apply_batch, donate_argnums=0)

@@ -1,0 +1,80 @@
+"""Gregorian interval math, ported from interval_test.go:27-116."""
+
+import datetime as dt
+
+import pytest
+
+from gubernator_tpu.utils import gregorian as g
+
+UTC = dt.timezone.utc
+
+
+def ms(y, mo, d, h=0, mi=0, s=0, msec=0):
+    return int(dt.datetime(y, mo, d, h, mi, s, msec * 1000, tzinfo=UTC).timestamp() * 1000)
+
+
+def test_expiration_minute():
+    now = dt.datetime(2019, 11, 11, 0, 0, 0, tzinfo=UTC)
+    assert g.gregorian_expiration(now, g.GREGORIAN_MINUTES) == ms(2019, 11, 11, 0, 0, 59, 999)
+    now = dt.datetime(2019, 11, 11, 0, 0, 30, 100, tzinfo=UTC)
+    assert g.gregorian_expiration(now, g.GREGORIAN_MINUTES) == 1573430459999
+
+
+def test_expiration_hour():
+    now = dt.datetime(2019, 11, 11, 0, 0, 0, tzinfo=UTC)
+    assert g.gregorian_expiration(now, g.GREGORIAN_HOURS) == ms(2019, 11, 11, 0, 59, 59, 999)
+    now = dt.datetime(2019, 11, 11, 0, 20, 1, 2134, tzinfo=UTC)
+    assert g.gregorian_expiration(now, g.GREGORIAN_HOURS) == 1573433999999
+
+
+def test_expiration_day():
+    now = dt.datetime(2019, 11, 11, 0, 0, 0, tzinfo=UTC)
+    assert g.gregorian_expiration(now, g.GREGORIAN_DAYS) == ms(2019, 11, 11, 23, 59, 59, 999)
+    now = dt.datetime(2019, 11, 11, 12, 10, 9, 2345, tzinfo=UTC)
+    assert g.gregorian_expiration(now, g.GREGORIAN_DAYS) == 1573516799999
+
+
+def test_expiration_month():
+    now = dt.datetime(2019, 11, 1, 0, 0, 0, tzinfo=UTC)
+    assert g.gregorian_expiration(now, g.GREGORIAN_MONTHS) == ms(2019, 11, 30, 23, 59, 59, 999)
+    now = dt.datetime(2019, 11, 11, 22, 2, 23, tzinfo=UTC)
+    assert g.gregorian_expiration(now, g.GREGORIAN_MONTHS) == 1575158399999
+    # January has 31 days
+    now = dt.datetime(2019, 1, 1, 0, 0, 0, tzinfo=UTC)
+    eom_ns = int(dt.datetime(2019, 2, 1, tzinfo=UTC).timestamp()) * 10**9 - 1
+    assert g.gregorian_expiration(now, g.GREGORIAN_MONTHS) == eom_ns // 10**6
+
+
+def test_expiration_year():
+    now = dt.datetime(2019, 1, 1, 0, 0, 0, tzinfo=UTC)
+    assert g.gregorian_expiration(now, g.GREGORIAN_YEARS) == ms(2019, 12, 31, 23, 59, 59, 999)
+    now = dt.datetime(2019, 3, 1, 20, 30, 0, tzinfo=UTC)
+    assert g.gregorian_expiration(now, g.GREGORIAN_YEARS) == 1577836799999
+
+
+def test_expiration_invalid():
+    now = dt.datetime(2019, 1, 1, tzinfo=UTC)
+    with pytest.raises(g.GregorianError, match="not a valid gregorian interval"):
+        g.gregorian_expiration(now, 99)
+    with pytest.raises(g.GregorianError, match="not yet supported"):
+        g.gregorian_expiration(now, g.GREGORIAN_WEEKS)
+
+
+def test_duration_constants():
+    now = dt.datetime(2019, 1, 1, tzinfo=UTC)
+    assert g.gregorian_duration(now, g.GREGORIAN_MINUTES) == 60_000
+    assert g.gregorian_duration(now, g.GREGORIAN_HOURS) == 3_600_000
+    assert g.gregorian_duration(now, g.GREGORIAN_DAYS) == 86_400_000
+    with pytest.raises(g.GregorianError):
+        g.gregorian_duration(now, g.GREGORIAN_WEEKS)
+    with pytest.raises(g.GregorianError):
+        g.gregorian_duration(now, 42)
+
+
+def test_duration_month_bugcompat():
+    """The reference computes end_ns - begin_ms for months/years
+    (interval.go:97,103 operator precedence); we are bug-compatible."""
+    now = dt.datetime(2019, 11, 11, tzinfo=UTC)
+    begin_s = int(dt.datetime(2019, 11, 1, tzinfo=UTC).timestamp())
+    end_ns = int(dt.datetime(2019, 12, 1, tzinfo=UTC).timestamp()) * 10**9 - 1
+    assert g.gregorian_duration(now, g.GREGORIAN_MONTHS) == end_ns - begin_s * 1000

@@ -1,0 +1,22 @@
+"""FNV-1/FNV-1a hashing against published test vectors."""
+
+from gubernator_tpu.utils import hashing
+
+
+def test_fnv1a_vectors():
+    # Standard FNV-64 reference vectors.
+    assert hashing.fnv1a_64(b"") == 0xCBF29CE484222325
+    assert hashing.fnv1a_64(b"a") == 0xAF63DC4C8601EC8C
+    assert hashing.fnv1a_64(b"foobar") == 0x85944171F73967E8
+
+
+def test_fnv1_vectors():
+    assert hashing.fnv1_64(b"") == 0xCBF29CE484222325
+    assert hashing.fnv1_64(b"a") == 0xAF63BD4C8601B7BE
+    assert hashing.fnv1_64(b"foobar") == 0x340D8765A4DDA9C2
+
+
+def test_hash_batch_matches_scalar():
+    keys = [f"key_{i}" for i in range(100)]
+    batch = hashing.hash_batch_64(keys)
+    assert batch == [hashing.hash_string_64(k) for k in keys]

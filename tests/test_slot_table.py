@@ -1,0 +1,50 @@
+"""SlotTable unit tests (cache.go semantics: expiry, LRU, accounting)."""
+
+from gubernator_tpu.models.slot_table import SlotTable
+
+
+def test_assign_and_hit():
+    t = SlotTable(4)
+    s, exists = t.lookup_or_assign("a", 100)
+    assert not exists
+    t.commit([s], [200], [False])
+    s2, exists = t.lookup_or_assign("a", 150)
+    assert s2 == s and exists
+    assert t.hits == 1 and t.misses == 1
+
+
+def test_expired_recycles_same_slot():
+    t = SlotTable(4)
+    s, _ = t.lookup_or_assign("a", 100)
+    t.commit([s], [200], [False])
+    # Strict expiry boundary: at exactly ExpireAt the item is still live
+    # (cache.go:151 `ExpireAt < now`).
+    s2, exists = t.lookup_or_assign("a", 200)
+    assert s2 == s and exists
+    s2, exists = t.lookup_or_assign("a", 201)  # past expiry
+    assert s2 == s and not exists
+
+
+def test_lru_eviction_order():
+    t = SlotTable(2)
+    sa, _ = t.lookup_or_assign("a", 0)
+    sb, _ = t.lookup_or_assign("b", 0)
+    t.commit([sa, sb], [10**15, 10**15], [False, False])
+    t.lookup_or_assign("a", 1)  # touch a; b becomes LRU
+    sc, _ = t.lookup_or_assign("c", 2)
+    assert sc == sb  # b evicted
+    assert t.get_slot("b") is None
+    assert t.get_slot("a") == sa
+    assert t.evictions == 1
+
+
+def test_removed_slot_freed():
+    t = SlotTable(2)
+    s, _ = t.lookup_or_assign("a", 0)
+    t.commit([s], [0], [True])
+    assert len(t) == 0
+    s2, exists = t.lookup_or_assign("b", 0)
+    assert not exists
+    assert s2 == s  # freed slot reused
+
+
