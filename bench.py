@@ -1,0 +1,76 @@
+"""Benchmark: end-to-end rate-limit check throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference reports > 2,000 requests/s on a single
+production node with batching (README.md:96-100; BASELINE.md).  Each
+value here is a full rate-limit check (validate -> key->slot resolve ->
+vectorized kernel -> response), measured steady-state through the
+public ShardStore path over a Zipf-ish key mix (hot keys + long tail),
+which mirrors BASELINE.json config 2.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    from gubernator_tpu.models.shard import ShardStore
+    from gubernator_tpu.types import Algorithm, RateLimitRequest
+
+    rng = np.random.RandomState(42)
+    n_keys = 100_000
+    batch_size = 8192
+    store = ShardStore(capacity=200_000)
+    now = 1_700_000_000_000
+
+    # Zipf-ish mix: 80% of traffic on 10% of keys.
+    hot = rng.randint(0, n_keys // 10, size=batch_size)
+    cold = rng.randint(0, n_keys, size=batch_size)
+    pick_hot = rng.random(batch_size) < 0.8
+    key_ids = np.where(pick_hot, hot, cold)
+
+    def make_batch(salt):
+        return [
+            RateLimitRequest(
+                name="bench",
+                unique_key=f"account:{(k + salt) % n_keys}",
+                hits=1,
+                limit=1_000_000,
+                duration=3_600_000,
+                algorithm=Algorithm.TOKEN_BUCKET if (k + salt) % 2 == 0 else Algorithm.LEAKY_BUCKET,
+            )
+            for k in key_ids
+        ]
+
+    # Warmup (compile + table fill).
+    store.apply(make_batch(0), now)
+    store.apply(make_batch(1), now + 1)
+
+    checks = 0
+    t0 = time.perf_counter()
+    rounds = 8
+    for i in range(rounds):
+        batch = make_batch(i % 4)
+        store.apply(batch, now + 2 + i)
+        checks += len(batch)
+    dt = time.perf_counter() - t0
+
+    value = checks / dt
+    baseline = 2000.0  # reference single-node req/s (README.md:96-100)
+    print(
+        json.dumps(
+            {
+                "metric": "rate_limit_checks_per_sec",
+                "value": round(value, 1),
+                "unit": "checks/s",
+                "vs_baseline": round(value / baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

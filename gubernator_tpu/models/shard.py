@@ -13,15 +13,15 @@ reference's mutex serialization (gubernator.go:336-337).
 from __future__ import annotations
 
 import datetime as _dt
+from collections import deque
 from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
 from ..ops import buckets
 from ..types import (
-    Algorithm,
     Behavior,
     RateLimitRequest,
     RateLimitResponse,
@@ -34,7 +34,7 @@ from .slot_table import SlotTable
 _PAD_SIZES = (64, 256, 1024, 4096, 16384, 65536, 262144, 1048576)
 
 
-def _pad_size(n: int) -> int:
+def pad_size(n: int) -> int:
     for p in _PAD_SIZES:
         if n <= p:
             return p
@@ -43,14 +43,133 @@ def _pad_size(n: int) -> int:
 
 @dataclass
 class _Prepared:
-    """A request resolved host-side, ready for kernel dispatch."""
+    """A request resolved host-side, ready for kernel dispatch.
+
+    gslot / cached_hint are used by the GLOBAL path (parallel/mesh.py):
+    cached_hint lanes answer from the replica columns, touch no local
+    bucket state, and scatter-add their hits — so they bypass the
+    round-uniqueness rules entirely.
+    """
 
     pos: int
     slot: int
     exists: bool
     req: RateLimitRequest
+    key: str
     greg_expire: int = 0
     greg_duration: int = 0
+    resolved: bool = False
+    gslot: int = -1
+    cached_hint: bool = False
+
+
+def prepare_requests(
+    requests: Sequence[RateLimitRequest],
+    now_ms: int,
+    responses: List[Optional[RateLimitResponse]],
+    positions: Optional[Sequence[int]] = None,
+) -> List[_Prepared]:
+    """Precompute per-request host-side values (hash key, Gregorian
+    expiry/duration — the host analogue of algorithms.go:90-95,140-145).
+    Requests with invalid Gregorian durations get error responses
+    directly (reference returns the error per-request)."""
+    now_dt = _dt.datetime.fromtimestamp(now_ms / 1000.0, tz=_dt.timezone.utc)
+    # now_dt is fixed for the whole batch, so Gregorian math depends only
+    # on req.duration — memoize the (at most 6) distinct values.
+    greg_cache: Dict[int, object] = {}
+    prepared: List[_Prepared] = []
+
+    for i, req in enumerate(requests):
+        pos = positions[i] if positions is not None else i
+        p = _Prepared(pos=pos, slot=-1, exists=False, req=req, key=req.hash_key())
+        if has_behavior(req.behavior, Behavior.DURATION_IS_GREGORIAN):
+            if req.duration not in greg_cache:
+                try:
+                    greg_cache[req.duration] = (
+                        gregorian.gregorian_expiration(now_dt, req.duration),
+                        gregorian.gregorian_duration(now_dt, req.duration),
+                    )
+                except gregorian.GregorianError as e:
+                    greg_cache[req.duration] = e
+            cached = greg_cache[req.duration]
+            if isinstance(cached, gregorian.GregorianError):
+                responses[pos] = RateLimitResponse(error=str(cached))
+                continue
+            p.greg_expire, p.greg_duration = cached
+        prepared.append(p)
+    return prepared
+
+
+class RoundPlanner:
+    """Splits a prepared request stream into kernel rounds.
+
+    A round must have unique keys AND unique slots (the scatter is
+    race-free only then); a duplicate ends the round so the k-th request
+    for a key observes the (k-1)-th's committed state — the vectorized
+    equivalent of the reference's mutex serialization
+    (gubernator.go:336-337).  A slot collision can only happen when LRU
+    eviction under capacity pressure reuses a slot already scheduled in
+    the current round; the colliding request keeps its captured
+    (slot, exists) — re-resolving after the round would see the stale
+    mirror the evicted lane wrote — and runs next round, preserving
+    sequential evict-then-create semantics.
+    """
+
+    def __init__(self, table: SlotTable, prepared: Sequence[_Prepared], now_ms: int):
+        self.table = table
+        self.queue = deque(prepared)
+        self.now_ms = now_ms
+
+    def next_chunk(self) -> List[_Prepared]:
+        cur: List[_Prepared] = []
+        seen_keys: set = set()
+        used_slots: set = set()
+        while self.queue:
+            p = self.queue[0]
+            if p.cached_hint:
+                # Replica-cache lane: no local state touched, hit
+                # accumulation is scatter-add (duplicate-safe) — exempt
+                # from key/slot uniqueness.
+                p.slot, p.exists, p.resolved = -1, False, True
+                cur.append(p)
+                self.queue.popleft()
+                continue
+            if p.key in seen_keys:
+                break  # duplicate key: must see this round's commit first
+            if not p.resolved:
+                p.slot, p.exists = self.table.lookup_or_assign(p.key, self.now_ms)
+                p.resolved = True
+            if p.slot in used_slots:
+                break  # eviction collision: run next round as-is
+            cur.append(p)
+            seen_keys.add(p.key)
+            used_slots.add(p.slot)
+            self.queue.popleft()
+        return cur
+
+
+def build_round_arrays(chunk: Sequence[_Prepared], padded: int) -> Tuple[np.ndarray, ...]:
+    """Columnize one round of prepared requests into kernel input arrays."""
+    slot = np.full(padded, -1, dtype=np.int32)
+    exists = np.zeros(padded, dtype=bool)
+    algo = np.zeros(padded, dtype=np.int32)
+    behavior = np.zeros(padded, dtype=np.int32)
+    hits = np.zeros(padded, dtype=np.int64)
+    limit = np.zeros(padded, dtype=np.int64)
+    duration = np.zeros(padded, dtype=np.int64)
+    greg_expire = np.zeros(padded, dtype=np.int64)
+    greg_duration = np.zeros(padded, dtype=np.int64)
+    for i, p in enumerate(chunk):
+        slot[i] = p.slot
+        exists[i] = p.exists
+        algo[i] = int(p.req.algorithm)
+        behavior[i] = int(p.req.behavior)
+        hits[i] = p.req.hits
+        limit[i] = p.req.limit
+        duration[i] = p.req.duration
+        greg_expire[i] = p.greg_expire
+        greg_duration[i] = p.greg_duration
+    return slot, exists, algo, behavior, hits, limit, duration, greg_expire, greg_duration
 
 
 class ShardStore:
@@ -72,96 +191,26 @@ class ShardStore:
         self, requests: Sequence[RateLimitRequest], now_ms: int
     ) -> List[RateLimitResponse]:
         """Evaluate a batch; responses come back in request order."""
-        n = len(requests)
-        responses: List[Optional[RateLimitResponse]] = [None] * n
-        prepared: List[_Prepared] = []
-        now_dt = _dt.datetime.fromtimestamp(now_ms / 1000.0, tz=_dt.timezone.utc)
-
-        # now_dt is fixed for the whole batch, so Gregorian math depends
-        # only on req.duration — memoize the (at most 6) distinct values.
-        greg_cache: dict = {}
-
-        for pos, req in enumerate(requests):
-            p = _Prepared(pos=pos, slot=-1, exists=False, req=req)
-            if has_behavior(req.behavior, Behavior.DURATION_IS_GREGORIAN):
-                if req.duration not in greg_cache:
-                    try:
-                        greg_cache[req.duration] = (
-                            gregorian.gregorian_expiration(now_dt, req.duration),
-                            gregorian.gregorian_duration(now_dt, req.duration),
-                        )
-                    except gregorian.GregorianError as e:
-                        greg_cache[req.duration] = e
-                cached = greg_cache[req.duration]
-                if isinstance(cached, gregorian.GregorianError):
-                    responses[pos] = RateLimitResponse(error=str(cached))
-                    continue
-                p.greg_expire, p.greg_duration = cached
-            prepared.append(p)
-
-        # Build rounds incrementally in request order.  A round must have
-        # unique keys AND unique slots (the scatter is race-free only
-        # then); a duplicate flushes the pending round first so the k-th
-        # request for a key observes the (k-1)-th's committed state —
-        # the vectorized equivalent of the reference's mutex
-        # serialization (gubernator.go:336-337).  A slot collision can
-        # only happen when LRU eviction under capacity pressure reuses a
-        # slot already scheduled this round; flushing first preserves
-        # sequential evict-then-create semantics.
-        cur: List[_Prepared] = []
-        seen_keys: set = set()
-        used_slots: set = set()
-
-        def flush():
-            nonlocal cur, seen_keys, used_slots
-            if cur:
-                self._run_round(cur, now_ms, responses)
-            cur, seen_keys, used_slots = [], set(), set()
-
-        for p in prepared:
-            key = p.req.hash_key()
-            if key in seen_keys:
-                flush()
-            p.slot, p.exists = self.table.lookup_or_assign(key, now_ms)
-            if p.slot in used_slots:
-                flush()
-            cur.append(p)
-            seen_keys.add(key)
-            used_slots.add(p.slot)
-        flush()
-
+        responses: List[Optional[RateLimitResponse]] = [None] * len(requests)
+        prepared = prepare_requests(requests, now_ms, responses)
+        planner = RoundPlanner(self.table, prepared, now_ms)
+        while True:
+            chunk = planner.next_chunk()
+            if not chunk:
+                break
+            self._run_round(chunk, now_ms, responses)
         return [r if r is not None else RateLimitResponse() for r in responses]
 
     # ------------------------------------------------------------------
     def _run_round(
-        self, chunk: List[_Prepared], now_ms: int, responses: List[Optional[RateLimitResponse]]
+        self,
+        chunk: List[_Prepared],
+        now_ms: int,
+        responses: List[Optional[RateLimitResponse]],
     ) -> None:
         b = len(chunk)
-        padded = _pad_size(b)
-        slot = np.full(padded, -1, dtype=np.int32)
-        exists = np.zeros(padded, dtype=bool)
-        algo = np.zeros(padded, dtype=np.int32)
-        behavior = np.zeros(padded, dtype=np.int32)
-        hits = np.zeros(padded, dtype=np.int64)
-        limit = np.zeros(padded, dtype=np.int64)
-        duration = np.zeros(padded, dtype=np.int64)
-        greg_expire = np.zeros(padded, dtype=np.int64)
-        greg_duration = np.zeros(padded, dtype=np.int64)
-
-        for i, p in enumerate(chunk):
-            slot[i] = p.slot
-            exists[i] = p.exists
-            algo[i] = int(p.req.algorithm)
-            behavior[i] = int(p.req.behavior)
-            hits[i] = p.req.hits
-            limit[i] = p.req.limit
-            duration[i] = p.req.duration
-            greg_expire[i] = p.greg_expire
-            greg_duration[i] = p.greg_duration
-
-        batch = buckets.make_batch(
-            slot, exists, algo, behavior, hits, limit, duration, greg_expire, greg_duration
-        )
+        arrays = build_round_arrays(chunk, pad_size(b))
+        batch = buckets.make_batch(*arrays)
         self.state, out = buckets.apply_batch_jit(self.state, batch, now_ms)
 
         out_status = np.asarray(out.status)
@@ -170,7 +219,10 @@ class ShardStore:
         out_exp = np.asarray(out.new_expire)
         out_removed = np.asarray(out.removed)
 
-        self.table.commit(slot[:b], out_exp[:b], out_removed[:b])
+        slot = arrays[0]
+        self.table.commit(
+            slot[:b], out_exp[:b], out_removed[:b], keys=[p.key for p in chunk]
+        )
         for i, p in enumerate(chunk):
             self.algo_mirror[p.slot] = int(p.req.algorithm)
             responses[p.pos] = RateLimitResponse(

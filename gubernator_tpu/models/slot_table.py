@@ -80,11 +80,21 @@ class SlotTable:
         slots: Sequence[int],
         new_expire_ms: Sequence[int],
         removed: Sequence[bool],
+        keys: Optional[Sequence[str]] = None,
     ) -> None:
-        """Fold kernel outputs back into the host mirror; free removed slots."""
-        for slot, exp, rm in zip(slots, new_expire_ms, removed):
+        """Fold kernel outputs back into the host mirror; free removed slots.
+
+        `keys` guards against stale lanes: if eviction during the same
+        batch remapped a slot to a different key after this lane was
+        scheduled, the lane's result must NOT touch the slot's new owner
+        (the evicted lane's state is simply dropped, matching sequential
+        evict semantics).
+        """
+        for i, (slot, exp, rm) in enumerate(zip(slots, new_expire_ms, removed)):
             if slot < 0:
                 continue
+            if keys is not None and self._slot_to_key[slot] != keys[i]:
+                continue  # slot remapped mid-batch; this lane is stale
             if rm:
                 self.remove_slot(slot)
             else:

@@ -359,16 +359,19 @@ def apply_batch(state: BucketState, req: RequestBatch, now_ms) -> "tuple[BucketS
 
     removed = tok_reset & valid
 
-    # Scatter rows back; padding lanes (slot=-1) drop.
+    # Scatter rows back.  Padding lanes (slot=-1) must NOT write: jax
+    # `.at[-1]` wraps like NumPy negative indexing, so map them to C
+    # (out of bounds) where mode='drop' actually drops them.
+    scat = jnp.where(valid, req.slot, C)
     drop = dict(mode="drop")
     new_state = BucketState(
-        algo=state.algo.at[req.slot].set(n_algo, **drop),
-        limit=state.limit.at[req.slot].set(n_limit, **drop),
-        remaining=state.remaining.at[req.slot].set(n_rem, **drop),
-        duration=state.duration.at[req.slot].set(n_dur, **drop),
-        stamp=state.stamp.at[req.slot].set(n_stamp, **drop),
-        expire_at=state.expire_at.at[req.slot].set(n_exp, **drop),
-        status=state.status.at[req.slot].set(n_status, **drop),
+        algo=state.algo.at[scat].set(n_algo, **drop),
+        limit=state.limit.at[scat].set(n_limit, **drop),
+        remaining=state.remaining.at[scat].set(n_rem, **drop),
+        duration=state.duration.at[scat].set(n_dur, **drop),
+        stamp=state.stamp.at[scat].set(n_stamp, **drop),
+        expire_at=state.expire_at.at[scat].set(n_exp, **drop),
+        status=state.status.at[scat].set(n_status, **drop),
     )
 
     out = BatchOutput(

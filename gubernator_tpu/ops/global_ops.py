@@ -1,0 +1,237 @@
+"""GLOBAL-behavior kernels: replica caches, hit accumulators, and the
+collective sync program.
+
+Reference model (global.go, gubernator.go:231-272, architecture.md:46-74):
+a GLOBAL rate limit is owned by one peer; every other peer answers from
+a local cache of the owner's last broadcast status, asynchronously
+forwards aggregated hits to the owner, and the owner broadcasts
+authoritative status back.  Three RPC pipelines (QueueHit->sendHits,
+GetPeerRateLimits, UpdatePeerGlobals) implement this.
+
+TPU-native redesign: "peers" are mesh shards.  GLOBAL keys get a
+process-wide dense id (gslot) so every shard indexes the same [G]
+replica columns.  Per shard:
+  * replica columns rep_* [G]      — the owner's last broadcast status
+                                     (the non-owner cache of
+                                     gubernator.go:263-270, ExpireAt =
+                                     ResetTime)
+  * hit accumulator ghits [G]      — hits answered locally, not yet
+                                     forwarded (globalManager.asyncQueue
+                                     aggregation, global.go:83-91)
+
+The answer kernel (answer_batch) extends the bucket kernel: lanes whose
+replica entry is live answer from it WITHOUT touching local buckets
+(gubernator.go:241-249); lanes whose entry is dead fall through to a
+normal local-bucket evaluation, exactly the reference's
+"process as if we own it" fallback (gubernator.go:250-254).  Either
+way the lane's hits scatter-add into ghits (duplicate gslots are safe:
+scatter-add commutes).
+
+The sync program (global_sync) is ONE shard_map over the mesh replacing
+all three RPC pipelines with collectives:
+  1. psum(ghits)            — hit aggregation to owners
+                              (replaces sendHits, global.go:120-160)
+  2. owners apply the summed hits to their buckets via the bucket
+     kernel (replaces GetPeerRateLimits -> getRateLimit)
+  3. psum of owner-masked status — authoritative broadcast
+                              (replaces broadcastPeers, global.go:198-243;
+                              sum works because exactly one shard owns
+                              each gslot)
+  4. every shard writes its replica columns; accumulators reset.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..types import Behavior
+from . import buckets
+from .buckets import BucketState, RequestBatch, BatchOutput
+
+_I64 = jnp.int64
+_I32 = jnp.int32
+
+
+class GlobalColumns(NamedTuple):
+    """Per-shard GLOBAL state (leading axis [G] per shard).
+
+    rep_*: cached owner-broadcast status (the RateLimitResp cache item of
+    gubernator.go:263-270).  ghits: locally-accumulated unforwarded hits.
+    """
+
+    rep_status: jax.Array  # i32[G]
+    rep_limit: jax.Array  # i64[G]
+    rep_remaining: jax.Array  # i64[G]
+    rep_reset: jax.Array  # i64[G]
+    rep_expire: jax.Array  # i64[G]
+    ghits: jax.Array  # i64[G]
+
+
+class GlobalBatchExtra(NamedTuple):
+    """Extra per-lane request columns for GLOBAL routing.
+
+    gslot: process-wide GLOBAL key id; -1 for non-GLOBAL lanes and for
+    GLOBAL lanes evaluated at their owner shard (those take the normal
+    bucket path; only the dirty flag is tracked host-side).
+    """
+
+    gslot: jax.Array  # i32[B]
+
+
+class SyncConfig(NamedTuple):
+    """Per-gslot apply config for the sync step, host-provided (the host
+    mirrors the last-seen request config per GLOBAL key, standing in for
+    the full RateLimitReq the reference forwards in GetPeerRateLimits)."""
+
+    owner_slot: jax.Array  # i32[G] owner shard's local bucket slot
+    owner_shard: jax.Array  # i32[G]
+    algorithm: jax.Array  # i32[G]
+    behavior: jax.Array  # i32[G] (GLOBAL bit stripped host-side)
+    limit: jax.Array  # i64[G]
+    duration: jax.Array  # i64[G]
+    greg_expire: jax.Array  # i64[G]
+    greg_duration: jax.Array  # i64[G]
+
+
+def clear_gslots(gcols: GlobalColumns, gslots) -> GlobalColumns:
+    """Zero the rows of recycled gslots (host evicted their keys).
+
+    Run immediately at eviction so a reused gslot can never serve the
+    previous key's cached status.  Unforwarded ghits for the evicted key
+    are dropped — analogous to the reference losing a key's state on LRU
+    eviction (cache.go:115-130).
+    """
+    idx = jnp.asarray(gslots, _I32)
+    return GlobalColumns(
+        rep_status=gcols.rep_status.at[idx].set(0, mode="drop"),
+        rep_limit=gcols.rep_limit.at[idx].set(0, mode="drop"),
+        rep_remaining=gcols.rep_remaining.at[idx].set(0, mode="drop"),
+        rep_reset=gcols.rep_reset.at[idx].set(0, mode="drop"),
+        rep_expire=gcols.rep_expire.at[idx].set(0, mode="drop"),
+        ghits=gcols.ghits.at[idx].set(0, mode="drop"),
+    )
+
+
+def init_global_columns(g_capacity: int) -> GlobalColumns:
+    z64 = jnp.zeros((g_capacity,), _I64)
+    return GlobalColumns(
+        rep_status=jnp.zeros((g_capacity,), _I32),
+        rep_limit=z64,
+        rep_remaining=z64,
+        rep_reset=z64,
+        rep_expire=z64,
+        ghits=z64,
+    )
+
+
+def answer_batch(
+    state: BucketState,
+    gcols: GlobalColumns,
+    req: RequestBatch,
+    extra: GlobalBatchExtra,
+    now_ms,
+):
+    """Unified per-shard request kernel: bucket evaluation + GLOBAL
+    replica-cache short-circuit + hit accumulation.
+
+    Returns (new_state, new_gcols, out, cached) where cached[b] marks
+    lanes answered from the replica cache (no local bucket mutation —
+    the host must skip its slot-table commit for those lanes).
+    """
+    now = jnp.asarray(now_ms, _I64)
+    G = gcols.rep_status.shape[0]
+    has_g = extra.gslot >= 0
+    g = jnp.clip(extra.gslot, 0, G - 1)
+
+    # Live replica entry => answer from cache (gubernator.go:241-249).
+    cached = has_g & (gcols.rep_expire[g] >= now)
+
+    # Cached lanes skip local bucket evaluation entirely.
+    local_req = req._replace(slot=jnp.where(cached, -1, req.slot))
+    new_state, out = buckets.apply_batch(state, local_req, now)
+
+    status = jnp.where(cached, gcols.rep_status[g], out.status)
+    limit = jnp.where(cached, gcols.rep_limit[g], out.limit)
+    remaining = jnp.where(cached, gcols.rep_remaining[g], out.remaining)
+    reset_time = jnp.where(cached, gcols.rep_reset[g], out.reset_time)
+
+    # Async hit forwarding: aggregate into the accumulator
+    # (globalManager.QueueHit + the sum at global.go:83-91).  Non-GLOBAL
+    # lanes map to G (out of bounds) so mode='drop' drops them —
+    # `.at[-1]` would wrap to the last gslot.
+    gs = jnp.where(has_g, extra.gslot, G)
+    new_gcols = gcols._replace(ghits=gcols.ghits.at[gs].add(req.hits, mode="drop"))
+
+    out = BatchOutput(
+        status=status,
+        limit=limit,
+        remaining=remaining,
+        reset_time=reset_time,
+        new_expire=out.new_expire,
+        removed=out.removed,
+    )
+    return new_state, new_gcols, out, cached
+
+
+def global_sync(
+    state: BucketState,
+    gcols: GlobalColumns,
+    cfg: SyncConfig,
+    dirty,  # bool[G] — this shard owns these gslots and touched them locally
+    now_ms,
+    *,
+    axis: str,
+):
+    """One GLOBAL sync step for one shard, meant to run inside shard_map
+    over `axis`.  Collectives replace the reference's three RPC
+    pipelines (see module docstring)."""
+    now = jnp.asarray(now_ms, _I64)
+    my = jax.lax.axis_index(axis).astype(_I32)
+
+    total = jax.lax.psum(gcols.ghits, axis)  # hit aggregation -> owners
+
+    mine = cfg.owner_shard == my
+    # Owners apply when there are forwarded hits or local dirt; hits==0
+    # lanes are pure status reads (broadcastPeers' Hits=0 getRateLimit,
+    # global.go:202-214).
+    any_dirty = jax.lax.psum(jnp.where(mine & dirty, 1, 0).astype(_I32), axis) > 0
+    active = (total > 0) | any_dirty
+    apply_mask = mine & active & (cfg.owner_slot >= 0)
+
+    batch = RequestBatch(
+        slot=jnp.where(apply_mask, cfg.owner_slot, -1),
+        exists=apply_mask,  # kernel re-validates expiry device-side
+        algorithm=cfg.algorithm,
+        behavior=cfg.behavior,
+        hits=total,
+        limit=cfg.limit,
+        duration=cfg.duration,
+        greg_expire=cfg.greg_expire,
+        greg_duration=cfg.greg_duration,
+    )
+    new_state, out = buckets.apply_batch(state, batch, now)
+
+    # Authoritative broadcast: exactly one shard owns each gslot, so a
+    # masked psum is the broadcast (replaces UpdatePeerGlobals).
+    def bcast(v):
+        return jax.lax.psum(jnp.where(apply_mask, v, 0), axis)
+
+    b_status = bcast(out.status.astype(_I32))
+    b_limit = bcast(out.limit)
+    b_remaining = bcast(out.remaining)
+    b_reset = bcast(out.reset_time)
+    applied = jax.lax.psum(apply_mask.astype(_I32), axis) > 0
+
+    new_gcols = GlobalColumns(
+        rep_status=jnp.where(applied, b_status, gcols.rep_status),
+        rep_limit=jnp.where(applied, b_limit, gcols.rep_limit),
+        rep_remaining=jnp.where(applied, b_remaining, gcols.rep_remaining),
+        # Non-owner cache item expires at ResetTime (gubernator.go:268).
+        rep_reset=jnp.where(applied, b_reset, gcols.rep_reset),
+        rep_expire=jnp.where(applied, b_reset, gcols.rep_expire),
+        ghits=jnp.zeros_like(gcols.ghits),
+    )
+    return new_state, new_gcols, out, applied

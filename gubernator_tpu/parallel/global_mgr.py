@@ -1,0 +1,88 @@
+"""Host-side bookkeeping for GLOBAL keys: the process-wide gslot table.
+
+Every GLOBAL key gets one dense id (gslot) shared by all shards, so the
+device-side replica columns and hit accumulators (ops/global_ops.py) are
+uniformly indexed across the mesh.  The host mirrors per-key config
+(the stand-in for the full RateLimitReq the reference forwards in
+GetPeerRateLimits, global.go:129-145) and the owner's slot mapping.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..types import Behavior, set_behavior
+
+
+class GlobalKeyTable:
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._key_to_gslot: Dict[str, int] = {}
+        self._gslot_to_key: List[Optional[str]] = [None] * capacity
+        self._free: List[int] = list(range(capacity - 1, -1, -1))
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+
+        self.owner_shard = np.full(capacity, -1, dtype=np.int32)
+        self.owner_slot = np.full(capacity, -1, dtype=np.int32)
+        self.algorithm = np.zeros(capacity, dtype=np.int32)
+        self.behavior = np.zeros(capacity, dtype=np.int32)  # GLOBAL bit stripped
+        self.limit = np.zeros(capacity, dtype=np.int64)
+        self.duration = np.zeros(capacity, dtype=np.int64)
+        self.greg_expire = np.zeros(capacity, dtype=np.int64)
+        self.greg_duration = np.zeros(capacity, dtype=np.int64)
+        # Host mirror of the broadcast expiry (== device rep_expire rows).
+        self.rep_expire = np.zeros(capacity, dtype=np.int64)
+
+    def __len__(self) -> int:
+        return len(self._key_to_gslot)
+
+    def key_of(self, gslot: int) -> Optional[str]:
+        return self._gslot_to_key[gslot]
+
+    def get(self, key: str) -> Optional[int]:
+        g = self._key_to_gslot.get(key)
+        if g is not None:
+            self._lru.move_to_end(g)
+        return g
+
+    def lookup_or_assign(self, key: str, owner_shard: int):
+        """Returns (gslot, evicted_gslot_or_None).  The caller must clear
+        the evicted gslot's device rows before reusing it."""
+        g = self._key_to_gslot.get(key)
+        if g is not None:
+            self._lru.move_to_end(g)
+            return g, None
+        evicted = None
+        if self._free:
+            g = self._free.pop()
+        else:
+            g, _ = self._lru.popitem(last=False)
+            old = self._gslot_to_key[g]
+            if old is not None:
+                del self._key_to_gslot[old]
+            evicted = g
+        self._key_to_gslot[key] = g
+        self._gslot_to_key[g] = key
+        self._lru[g] = None
+        self._lru.move_to_end(g)
+        self.owner_shard[g] = owner_shard
+        self.owner_slot[g] = -1
+        self.rep_expire[g] = 0
+        return g, evicted
+
+    def update_config(self, g: int, req, greg_expire: int, greg_duration: int) -> None:
+        """Last-writer-wins config mirror.  (The reference keeps the
+        FIRST queued request's config per window and sums hits,
+        global.go:83-91; configs for one key are identical in practice.)"""
+        self.algorithm[g] = int(req.algorithm)
+        self.behavior[g] = set_behavior(req.behavior, Behavior.GLOBAL, False)
+        self.limit[g] = req.limit
+        self.duration[g] = req.duration
+        self.greg_expire[g] = greg_expire
+        self.greg_duration[g] = greg_duration
+
+    def active_gslots(self) -> List[int]:
+        return list(self._key_to_gslot.values())

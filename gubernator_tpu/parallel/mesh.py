@@ -1,0 +1,296 @@
+"""Mesh-sharded bucket store: key ownership = device shard.
+
+The TPU-native replacement for the reference's peer cluster
+(replicated_hash.go key->owner + per-peer caches): bucket state columns
+get a leading shard axis laid out over a 1-D `jax.sharding.Mesh`, and
+one program applies every shard's request sub-batch to its own state
+slice in a single dispatch.  What the reference does with N gRPC
+servers and a consistent-hash ring across processes, this does with N
+devices and a static shardmap inside one XLA program — peer traffic
+becomes ICI traffic.
+
+GLOBAL behavior (Behavior.GLOBAL) is fully supported: non-owner shards
+answer from replica columns and accumulate hits device-side; a periodic
+`sync_globals()` runs ONE shard_map collective program (psum hit
+aggregation -> owner apply -> psum status broadcast) in place of the
+reference's three RPC pipelines (global.go).  See ops/global_ops.py.
+
+Key -> shard assignment is `fnv1a(key) % n_shards` (a static shardmap;
+the dynamic-membership ring remains at the host/daemon tier for
+multi-process deployments, parallel/hash_ring.py).  The mesh is static
+for the process lifetime — the reference drops bucket state on
+membership change anyway (architecture.md:5-11), so elasticity lives at
+the host tier in both designs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.shard import RoundPlanner, build_round_arrays, pad_size, prepare_requests
+from ..models.slot_table import SlotTable
+from ..ops import buckets, global_ops
+from ..types import Behavior, RateLimitRequest, RateLimitResponse, has_behavior
+from ..utils import hashing
+from .global_mgr import GlobalKeyTable
+
+try:
+    from jax import shard_map  # jax >= 0.6
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def shard_of_key(key: str, n_shards: int) -> int:
+    """Static shardmap: fnv1a-64 of the hash key, modulo shard count."""
+    return hashing.hash_string_64(key) % n_shards
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None, axis: str = "shard") -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), (axis,))
+
+
+class MeshBucketStore:
+    """Bucket tables for all local shards, sharded over a device mesh.
+
+    The host keeps one SlotTable per shard; requests are bucketed by
+    `shard_of_key`, each shard's stream is round-planned independently
+    (duplicate keys serialize within their shard), and all shards' round
+    r runs as ONE sharded program dispatch.
+
+    `apply(..., home_shard=s)` models the reference's ingress topology:
+    the request arrived at peer s, which may not own the key.  GLOBAL
+    requests at a non-owner answer locally (replica cache or as-if-owner
+    fallback, gubernator.go:231-255) and forward hits at the next
+    `sync_globals()`.  Non-GLOBAL requests always route to the owner
+    (the in-process equivalent of the BATCHING forward,
+    peer_client.go:237-268).
+    """
+
+    def __init__(
+        self,
+        capacity_per_shard: int = 50_000,
+        g_capacity: int = 4096,
+        mesh: Optional[Mesh] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ):
+        self.mesh = mesh if mesh is not None else make_mesh(devices)
+        (self.axis,) = self.mesh.axis_names
+        self.n_shards = self.mesh.devices.size
+        self.capacity_per_shard = capacity_per_shard
+        self.g_capacity = g_capacity
+        self.tables = [SlotTable(capacity_per_shard) for _ in range(self.n_shards)]
+        self.algo_mirror = [
+            np.zeros(capacity_per_shard, dtype=np.int32) for _ in range(self.n_shards)
+        ]
+        self.gtable = GlobalKeyTable(g_capacity)
+        self.dirty = np.zeros((self.n_shards, g_capacity), dtype=bool)
+
+        self._sharding = NamedSharding(self.mesh, P(self.axis))
+        self.state = self._stack_and_shard(buckets.init_state(capacity_per_shard))
+        self.gcols = self._stack_and_shard(global_ops.init_global_columns(g_capacity))
+
+        axis = self.axis
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def _answer(state, gcols, batch, extra, now):
+            return jax.vmap(global_ops.answer_batch, in_axes=(0, 0, 0, 0, None))(
+                state, gcols, batch, extra, now
+            )
+
+        self._answer_fn = _answer
+
+        def _sync_body(state, gcols, cfg, dirty, now):
+            sq = lambda t: jax.tree.map(lambda a: a[0], t)
+            ns, ngc, out, applied = global_ops.global_sync(
+                sq(state), sq(gcols), cfg, dirty[0], now, axis=axis
+            )
+            ex = lambda t: jax.tree.map(lambda a: a[None], t)
+            return ex(ns), ex(ngc), ex(out), applied[None]
+
+        self._sync_fn = jax.jit(
+            shard_map(
+                _sync_body,
+                mesh=self.mesh,
+                in_specs=(P(axis), P(axis), P(), P(axis), P()),
+                out_specs=(P(axis), P(axis), P(axis), P(axis)),
+            ),
+            donate_argnums=(0, 1),
+        )
+
+        @partial(jax.jit, donate_argnums=0)
+        def _clear(gcols, idx):
+            return jax.vmap(global_ops.clear_gslots, in_axes=(0, None))(gcols, idx)
+
+        self._clear_fn = _clear
+
+    def _stack_and_shard(self, single):
+        stacked = jax.tree.map(
+            lambda c: np.broadcast_to(np.asarray(c), (self.n_shards,) + c.shape).copy(), single
+        )
+        return jax.tree.map(lambda c: jax.device_put(c, self._sharding), stacked)
+
+    # ------------------------------------------------------------------
+    def apply(
+        self,
+        requests: Sequence[RateLimitRequest],
+        now_ms: int,
+        home_shard: Optional[int] = None,
+    ) -> List[RateLimitResponse]:
+        """Evaluate a batch across all shards; responses in request order."""
+        responses: List[Optional[RateLimitResponse]] = [None] * len(requests)
+        prepared = prepare_requests(requests, now_ms, responses)
+
+        by_shard: List[list] = [[] for _ in range(self.n_shards)]
+        for p in prepared:
+            owner = shard_of_key(p.key, self.n_shards)
+            target = owner
+            if has_behavior(p.req.behavior, Behavior.GLOBAL):
+                g, evicted = self.gtable.lookup_or_assign(p.key, owner)
+                if evicted is not None:
+                    self.gcols = self._clear_fn(self.gcols, np.array([evicted], np.int32))
+                self.gtable.update_config(g, p.req, p.greg_expire, p.greg_duration)
+                if home_shard is not None and home_shard != owner:
+                    # Non-owner: answer locally, forward hits at sync
+                    # (gubernator.go:231-255).
+                    p.gslot = g
+                    target = home_shard
+                    if self.gtable.rep_expire[g] >= now_ms:
+                        p.cached_hint = True
+                else:
+                    # Owner applies directly and owes a broadcast
+                    # (getRateLimit's QueueUpdate, gubernator.go:339-341).
+                    self.dirty[owner, g] = True
+            by_shard[target].append(p)
+
+        planners = [
+            RoundPlanner(self.tables[s], by_shard[s], now_ms) for s in range(self.n_shards)
+        ]
+        while True:
+            chunks = [pl.next_chunk() for pl in planners]
+            if not any(chunks):
+                break
+            self._run_round(chunks, now_ms, responses)
+
+        return [r if r is not None else RateLimitResponse() for r in responses]
+
+    # ------------------------------------------------------------------
+    def _run_round(self, chunks, now_ms: int, responses) -> None:
+        padded = pad_size(max(max((len(c) for c in chunks), default=1), 1))
+        cols = [build_round_arrays(c, padded) for c in chunks]
+        stacked = [np.stack([col[f] for col in cols]) for f in range(9)]
+        gslot = np.full((self.n_shards, padded), -1, dtype=np.int32)
+        for s, chunk in enumerate(chunks):
+            for i, p in enumerate(chunk):
+                gslot[s, i] = p.gslot
+
+        batch = buckets.RequestBatch(*[jnp.asarray(a) for a in stacked])
+        batch = jax.tree.map(lambda c: jax.device_put(c, self._sharding), batch)
+        extra = global_ops.GlobalBatchExtra(
+            gslot=jax.device_put(jnp.asarray(gslot), self._sharding)
+        )
+
+        self.state, self.gcols, out, cached = self._answer_fn(
+            self.state, self.gcols, batch, extra, now_ms
+        )
+
+        out_status = np.asarray(out.status)
+        out_limit = np.asarray(out.limit)
+        out_rem = np.asarray(out.remaining)
+        out_reset = np.asarray(out.reset_time)
+        out_exp = np.asarray(out.new_expire)
+        out_removed = np.asarray(out.removed)
+        cached_np = np.asarray(cached)
+
+        for s, chunk in enumerate(chunks):
+            if not chunk:
+                continue
+            commit_slots, commit_exp, commit_rm, commit_keys = [], [], [], []
+            for i, p in enumerate(chunk):
+                if not cached_np[s, i] and p.slot >= 0:
+                    commit_slots.append(p.slot)
+                    commit_exp.append(out_exp[s, i])
+                    commit_rm.append(out_removed[s, i])
+                    commit_keys.append(p.key)
+                    self.algo_mirror[s][p.slot] = int(p.req.algorithm)
+                responses[p.pos] = RateLimitResponse(
+                    status=int(out_status[s, i]),
+                    limit=int(out_limit[s, i]) if cached_np[s, i] else int(p.req.limit),
+                    remaining=int(out_rem[s, i]),
+                    reset_time=int(out_reset[s, i]),
+                )
+            self.tables[s].commit(commit_slots, commit_exp, commit_rm, keys=commit_keys)
+
+    # ------------------------------------------------------------------
+    def sync_globals(self, now_ms: int) -> int:
+        """Run one GLOBAL sync collective (the TPU-native stand-in for
+        GlobalSyncWait ticks of all three global.go pipelines).  Returns
+        the number of keys broadcast."""
+        active = self.gtable.active_gslots()
+        if not active and not self.dirty.any():
+            return 0
+
+        # Resolve each GLOBAL key's slot in its owner shard's table.
+        # Assigning one key can evict another's slot under capacity
+        # pressure, so iterate to a fixed point (bounded), then drop any
+        # still-unstable entries from this sync.
+        for _ in range(3):
+            changed = False
+            for g in active:
+                key = self.gtable.key_of(g)
+                o = int(self.gtable.owner_shard[g])
+                slot = self.tables[o].get_slot(key)
+                if slot is None:
+                    slot, _ = self.tables[o].lookup_or_assign(key, now_ms)
+                    changed = True
+                self.gtable.owner_slot[g] = slot
+            if not changed:
+                break
+        for g in active:
+            key = self.gtable.key_of(g)
+            o = int(self.gtable.owner_shard[g])
+            if self.tables[o].get_slot(key) != int(self.gtable.owner_slot[g]):
+                self.gtable.owner_slot[g] = -1
+
+        cfg = global_ops.SyncConfig(
+            owner_slot=jnp.asarray(self.gtable.owner_slot),
+            owner_shard=jnp.asarray(self.gtable.owner_shard),
+            algorithm=jnp.asarray(self.gtable.algorithm),
+            behavior=jnp.asarray(self.gtable.behavior),
+            limit=jnp.asarray(self.gtable.limit),
+            duration=jnp.asarray(self.gtable.duration),
+            greg_expire=jnp.asarray(self.gtable.greg_expire),
+            greg_duration=jnp.asarray(self.gtable.greg_duration),
+        )
+        dirty_dev = jax.device_put(jnp.asarray(self.dirty), self._sharding)
+        self.state, self.gcols, out, applied = self._sync_fn(
+            self.state, self.gcols, cfg, dirty_dev, now_ms
+        )
+
+        out_exp = np.asarray(out.new_expire)
+        out_rm = np.asarray(out.removed)
+        applied_np = np.asarray(applied)[0]
+        self.gtable.rep_expire[:] = np.asarray(self.gcols.rep_expire)[0]
+
+        n_bcast = 0
+        for g in active:
+            slot = int(self.gtable.owner_slot[g])
+            if slot < 0 or not applied_np[g]:
+                continue
+            o = int(self.gtable.owner_shard[g])
+            self.tables[o].commit(
+                [slot], [out_exp[o, g]], [out_rm[o, g]], keys=[self.gtable.key_of(g)]
+            )
+            n_bcast += 1
+        self.dirty[:] = False
+        return n_bcast
+
+    # ------------------------------------------------------------------
+    def size(self) -> int:
+        return sum(len(t) for t in self.tables)

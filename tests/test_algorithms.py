@@ -292,6 +292,42 @@ def test_duplicate_keys_in_one_batch():
     ]
 
 
+def test_eviction_collision_with_reset_does_not_drop_new_key():
+    """Regression: a RESET_REMAINING lane whose slot gets evicted and
+    remapped mid-batch must not delete the new key's mapping when its
+    removed-flag commits (key-guarded commit)."""
+    store = ShardStore(capacity=2)
+    now = T0
+    one(store, mk(name="x", key="A", hits=1, limit=10, duration=9000), now)
+    one(store, mk(name="x", key="B", hits=1, limit=10, duration=9000), now)
+    resps = store.apply(
+        [
+            mk(name="x", key="A", hits=1, limit=10, duration=9000,
+               behavior=Behavior.RESET_REMAINING),
+            mk(name="x", key="B", hits=1, limit=10, duration=9000),
+            mk(name="x", key="C", hits=1, limit=10, duration=9000),  # evicts A's slot
+        ],
+        now,
+    )
+    assert [r.remaining for r in resps] == [10, 8, 9]
+    # C must still be mapped: another hit continues its bucket.
+    r = one(store, mk(name="x", key="C", hits=1, limit=10, duration=9000), now)
+    assert r.remaining == 8
+
+
+def test_padding_lanes_do_not_corrupt_last_slot():
+    """Regression: jax .at[-1] wraps, so padding lanes (slot=-1) used to
+    scatter garbage into the table's last slot."""
+    store = ShardStore(capacity=2)
+    now = T0
+    one(store, mk(name="p", key="K0", hits=1, limit=10, duration=9000), now)
+    one(store, mk(name="p", key="K1", hits=1, limit=10, duration=9000), now)  # slot 1 (last)
+    # Another padded batch touching only K0 must leave K1's bucket intact.
+    one(store, mk(name="p", key="K0", hits=1, limit=10, duration=9000), now)
+    r = one(store, mk(name="p", key="K1", hits=1, limit=10, duration=9000), now)
+    assert r.remaining == 8
+
+
 def test_lru_eviction():
     store = ShardStore(capacity=4)
     now = T0

@@ -1,0 +1,162 @@
+"""GLOBAL behavior: replica caches, device-side hit accumulation, and
+the collective sync program, on the 8-device mesh.
+
+Reference model under test: non-owner answers locally and forwards hits
+async (gubernator.go:231-255, global.go:77-160); owner applies and
+broadcasts authoritative status (global.go:163-243); peers then answer
+from the broadcast cache until it expires (gubernator.go:241-249,
+259-272).  Convergence observed here by stepping `sync_globals()` —
+the in-process equivalent of waiting out GlobalSyncWait ticks as
+TestGlobalRateLimits does by polling metrics (functional_test.go:478-546).
+"""
+
+from gubernator_tpu.parallel.mesh import MeshBucketStore, shard_of_key
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest, Status
+from gubernator_tpu.utils.clock import Clock
+
+T0 = 1_573_430_430_000
+GLOBAL = Behavior.GLOBAL
+
+
+def mk(key, hits=1, limit=10, duration=60_000, behavior=GLOBAL):
+    return RateLimitRequest(
+        name="glob", unique_key=key, hits=hits, limit=limit,
+        duration=duration, algorithm=Algorithm.TOKEN_BUCKET, behavior=behavior,
+    )
+
+
+def owner_and_other(store, key):
+    owner = shard_of_key(f"glob_{key}", store.n_shards)
+    other = (owner + 1) % store.n_shards
+    return owner, other
+
+
+def test_non_owner_answers_locally_then_converges():
+    store = MeshBucketStore(capacity_per_shard=64, g_capacity=32)
+    owner, other = owner_and_other(store, "k1")
+
+    # First hit lands at a non-owner: replica cache is cold, so it
+    # computes as-if-owner locally (gubernator.go:250-254).
+    r = store.apply([mk("k1")], T0, home_shard=other)[0]
+    assert r.status == Status.UNDER_LIMIT and r.remaining == 9
+
+    # Sync: the hit reaches the owner, owner broadcasts.
+    n = store.sync_globals(T0 + 1)
+    assert n == 1
+    assert store.gtable.rep_expire[store.gtable.get("glob_k1")] > T0
+
+    # Now the non-owner answers from the broadcast cache: remaining is
+    # the owner's authoritative value, static until the next broadcast.
+    r = store.apply([mk("k1")], T0 + 2, home_shard=other)[0]
+    assert r.status == Status.UNDER_LIMIT and r.remaining == 9
+    r = store.apply([mk("k1")], T0 + 3, home_shard=other)[0]
+    assert r.remaining == 9  # still the cached value (reference semantics)
+
+    # Those two cached hits converge at the next sync.
+    store.sync_globals(T0 + 4)
+    g = store.gtable.get("glob_k1")
+    assert store.gtable.rep_expire[g] > T0
+    r = store.apply([mk("k1", hits=0)], T0 + 5, home_shard=other)[0]
+    assert r.remaining == 7  # 10 - 1 (pre-sync) - 2 (cached hits)
+
+
+def test_owner_local_hits_broadcast_without_forwarding():
+    store = MeshBucketStore(capacity_per_shard=64, g_capacity=32)
+    owner, other = owner_and_other(store, "k2")
+
+    # Hits at the owner apply directly (gubernator.go:176) and mark the
+    # key dirty for broadcast (QueueUpdate, gubernator.go:339-341).
+    r = store.apply([mk("k2", hits=4)], T0, home_shard=owner)[0]
+    assert r.remaining == 6
+    store.sync_globals(T0 + 1)
+
+    # Another shard answers from the broadcast without ever computing.
+    r = store.apply([mk("k2", hits=1)], T0 + 2, home_shard=other)[0]
+    assert r.remaining == 6  # owner's broadcast value
+
+
+def test_hot_key_skew_converges_across_shards():
+    """BASELINE config 4: GLOBAL hot key hammered from every shard."""
+    store = MeshBucketStore(capacity_per_shard=64, g_capacity=32)
+    owner, _ = owner_and_other(store, "hot")
+    limit = 1000
+    total = 0
+    clock = Clock()
+    clock.freeze(T0)
+
+    # Warm the cache with one owner-side hit + sync.
+    store.apply([mk("hot", hits=1, limit=limit)], clock.now_ms(), home_shard=owner)
+    total += 1
+    store.sync_globals(clock.now_ms())
+
+    # 5 windows of skewed traffic from every shard.
+    for window in range(5):
+        clock.advance(10)
+        for s in range(store.n_shards):
+            if s == owner:
+                continue
+            hits = 7 + (s % 3)
+            r = store.apply(
+                [mk("hot", hits=hits, limit=limit)], clock.now_ms(), home_shard=s
+            )[0]
+            assert r.status == Status.UNDER_LIMIT  # cached answers
+            total += hits
+        clock.advance(10)
+        store.sync_globals(clock.now_ms())
+
+    # The authoritative count must equal the exact sum of all hits.
+    r = store.apply([mk("hot", hits=0, limit=limit)], clock.now_ms(), home_shard=owner)[0]
+    assert r.remaining == limit - total
+
+
+def test_over_limit_propagates_to_replicas():
+    store = MeshBucketStore(capacity_per_shard=64, g_capacity=32)
+    owner, other = owner_and_other(store, "k3")
+
+    store.apply([mk("k3", hits=10, limit=10)], T0, home_shard=owner)
+    store.sync_globals(T0 + 1)
+
+    # The broadcast carries the owner's STICKY status: draining to 0 via
+    # a hits==limit create leaves Status UNDER_LIMIT (algorithms.go:
+    # 147-159 never sets it), so replicas serve UNDER/0 until a hit
+    # actually bounces at the owner.
+    for i in range(3):
+        r = store.apply([mk("k3", hits=1, limit=10)], T0 + 2 + i, home_shard=other)[0]
+        assert r.status == Status.UNDER_LIMIT
+        assert r.remaining == 0
+
+    # Next sync: the 3 forwarded hits bounce (remaining==0 & hits>0 =>
+    # OVER + sticky, algorithms.go:112-117) and OVER propagates.
+    store.sync_globals(T0 + 9)
+    r = store.apply([mk("k3", hits=0, limit=10)], T0 + 10, home_shard=owner)[0]
+    assert r.status == Status.OVER_LIMIT
+    assert r.remaining == 0
+    r = store.apply([mk("k3", hits=1, limit=10)], T0 + 11, home_shard=other)[0]
+    assert r.status == Status.OVER_LIMIT  # replica now serves OVER from cache
+
+
+def test_gslot_eviction_clears_device_rows():
+    """A recycled gslot must never serve the evicted key's broadcast."""
+    store = MeshBucketStore(capacity_per_shard=64, g_capacity=2)
+
+    # Warm e1: broadcast makes its replica rows live (remaining=4).
+    owner1, other1 = owner_and_other(store, "e1")
+    store.apply([mk("e1", hits=6, limit=10)], T0, home_shard=owner1)
+    store.sync_globals(T0 + 1)
+    g_e1 = store.gtable.get("glob_e1")
+    assert store.gtable.rep_expire[g_e1] > T0
+
+    # Two more keys exhaust the 2-entry table; e1 is evicted and its
+    # gslot recycled for e3.
+    for k in ["e2", "e3"]:
+        _, oth = owner_and_other(store, k)
+        store.apply([mk(k)], T0 + 2, home_shard=oth)
+    assert store.gtable.get("glob_e1") is None
+    g_e3 = store.gtable.get("glob_e3")
+    assert g_e3 == g_e1  # recycled
+
+    # e3's non-owner answer above must have computed locally (fresh
+    # bucket: 10-1=9), not served e1's stale broadcast (remaining=4).
+    _, oth3 = owner_and_other(store, "e3")
+    r = store.apply([mk("e3", hits=0)], T0 + 3, home_shard=oth3)[0]
+    assert r.remaining == 9

@@ -1,0 +1,59 @@
+"""Consistent-hash ring tests, incl. the pinned distribution table from
+replicated_hash_test.go:40-86 (same hosts, same 10k synthetic IPs, same
+expected per-host counts for fnv1 and fnv1a)."""
+
+import pytest
+
+from gubernator_tpu.parallel.hash_ring import (
+    DEFAULT_REPLICAS,
+    ReplicatedConsistentHash,
+    fnv1_hash,
+    fnv1a_hash,
+)
+
+HOSTS = ["a.svc.local", "b.svc.local", "c.svc.local"]
+
+
+def test_size_and_membership():
+    ring = ReplicatedConsistentHash()
+    for h in HOSTS:
+        ring.add(h, peer={"addr": h})
+    assert ring.size() == 3
+    assert ring.get_by_peer_id("a.svc.local") == {"addr": "a.svc.local"}
+    assert sorted(ring.peer_ids()) == sorted(HOSTS)
+
+
+def test_empty_ring_raises():
+    ring = ReplicatedConsistentHash()
+    with pytest.raises(RuntimeError, match="pool is empty"):
+        ring.get("x")
+
+
+@pytest.mark.parametrize(
+    "hash_fn,expected",
+    [
+        (None, {"a.svc.local": 2948, "b.svc.local": 3592, "c.svc.local": 3460}),
+        (fnv1a_hash(), {"a.svc.local": 3110, "b.svc.local": 3856, "c.svc.local": 3034}),
+        (fnv1_hash(), {"a.svc.local": 2948, "b.svc.local": 3592, "c.svc.local": 3460}),
+    ],
+    ids=["default", "fnv1a", "fnv1"],
+)
+def test_pinned_distribution(hash_fn, expected):
+    """Exact parity with the reference's pinned table — proves vnode
+    construction, hashing, and ring search all match bit-for-bit."""
+    ring = ReplicatedConsistentHash(hash_fn, DEFAULT_REPLICAS)
+    for h in HOSTS:
+        ring.add(h)
+    keys = [f"192.168.{i >> 8}.{i & 255}" for i in range(10000)]
+    dist = {h: 0 for h in HOSTS}
+    for owner in ring.get_batch(keys):
+        dist[owner] += 1
+    assert dist == expected
+
+
+def test_get_matches_get_batch():
+    ring = ReplicatedConsistentHash()
+    for h in HOSTS:
+        ring.add(h)
+    keys = [f"key_{i}" for i in range(500)]
+    assert ring.get_batch(keys) == [ring.get(k) for k in keys]
