@@ -1,0 +1,100 @@
+"""Client helpers (reference client.go + python/gubernator/__init__.py).
+
+`V1Client` speaks the HTTP/JSON gateway (the reference's
+DialV1Server gRPC channel maps to the same surface).  Includes the
+Python client's `sleep_until_reset` convenience.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import random
+import ssl
+import string
+import time
+from typing import List, Optional
+
+from .types import (
+    GetRateLimitsRequest,
+    GetRateLimitsResponse,
+    HealthCheckResponse,
+    PeerInfo,
+    RateLimitResponse,
+)
+
+# Duration constants in milliseconds (client.go:30-34).
+MILLISECOND = 1
+SECOND = 1000
+MINUTE = 60 * SECOND
+
+
+class V1Client:
+    def __init__(
+        self,
+        endpoint: str = "127.0.0.1:1050",
+        timeout_s: float = 5.0,
+        tls_context: Optional[ssl.SSLContext] = None,
+    ):
+        self.endpoint = endpoint
+        self.timeout_s = timeout_s
+        self.tls_context = tls_context
+
+    def _request(self, method: str, path: str, payload: Optional[dict] = None) -> dict:
+        host, _, port = self.endpoint.partition(":")
+        if self.tls_context is not None:
+            conn = http.client.HTTPSConnection(
+                host, int(port or 443), timeout=self.timeout_s, context=self.tls_context
+            )
+        else:
+            conn = http.client.HTTPConnection(host, int(port or 80), timeout=self.timeout_s)
+        try:
+            body = json.dumps(payload).encode() if payload is not None else None
+            conn.request(
+                method, path, body=body, headers={"Content-Type": "application/json"}
+            )
+            r = conn.getresponse()
+            raw = r.read()
+            data = json.loads(raw) if raw else {}
+            if r.status != 200:
+                raise RuntimeError(
+                    f"{path} returned HTTP {r.status}: {data.get('message', raw[:200])}"
+                )
+            return data
+        finally:
+            conn.close()
+
+    def get_rate_limits(self, req: GetRateLimitsRequest) -> GetRateLimitsResponse:
+        return GetRateLimitsResponse.from_json(
+            self._request("POST", "/v1/GetRateLimits", req.to_json())
+        )
+
+    def health_check(self) -> HealthCheckResponse:
+        return HealthCheckResponse.from_json(self._request("GET", "/v1/HealthCheck"))
+
+    def metrics_text(self) -> str:
+        host, _, port = self.endpoint.partition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=self.timeout_s)
+        try:
+            conn.request("GET", "/metrics")
+            return conn.getresponse().read().decode()
+        finally:
+            conn.close()
+
+
+def sleep_until_reset(rate_limit: RateLimitResponse) -> None:
+    """python/gubernator/__init__.py:12-17."""
+    now = time.time()
+    delta = rate_limit.reset_time / 1000.0 - now
+    if delta > 0:
+        time.sleep(delta)
+
+
+def random_peer(peers: List[PeerInfo]) -> PeerInfo:
+    """client.go:81-86."""
+    return random.choice(peers)
+
+
+def random_string(prefix: str = "", n: int = 10) -> str:
+    """client.go:89-97."""
+    return prefix + "".join(random.choices(string.ascii_lowercase + string.digits, k=n))

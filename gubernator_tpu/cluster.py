@@ -1,0 +1,110 @@
+"""In-process test cluster: N real daemons on loopback ports.
+
+Parity with cluster/cluster.go:82-131: every daemon gets the FULL peer
+list (discovery bypassed), behavior windows are shortened for tests, and
+daemons can be restarted in place.  Supports data-center labels for
+multi-region tests (cluster.DataCenterNone / DataCenterOne).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional
+
+from .config import BehaviorConfig, DaemonConfig
+from .daemon import Daemon
+from .types import PeerInfo
+from .utils.clock import Clock
+
+DATA_CENTER_NONE = ""
+DATA_CENTER_ONE = "datacenter-1"
+
+
+def test_behaviors() -> BehaviorConfig:
+    """Shortened windows (cluster/cluster.go:104-110)."""
+    return BehaviorConfig(
+        global_sync_wait_s=0.05,
+        global_timeout_s=5.0,
+        batch_timeout_s=5.0,
+        multi_region_sync_wait_s=0.05,
+        multi_region_timeout_s=5.0,
+    )
+
+
+class Cluster:
+    def __init__(self):
+        self.daemons: List[Daemon] = []
+        self.peers: List[PeerInfo] = []
+
+    def start(self, n: int, clock: Optional[Clock] = None) -> "Cluster":
+        return self.start_with([DATA_CENTER_NONE] * n, clock=clock)
+
+    def start_with(
+        self,
+        data_centers: List[str],
+        clock: Optional[Clock] = None,
+        cache_size: int = 4096,
+        g_capacity: int = 256,
+    ) -> "Cluster":
+        """cluster/cluster.go:96-131: spawn every daemon, then feed the
+        full converged peer list to all of them."""
+        for dc in data_centers:
+            conf = DaemonConfig(
+                listen_address="127.0.0.1:0",
+                cache_size=cache_size,
+                global_cache_size=g_capacity,
+                data_center=dc,
+                behaviors=test_behaviors(),
+                peer_discovery_type="static",
+            )
+            d = Daemon(conf, clock=clock).start()
+            self.daemons.append(d)
+        self.peers = [d.peer_info for d in self.daemons]
+        for d in self.daemons:
+            d.set_peers(self.peers)
+        return self
+
+    # ------------------------------------------------------------------
+    def peer_at(self, idx: int) -> PeerInfo:
+        return self.peers[idx]
+
+    def daemon_at(self, idx: int) -> Daemon:
+        return self.daemons[idx]
+
+    def get_random_peer(self, data_center: str = DATA_CENTER_NONE) -> PeerInfo:
+        """cluster/cluster.go:40-54."""
+        candidates = [p for p in self.peers if p.data_center == data_center]
+        if not candidates:
+            raise RuntimeError(f"no peers in data center '{data_center}'")
+        return random.choice(candidates)
+
+    def daemon_for(self, peer: PeerInfo) -> Daemon:
+        for d in self.daemons:
+            if d.peer_info.grpc_address == peer.grpc_address:
+                return d
+        raise KeyError(peer.grpc_address)
+
+    def restart(self, idx: int, clock: Optional[Clock] = None) -> None:
+        """cluster/cluster.go:87-93: close and respawn at the same address."""
+        old = self.daemons[idx]
+        addr = old.peer_info.grpc_address
+        old.close()
+        conf = DaemonConfig(
+            listen_address=addr,
+            cache_size=old.conf.cache_size,
+            global_cache_size=old.conf.global_cache_size,
+            data_center=old.conf.data_center,
+            behaviors=old.conf.behaviors,
+            peer_discovery_type="static",
+        )
+        d = Daemon(conf, clock=clock or old.clock).start()
+        self.daemons[idx] = d
+        self.peers[idx] = d.peer_info
+        for dm in self.daemons:
+            dm.set_peers(self.peers)
+
+    def stop(self) -> None:
+        for d in self.daemons:
+            d.close()
+        self.daemons = []
+        self.peers = []

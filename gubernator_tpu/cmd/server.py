@@ -1,0 +1,37 @@
+"""Server binary (reference cmd/gubernator/main.go): flags -> daemon."""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="gubernator-tpu rate-limit daemon")
+    parser.add_argument("-config", dest="config", default="", help="env config file")
+    parser.add_argument("-debug", dest="debug", action="store_true", help="debug logging")
+    args = parser.parse_args(argv)
+
+    from ..config import setup_daemon_config
+    from ..daemon import spawn_daemon
+
+    conf = setup_daemon_config(config_file=args.config)
+    if args.debug:
+        conf.debug = True
+    daemon = spawn_daemon(conf)
+    addr = daemon.gateway.address
+    print(f"gubernator-tpu listening on http://{addr} (advertise {daemon.peer_info.grpc_address})")
+    sys.stdout.flush()
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    daemon.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

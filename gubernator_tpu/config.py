@@ -1,0 +1,184 @@
+"""Configuration: behavior knobs, service/daemon config, GUBER_* env parsing.
+
+Mirrors config.go: `BehaviorConfig` (config.go:42-63) with the same
+defaults (BatchTimeout 500ms, BatchWait 500us, BatchLimit 1000, and the
+GLOBAL/multi-region equivalents, config.go:106-133), `DaemonConfig`
+(config.go:155-202), and `setup_daemon_config` env handling
+(config.go:220-388): env-file lines -> GUBER_* environment -> defaults.
+
+Divergence: the default GLOBAL/multi-region sync window is 100ms instead
+of the reference's 500us — each sync here is a device collective whose
+dispatch cost wants amortizing; tests and deployments tune it down
+exactly like the reference's own test harness does
+(cluster/cluster.go:104-110 uses 50ms).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .types import PeerInfo
+
+MAX_BATCH_SIZE = 1000  # gubernator.go:36
+
+
+@dataclass
+class BehaviorConfig:
+    """config.go:42-63 (durations in seconds)."""
+
+    batch_timeout_s: float = 0.5
+    batch_wait_s: float = 0.0005
+    batch_limit: int = 1000
+
+    global_timeout_s: float = 0.5
+    global_sync_wait_s: float = 0.1
+    global_batch_limit: int = 1000
+
+    multi_region_timeout_s: float = 0.5
+    multi_region_sync_wait_s: float = 0.1
+    multi_region_batch_limit: int = 1000
+
+
+@dataclass
+class DaemonConfig:
+    """config.go:155-202 equivalent for the HTTP/JSON daemon."""
+
+    listen_address: str = "127.0.0.1:1050"
+    advertise_address: str = ""
+    cache_size: int = 50_000
+    global_cache_size: int = 4096
+    data_center: str = ""
+    behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
+    # Static peer list (the zero-dependency discovery mode; etcd/
+    # memberlist/k8s plug in via gubernator_tpu.peers).
+    peers: List[PeerInfo] = field(default_factory=list)
+    peer_discovery_type: str = "static"  # static | file | etcd | member-list | k8s
+    peers_file: str = ""
+    store: object = None
+    loader: object = None
+    debug: bool = False
+    # TLS (reference tls.go); served by the gateway when set.
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
+    tls_ca_file: str = ""
+    client_auth: str = ""  # "", "request", "require-and-verify"
+    devices: Optional[list] = None  # jax devices for the mesh (None = all)
+
+    def resolved_advertise(self) -> str:
+        return self.advertise_address or self.listen_address
+
+
+def _env_int(env: Dict[str, str], name: str, default: int) -> int:
+    v = env.get(name, "")
+    return int(v) if v else default
+
+
+def _env_float_ms(env: Dict[str, str], name: str, default_s: float) -> float:
+    """GUBER durations are Go duration strings in the reference; we accept
+    plain milliseconds or '<x>ms'/'<x>s' suffixes."""
+    v = env.get(name, "")
+    if not v:
+        return default_s
+    v = v.strip()
+    if v.endswith("ms"):
+        return float(v[:-2]) / 1000.0
+    if v.endswith("us") or v.endswith("µs"):
+        return float(v[:-2]) / 1_000_000.0
+    if v.endswith("s"):
+        return float(v[:-1])
+    return float(v) / 1000.0
+
+
+def from_env_file(path: str) -> Dict[str, str]:
+    """KEY=VALUE lines -> dict (config.go:493-521); '#' comments skipped."""
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" not in line:
+                raise ValueError(f"malformed line in env file: '{line}'")
+            k, _, v = line.partition("=")
+            out[k.strip()] = v.strip()
+    return out
+
+
+def setup_daemon_config(
+    config_file: str = "", env: Optional[Dict[str, str]] = None
+) -> DaemonConfig:
+    """Env-file -> GUBER_* env vars -> defaults (config.go:220-388)."""
+    merged: Dict[str, str] = {}
+    if config_file:
+        merged.update(from_env_file(config_file))
+    merged.update({k: v for k, v in (env or os.environ).items() if k.startswith("GUBER_")})
+
+    conf = DaemonConfig()
+    # The reference listens gRPC on GUBER_GRPC_ADDRESS and HTTP on
+    # GUBER_HTTP_ADDRESS; this daemon serves one HTTP/JSON port, so
+    # GUBER_HTTP_ADDRESS wins and GRPC_ADDRESS is accepted as an alias.
+    conf.listen_address = (
+        merged.get("GUBER_HTTP_ADDRESS")
+        or merged.get("GUBER_GRPC_ADDRESS")
+        or conf.listen_address
+    )
+    conf.advertise_address = merged.get(
+        "GUBER_ADVERTISE_ADDRESS", merged.get("GUBER_GRPC_ADVERTISE_ADDRESS", "")
+    )
+    conf.cache_size = _env_int(merged, "GUBER_CACHE_SIZE", conf.cache_size)
+    conf.global_cache_size = _env_int(
+        merged, "GUBER_GLOBAL_CACHE_SIZE", conf.global_cache_size
+    )
+    conf.data_center = merged.get("GUBER_DATA_CENTER", "")
+    conf.debug = merged.get("GUBER_DEBUG", "").lower() in ("true", "1", "yes")
+    conf.peer_discovery_type = merged.get("GUBER_PEER_DISCOVERY_TYPE", "static")
+    if conf.peer_discovery_type not in ("static", "file", "etcd", "member-list", "k8s"):
+        raise ValueError(
+            f"GUBER_PEER_DISCOVERY_TYPE is invalid; expected 'static', 'file', "
+            f"'etcd', 'member-list' or 'k8s' got '{conf.peer_discovery_type}'"
+        )
+    conf.peers_file = merged.get("GUBER_PEERS_FILE", "")
+
+    b = conf.behaviors
+    b.batch_timeout_s = _env_float_ms(merged, "GUBER_BATCH_TIMEOUT", b.batch_timeout_s)
+    b.batch_wait_s = _env_float_ms(merged, "GUBER_BATCH_WAIT", b.batch_wait_s)
+    b.batch_limit = _env_int(merged, "GUBER_BATCH_LIMIT", b.batch_limit)
+    if b.batch_limit > MAX_BATCH_SIZE:
+        raise ValueError(f"GUBER_BATCH_LIMIT cannot exceed '{MAX_BATCH_SIZE}'")
+    b.global_timeout_s = _env_float_ms(merged, "GUBER_GLOBAL_TIMEOUT", b.global_timeout_s)
+    b.global_sync_wait_s = _env_float_ms(
+        merged, "GUBER_GLOBAL_SYNC_WAIT", b.global_sync_wait_s
+    )
+    b.global_batch_limit = _env_int(
+        merged, "GUBER_GLOBAL_BATCH_LIMIT", b.global_batch_limit
+    )
+    if b.global_batch_limit > MAX_BATCH_SIZE:
+        raise ValueError(f"GUBER_GLOBAL_BATCH_LIMIT cannot exceed '{MAX_BATCH_SIZE}'")
+    b.multi_region_timeout_s = _env_float_ms(
+        merged, "GUBER_MULTI_REGION_TIMEOUT", b.multi_region_timeout_s
+    )
+    b.multi_region_sync_wait_s = _env_float_ms(
+        merged, "GUBER_MULTI_REGION_SYNC_WAIT", b.multi_region_sync_wait_s
+    )
+    b.multi_region_batch_limit = _env_int(
+        merged, "GUBER_MULTI_REGION_BATCH_LIMIT", b.multi_region_batch_limit
+    )
+
+    # Static peers: GUBER_STATIC_PEERS=addr1,addr2 (our addition for the
+    # zero-dependency mode; the reference's equivalent is the member-list
+    # seed GUBER_MEMBERLIST_KNOWN_NODES).
+    static = merged.get("GUBER_STATIC_PEERS", "")
+    if static:
+        conf.peers = [
+            PeerInfo(grpc_address=a.strip(), http_address=a.strip())
+            for a in static.split(",")
+            if a.strip()
+        ]
+
+    conf.tls_cert_file = merged.get("GUBER_TLS_CERT", "")
+    conf.tls_key_file = merged.get("GUBER_TLS_KEY", "")
+    conf.tls_ca_file = merged.get("GUBER_TLS_CA", "")
+    conf.client_auth = merged.get("GUBER_TLS_CLIENT_AUTH", "")
+    return conf

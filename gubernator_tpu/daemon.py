@@ -1,0 +1,149 @@
+"""Daemon — process assembly (reference daemon.go).
+
+Builds the mesh store + metrics + V1Service, serves the HTTP/JSON
+gateway (client API, peer data plane, /metrics), wires peer discovery,
+and handles graceful shutdown with Loader save.  `set_peers` stamps
+IsOwner by advertise-address compare exactly like daemon.go:277-287.
+"""
+
+from __future__ import annotations
+
+import socket
+import ssl
+import threading
+import time
+from typing import List, Optional, Sequence
+
+from .config import DaemonConfig
+from .gateway import GatewayServer
+from .metrics import Metrics
+from .service import ServiceConfig, V1Service
+from .types import PeerInfo
+from .utils.clock import Clock, DEFAULT_CLOCK
+
+
+class Daemon:
+    def __init__(self, conf: DaemonConfig, clock: Optional[Clock] = None):
+        self.conf = conf
+        self.clock = clock or DEFAULT_CLOCK
+        self.service: Optional[V1Service] = None
+        self.gateway: Optional[GatewayServer] = None
+        self._pool = None
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    def start(self) -> "Daemon":
+        """daemon.go:72-251."""
+        server_tls, _ = _build_tls(self.conf)
+        metrics = Metrics()
+        svc_conf = ServiceConfig(
+            cache_size=self.conf.cache_size,
+            global_cache_size=self.conf.global_cache_size,
+            behaviors=self.conf.behaviors,
+            data_center=self.conf.data_center,
+            persist_store=self.conf.store,
+            loader=self.conf.loader,
+            clock=self.clock,
+            metrics=metrics,
+            devices=self.conf.devices,
+        )
+        self.service = V1Service(svc_conf)
+        self.gateway = GatewayServer(
+            self.service, self.conf.listen_address, tls_context=server_tls
+        )
+        self.gateway.start()
+        # Port 0 resolves at bind time; advertise the real address.
+        self.service.conf.advertise_address = (
+            self.conf.advertise_address or self.gateway.address
+        )
+
+        if self.conf.peer_discovery_type == "static":
+            # A static daemon with no peer list serves standalone: it is
+            # its own (sole) owner for every key.
+            self.set_peers(self.conf.peers or [self.peer_info])
+        elif self.conf.peer_discovery_type == "file":
+            from .peers import FilePool
+
+            self._pool = FilePool(self.conf.peers_file, on_update=self.set_peers)
+        elif self.conf.peer_discovery_type in ("etcd", "member-list", "k8s"):
+            from .peers import make_pool
+
+            self._pool = make_pool(
+                self.conf.peer_discovery_type, self.conf, on_update=self.set_peers
+            )
+        self.wait_for_connect()
+        return self
+
+    # ------------------------------------------------------------------
+    @property
+    def peer_info(self) -> PeerInfo:
+        addr = self.service.conf.advertise_address
+        return PeerInfo(
+            grpc_address=addr, http_address=addr, data_center=self.conf.data_center
+        )
+
+    def set_peers(self, peers: Sequence[PeerInfo]) -> None:
+        """Stamp IsOwner by address compare, then hand to the service
+        (daemon.go:277-287)."""
+        mine = self.service.conf.advertise_address
+        stamped = []
+        for p in peers:
+            q = PeerInfo(
+                grpc_address=p.grpc_address,
+                http_address=p.http_address or p.grpc_address,
+                data_center=p.data_center,
+                is_owner=(p.grpc_address == mine or p.http_address == mine),
+            )
+            stamped.append(q)
+        self.service.set_peers(stamped)
+
+    # ------------------------------------------------------------------
+    def wait_for_connect(self, timeout_s: float = 10.0) -> None:
+        """Block until the gateway socket accepts (daemon.go:305-344)."""
+        host, _, port = self.gateway.address.partition(":")
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                with socket.create_connection((host, int(port)), timeout=0.5):
+                    return
+            except OSError:
+                time.sleep(0.05)
+        raise TimeoutError(f"gateway at {self.gateway.address} never became reachable")
+
+    # ------------------------------------------------------------------
+    def close(self) -> None:
+        """daemon.go:254-274 (Loader save happens in service.close)."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._pool is not None:
+            self._pool.close()
+        if self.service is not None:
+            self.service.close()
+        if self.gateway is not None:
+            self.gateway.close()
+
+
+def spawn_daemon(conf: DaemonConfig, clock: Optional[Clock] = None) -> Daemon:
+    """daemon.go:59-70."""
+    return Daemon(conf, clock=clock).start()
+
+
+def _build_tls(conf: DaemonConfig):
+    """Assemble server/client ssl contexts from DaemonConfig (tls.go
+    equivalent).  Returns (server_ctx, client_ctx); (None, None) when TLS
+    is not configured."""
+    if not conf.tls_cert_file:
+        return None, None
+    server = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    server.load_cert_chain(conf.tls_cert_file, conf.tls_key_file)
+    if conf.tls_ca_file:
+        server.load_verify_locations(conf.tls_ca_file)
+    if conf.client_auth == "require-and-verify":
+        server.verify_mode = ssl.CERT_REQUIRED
+    elif conf.client_auth == "request":
+        server.verify_mode = ssl.CERT_OPTIONAL
+    client = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    if conf.tls_ca_file:
+        client.load_verify_locations(conf.tls_ca_file)
+    return server, client

@@ -1,0 +1,154 @@
+"""HTTP/JSON gateway — the client-facing edge.
+
+Parity with the reference's grpc-gateway mux + metrics endpoint
+(daemon.go:194-239): POST /v1/GetRateLimits, GET /v1/HealthCheck,
+GET /metrics, plus the peer data plane (PeersV1) as
+POST /v1/peer.GetPeerRateLimits and POST /v1/peer.UpdatePeerGlobals.
+Errors render grpc-gateway style: {"code": N, "message": "..."}.
+TLS (including mTLS client auth) wraps the listener when configured
+(tls.go:118-263 equivalent via ssl.SSLContext).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .service import ApiError, V1Service
+from .types import GetRateLimitsRequest, UpdatePeerGlobal
+
+_GRPC_CODES = {"InvalidArgument": 3, "OutOfRange": 11, "Internal": 13}
+
+
+class GatewayServer:
+    def __init__(
+        self,
+        service: V1Service,
+        listen_address: str = "127.0.0.1:0",
+        tls_context: Optional[ssl.SSLContext] = None,
+    ):
+        self.service = service
+        host, _, port = listen_address.partition(":")
+        handler = _make_handler(service)
+        self.httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port or 0)), handler)
+        self.httpd.daemon_threads = True
+        if tls_context is not None:
+            self.httpd.socket = tls_context.wrap_socket(self.httpd.socket, server_side=True)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+def _make_handler(service: V1Service):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # noqa: N802 — silence stdlib logging
+            pass
+
+        def _send_json(self, status: int, payload) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_bytes(self, status: int, content_type: str, body: bytes) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _refuse_if_closed(self) -> bool:
+            """A closed daemon must refuse — keep-alive handler threads
+            outlive server shutdown, but the reference's gRPC server
+            kills streams on Close (daemon.go:254-274)."""
+            if getattr(service, "_closed", False):
+                self.close_connection = True
+                try:
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                return True
+            return False
+
+        def _read_json(self) -> dict:
+            length = int(self.headers.get("Content-Length", "0"))
+            raw = self.rfile.read(length) if length else b""
+            if not raw:
+                return {}
+            return json.loads(raw)
+
+        def do_GET(self):  # noqa: N802
+            if self._refuse_if_closed():
+                return
+            try:
+                if self.path == "/v1/HealthCheck":
+                    self._send_json(200, service.health_check().to_json())
+                elif self.path == "/metrics":
+                    self._send_bytes(
+                        200, "text/plain; version=0.0.4", service.metrics.render()
+                    )
+                else:
+                    self._send_json(
+                        404, {"code": 5, "message": f"no handler for {self.path}"}
+                    )
+            except Exception as e:  # noqa: BLE001
+                self._send_json(500, {"code": 13, "message": str(e)})
+
+        def do_POST(self):  # noqa: N802
+            if self._refuse_if_closed():
+                return
+            try:
+                body = self._read_json()
+                if self.path == "/v1/GetRateLimits":
+                    req = GetRateLimitsRequest.from_json(body)
+                    resp = service.get_rate_limits(req)
+                    self._send_json(200, resp.to_json())
+                elif self.path == "/v1/peer.GetPeerRateLimits":
+                    req = GetRateLimitsRequest.from_json(body)
+                    resp = service.get_peer_rate_limits(req)
+                    # PeersV1 response field is rate_limits (peers.proto:42-45).
+                    self._send_json(
+                        200, {"rateLimits": [r.to_json() for r in resp.responses]}
+                    )
+                elif self.path == "/v1/peer.UpdatePeerGlobals":
+                    updates = [
+                        UpdatePeerGlobal.from_json(u) for u in body.get("globals", [])
+                    ]
+                    service.update_peer_globals(updates)
+                    self._send_json(200, {})
+                else:
+                    self._send_json(
+                        404, {"code": 5, "message": f"no handler for {self.path}"}
+                    )
+            except ApiError as e:
+                self._send_json(
+                    e.http_status,
+                    {"code": _GRPC_CODES.get(e.code, 2), "message": e.message},
+                )
+            except json.JSONDecodeError as e:
+                self._send_json(400, {"code": 3, "message": f"invalid JSON: {e}"})
+            except Exception as e:  # noqa: BLE001
+                self._send_json(500, {"code": 13, "message": str(e)})
+
+    return Handler

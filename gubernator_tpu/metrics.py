@@ -1,0 +1,69 @@
+"""Prometheus metrics with reference name parity.
+
+Metric names match the reference exactly so dashboards/alerts port
+unchanged: gubernator_cache_size + gubernator_cache_access_count
+(cache.go:88-92,205-218), gubernator_grpc_request_counts +
+gubernator_grpc_request_duration (grpc_stats.go:45-59),
+gubernator_async_durations + gubernator_broadcast_durations
+(global.go:40-56).
+"""
+
+from __future__ import annotations
+
+from prometheus_client import CollectorRegistry, Counter, Gauge, Summary, generate_latest
+
+
+class Metrics:
+    def __init__(self):
+        self.registry = CollectorRegistry()
+        self.cache_size = Gauge(
+            "gubernator_cache_size",
+            "The number of items in LRU Cache which holds the rate limits.",
+            registry=self.registry,
+        )
+        self.cache_access_count = Counter(
+            "gubernator_cache_access_count",
+            "Cache access counts.",
+            ["type"],
+            registry=self.registry,
+        )
+        self.request_counts = Counter(
+            "gubernator_grpc_request_counts",
+            "The count of gRPC requests.",
+            ["status", "method"],
+            registry=self.registry,
+        )
+        self.request_duration = Summary(
+            "gubernator_grpc_request_duration",
+            "The timings of gRPC requests in seconds.",
+            ["method"],
+            registry=self.registry,
+        )
+        self.async_durations = Summary(
+            "gubernator_async_durations",
+            "The duration of GLOBAL async sends in seconds.",
+            registry=self.registry,
+        )
+        self.broadcast_durations = Summary(
+            "gubernator_broadcast_durations",
+            "The duration of GLOBAL broadcasts to peers in seconds.",
+            registry=self.registry,
+        )
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+    def observe_cache(self, store) -> None:
+        """Refresh cache gauges from a ShardStore/MeshBucketStore."""
+        self.cache_size.set(store.size())
+        tables = getattr(store, "tables", None) or [store.table]
+        hits = sum(t.hits for t in tables)
+        misses = sum(t.misses for t in tables)
+        # Counters are monotonic: set via inc of the delta.
+        self._bump(self.cache_access_count.labels(type="hit"), hits)
+        self._bump(self.cache_access_count.labels(type="miss"), misses)
+
+    def _bump(self, counter, absolute: float) -> None:
+        current = counter._value.get()  # noqa: SLF001
+        if absolute > current:
+            counter.inc(absolute - current)

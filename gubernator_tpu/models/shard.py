@@ -13,6 +13,7 @@ reference's mutex serialization (gubernator.go:336-337).
 from __future__ import annotations
 
 import datetime as _dt
+import threading
 from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -22,6 +23,7 @@ import numpy as np
 
 from ..ops import buckets
 from ..types import (
+    Algorithm,
     Behavior,
     RateLimitRequest,
     RateLimitResponse,
@@ -115,10 +117,19 @@ class RoundPlanner:
     sequential evict-then-create semantics.
     """
 
-    def __init__(self, table: SlotTable, prepared: Sequence[_Prepared], now_ms: int):
+    def __init__(
+        self,
+        table: SlotTable,
+        prepared: Sequence[_Prepared],
+        now_ms: int,
+        resolver=None,
+    ):
         self.table = table
         self.queue = deque(prepared)
         self.now_ms = now_ms
+        # Pluggable (slot, exists) resolution — the Store SPI path wraps
+        # the table lookup with store.get / remove side effects.
+        self.resolver = resolver or (lambda p: table.lookup_or_assign(p.key, now_ms))
 
     def next_chunk(self) -> List[_Prepared]:
         cur: List[_Prepared] = []
@@ -137,7 +148,7 @@ class RoundPlanner:
             if p.key in seen_keys:
                 break  # duplicate key: must see this round's commit first
             if not p.resolved:
-                p.slot, p.exists = self.table.lookup_or_assign(p.key, self.now_ms)
+                p.slot, p.exists = self.resolver(p)
                 p.resolved = True
             if p.slot in used_slots:
                 break  # eviction collision: run next round as-is
@@ -173,12 +184,26 @@ def build_round_arrays(chunk: Sequence[_Prepared], padded: int) -> Tuple[np.ndar
 
 
 class ShardStore:
-    """Bucket table for one shard, pinned to (at most) one device."""
+    """Bucket table for one shard, pinned to (at most) one device.
 
-    def __init__(self, capacity: int = 50_000, device: Optional[jax.Device] = None):
+    `store` is the optional persistence SPI (gubernator_tpu.store.Store):
+    get() fulfills misses, on_change() observes every applied request,
+    remove() fires on explicit removals — the call pattern of
+    algorithms.go:26-33,64-68,176-177.
+    """
+
+    def __init__(
+        self,
+        capacity: int = 50_000,
+        device: Optional[jax.Device] = None,
+        store=None,
+    ):
         self.capacity = capacity
         self.table = SlotTable(capacity)
         self.device = device
+        self.store = store
+        # Serializes buffer-donating mutators for multi-threaded callers.
+        self._lock = threading.RLock()
         state = buckets.init_state(capacity)
         if device is not None:
             state = jax.device_put(state, device)
@@ -191,15 +216,55 @@ class ShardStore:
         self, requests: Sequence[RateLimitRequest], now_ms: int
     ) -> List[RateLimitResponse]:
         """Evaluate a batch; responses come back in request order."""
+        with self._lock:
+            return self._apply_locked(requests, now_ms)
+
+    def _apply_locked(self, requests, now_ms):
         responses: List[Optional[RateLimitResponse]] = [None] * len(requests)
         prepared = prepare_requests(requests, now_ms, responses)
-        planner = RoundPlanner(self.table, prepared, now_ms)
+        resolver = self._store_resolver(now_ms) if self.store is not None else None
+        planner = RoundPlanner(self.table, prepared, now_ms, resolver=resolver)
         while True:
             chunk = planner.next_chunk()
             if not chunk:
                 break
             self._run_round(chunk, now_ms, responses)
         return [r if r is not None else RateLimitResponse() for r in responses]
+
+    # ------------------------------------------------------------------
+    # Store SPI integration
+    # ------------------------------------------------------------------
+    def _store_resolver(self, now_ms: int):
+        return make_store_resolver(
+            self.table, self.algo_mirror, self.store, self._inject, now_ms
+        )
+
+    def _inject(self, slot: int, item) -> None:
+        """Write one CacheItem into the device row + host mirrors."""
+        rows = item_to_rows(item)
+        self.algo_mirror[slot] = int(rows.algo[0])
+        self.state = buckets.write_rows(self.state, np.array([slot], np.int32), rows)
+        self.table.expire_ms[slot] = item.expire_at
+
+    def load_item(self, item) -> None:
+        """Loader.Load path: place one persisted item (gubernator.go:78-90)."""
+        with self._lock:
+            slot, _ = self.table.lookup_or_assign(item.key, 0)
+            self._inject(slot, item)
+
+    def snapshot_items(self):
+        """Loader.Save path: every mapped slot as a CacheItem
+        (gubernator.go:93-111); materialized under the lock so apply()
+        cannot swap buffers mid-snapshot."""
+        with self._lock:
+            keys = self.table.keys()
+            if not keys:
+                return []
+            slots = [self.table.get_slot(k) for k in keys]
+            rows = buckets.read_rows(self.state, np.asarray(slots, np.int32))
+            return _rows_to_items(keys, rows)
+
+
 
     # ------------------------------------------------------------------
     def _run_round(
@@ -231,7 +296,124 @@ class ShardStore:
                 remaining=int(out_rem[i]),
                 reset_time=int(out_reset[i]),
             )
+        if self.store is not None:
+            self._fire_store_callbacks(chunk, out_removed)
+
+    # ------------------------------------------------------------------
+    def _fire_store_callbacks(self, chunk, out_removed) -> None:
+        """Post-round Store calls: remove for freed slots
+        (algorithms.go:38-40), on_change with the post-apply item for
+        everything else (the deferred s.OnChange, algorithms.go:64-68)."""
+        live = [(i, p) for i, p in enumerate(chunk) if not out_removed[i]]
+        for i, p in enumerate(chunk):
+            if out_removed[i]:
+                self.store.remove(p.key)
+        if not live:
+            return
+        rows = buckets.read_rows(
+            self.state, np.asarray([p.slot for _, p in live], np.int32)
+        )
+        items = _rows_to_items([p.key for _, p in live], rows)
+        for (_, p), item in zip(live, items):
+            self.store.on_change(p.req, item)
 
     # ------------------------------------------------------------------
     def size(self) -> int:
         return len(self.table)
+
+
+def make_store_resolver(table, algo_mirror, store, inject_fn, now_ms: int):
+    """Slot resolution wrapped with the reference's Store call pattern:
+    cache miss -> store.get -> inject (algorithms.go:26-33); cached item
+    with switched algorithm -> store.remove + re-get
+    (algorithms.go:54-62,196-204).  Shared by ShardStore and
+    MeshBucketStore (per-shard tables, one store)."""
+
+    def resolve(p):
+        slot, exists = table.lookup_or_assign(p.key, now_ms)
+        req = p.req
+        if exists and algo_mirror[slot] != int(req.algorithm):
+            # Algorithm switch: reference removes from cache AND store,
+            # then re-reads the store on the retry pass.
+            store.remove(p.key)
+            item, ok = store.get(req)
+            if ok and item is not None and int(item.algorithm) == int(req.algorithm):
+                inject_fn(slot, item)
+                return slot, True
+            return slot, False
+        if not exists:
+            item, ok = store.get(req)
+            if ok and item is not None and int(item.algorithm) != int(req.algorithm):
+                # c.Add + failed type-cast -> remove both + re-get.
+                store.remove(p.key)
+                item, ok = store.get(req)
+            if ok and item is not None:
+                inject_fn(slot, item)
+                # Note: an already-expired store item is recreated by the
+                # kernel's expiry check rather than resurrected
+                # (divergence: the reference trusts store items without
+                # re-checking ExpireAt for one request).
+                return slot, True
+        return slot, exists
+
+    return resolve
+
+
+def item_to_rows(item) -> "buckets.BucketState":
+    """Convert one SPI CacheItem to a single-row BucketState."""
+    from ..store import LeakyBucketItem
+
+    v = item.value
+    if isinstance(v, LeakyBucketItem):
+        return buckets.BucketState(
+            algo=np.array([int(Algorithm.LEAKY_BUCKET)], np.int32),
+            limit=np.array([v.limit], np.int64),
+            remaining=np.array([int(v.remaining * buckets.LEAKY_SCALE)], np.int64),
+            duration=np.array([v.duration], np.int64),
+            stamp=np.array([v.updated_at], np.int64),
+            expire_at=np.array([item.expire_at], np.int64),
+            status=np.array([0], np.int32),
+        )
+    return buckets.BucketState(
+        algo=np.array([int(Algorithm.TOKEN_BUCKET)], np.int32),
+        limit=np.array([v.limit], np.int64),
+        remaining=np.array([v.remaining], np.int64),
+        duration=np.array([v.duration], np.int64),
+        stamp=np.array([v.created_at], np.int64),
+        expire_at=np.array([item.expire_at], np.int64),
+        status=np.array([int(v.status)], np.int32),
+    )
+
+
+def _rows_to_items(keys, rows):
+    """Convert gathered device rows to SPI CacheItems (store.go:11-24)."""
+    from ..store import CacheItem, LeakyBucketItem, TokenBucketItem
+
+    algo = np.asarray(rows.algo)
+    limit = np.asarray(rows.limit)
+    remaining = np.asarray(rows.remaining)
+    duration = np.asarray(rows.duration)
+    stamp = np.asarray(rows.stamp)
+    expire = np.asarray(rows.expire_at)
+    status = np.asarray(rows.status)
+    items = []
+    for i, key in enumerate(keys):
+        if algo[i] == int(Algorithm.LEAKY_BUCKET):
+            value = LeakyBucketItem(
+                limit=int(limit[i]),
+                duration=int(duration[i]),
+                remaining=remaining[i] / buckets.LEAKY_SCALE,
+                updated_at=int(stamp[i]),
+            )
+        else:
+            value = TokenBucketItem(
+                limit=int(limit[i]),
+                duration=int(duration[i]),
+                remaining=int(remaining[i]),
+                created_at=int(stamp[i]),
+                status=int(status[i]),
+            )
+        items.append(
+            CacheItem(algorithm=int(algo[i]), key=key, value=value, expire_at=int(expire[i]))
+        )
+    return items

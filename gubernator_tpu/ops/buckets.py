@@ -59,6 +59,7 @@ selects them when the DURATION_IS_GREGORIAN bit is set.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -386,3 +387,24 @@ def apply_batch(state: BucketState, req: RequestBatch, now_ms) -> "tuple[BucketS
 
 
 apply_batch_jit = jax.jit(apply_batch, donate_argnums=0)
+
+
+@jax.jit
+def read_rows(state: BucketState, slots) -> BucketState:
+    """Gather full bucket rows for the given slots (host-bound: Store
+    OnChange callbacks and Loader snapshots need the item state the way
+    the reference passes CacheItems, store.go:29-45)."""
+    s = jnp.asarray(slots, _I32)
+    return BucketState(*[col[s] for col in state])
+
+
+@partial(jax.jit, donate_argnums=0)
+def write_rows(state: BucketState, slots, rows: BucketState) -> BucketState:
+    """Scatter full bucket rows (Store.Get results / Loader.Load items).
+    Negative slots are mapped out of bounds and dropped."""
+    C = state.limit.shape[0]
+    s = jnp.asarray(slots, _I32)
+    s = jnp.where(s >= 0, s, C)
+    return BucketState(
+        *[col.at[s].set(val, mode="drop") for col, val in zip(state, rows)]
+    )

@@ -115,6 +115,24 @@ def clear_gslots(gcols: GlobalColumns, gslots) -> GlobalColumns:
     )
 
 
+def set_replica(gcols: GlobalColumns, gslots, status, limit, remaining, reset) -> GlobalColumns:
+    """Write owner-broadcast statuses into replica rows — the receive
+    side of UpdatePeerGlobals (gubernator.go:259-272): the cache item is
+    the resp, keyed by HashKey, expiring at ResetTime."""
+    G = gcols.rep_status.shape[0]
+    idx = jnp.asarray(gslots, _I32)
+    idx = jnp.where(idx >= 0, idx, G)  # drop invalid (negative wraps!)
+    drop = dict(mode="drop")
+    return GlobalColumns(
+        rep_status=gcols.rep_status.at[idx].set(jnp.asarray(status, _I32), **drop),
+        rep_limit=gcols.rep_limit.at[idx].set(jnp.asarray(limit, _I64), **drop),
+        rep_remaining=gcols.rep_remaining.at[idx].set(jnp.asarray(remaining, _I64), **drop),
+        rep_reset=gcols.rep_reset.at[idx].set(jnp.asarray(reset, _I64), **drop),
+        rep_expire=gcols.rep_expire.at[idx].set(jnp.asarray(reset, _I64), **drop),
+        ghits=gcols.ghits,
+    )
+
+
 def init_global_columns(g_capacity: int) -> GlobalColumns:
     z64 = jnp.zeros((g_capacity,), _I64)
     return GlobalColumns(
@@ -234,4 +252,8 @@ def global_sync(
         rep_expire=jnp.where(applied, b_reset, gcols.rep_expire),
         ghits=jnp.zeros_like(gcols.ghits),
     )
-    return new_state, new_gcols, out, applied
+    # `total` is returned so the host tier can forward hits for keys
+    # whose authoritative owner is a REMOTE daemon (owner_shard == -1:
+    # no local shard applies, but the aggregated count must reach the
+    # owner via the peer transport — the sendHits leg, global.go:120-160).
+    return new_state, new_gcols, out, applied, total

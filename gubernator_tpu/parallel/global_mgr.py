@@ -35,6 +35,10 @@ class GlobalKeyTable:
         self.greg_duration = np.zeros(capacity, dtype=np.int64)
         # Host mirror of the broadcast expiry (== device rep_expire rows).
         self.rep_expire = np.zeros(capacity, dtype=np.int64)
+        # Last-seen request per gslot, the payload template for
+        # forwarding aggregated hits to a remote owner (sendHits sends
+        # full RateLimitReqs, global.go:129-145).
+        self.req_proto: Dict[int, object] = {}
 
     def __len__(self) -> int:
         return len(self._key_to_gslot)
@@ -54,6 +58,12 @@ class GlobalKeyTable:
         g = self._key_to_gslot.get(key)
         if g is not None:
             self._lru.move_to_end(g)
+            # Ownership can flip local <-> remote when the daemon ring
+            # rebalances; always track the latest claim, resetting the
+            # owner-slot mapping on a change.
+            if self.owner_shard[g] != owner_shard:
+                self.owner_shard[g] = owner_shard
+                self.owner_slot[g] = -1
             return g, None
         evicted = None
         if self._free:
@@ -83,6 +93,7 @@ class GlobalKeyTable:
         self.duration[g] = req.duration
         self.greg_expire[g] = greg_expire
         self.greg_duration[g] = greg_duration
+        self.req_proto[g] = req
 
     def active_gslots(self) -> List[int]:
         return list(self._key_to_gslot.values())

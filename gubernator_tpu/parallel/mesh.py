@@ -25,6 +25,8 @@ the host tier in both designs.
 
 from __future__ import annotations
 
+from dataclasses import dataclass, field, replace
+import threading
 from functools import partial
 from typing import List, Optional, Sequence
 
@@ -33,10 +35,24 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.shard import RoundPlanner, build_round_arrays, pad_size, prepare_requests
+from ..models.shard import (
+    RoundPlanner,
+    _rows_to_items,
+    build_round_arrays,
+    item_to_rows,
+    make_store_resolver,
+    pad_size,
+    prepare_requests,
+)
 from ..models.slot_table import SlotTable
 from ..ops import buckets, global_ops
-from ..types import Behavior, RateLimitRequest, RateLimitResponse, has_behavior
+from ..types import (
+    Behavior,
+    RateLimitRequest,
+    RateLimitResponse,
+    UpdatePeerGlobal,
+    has_behavior,
+)
 from ..utils import hashing
 from .global_mgr import GlobalKeyTable
 
@@ -54,6 +70,31 @@ def shard_of_key(key: str, n_shards: int) -> int:
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None, axis: str = "shard") -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     return Mesh(np.array(devices), (axis,))
+
+
+def _locked(fn):
+    """Serialize store mutators on the instance lock (donated device
+    buffers must never be used concurrently)."""
+
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+
+    wrapper.__name__ = fn.__name__
+    wrapper.__doc__ = fn.__doc__
+    return wrapper
+
+
+@dataclass
+class SyncResult:
+    """Host-tier work produced by one GLOBAL sync collective."""
+
+    broadcasts: List[UpdatePeerGlobal] = field(default_factory=list)
+    remote_hits: List[RateLimitRequest] = field(default_factory=list)
+
+    @property
+    def broadcast_count(self) -> int:
+        return len(self.broadcasts)
 
 
 class MeshBucketStore:
@@ -79,7 +120,15 @@ class MeshBucketStore:
         g_capacity: int = 4096,
         mesh: Optional[Mesh] = None,
         devices: Optional[Sequence[jax.Device]] = None,
+        store=None,
     ):
+        self.store = store
+        # One mutation lock: apply/sync/inject swap donated device
+        # buffers, so concurrent callers (gateway handler threads, the
+        # GlobalManager tick) must serialize — the role of the
+        # reference's cache mutex (gubernator.go:336-337), held per
+        # BATCH here instead of per request.
+        self._lock = threading.RLock()
         self.mesh = mesh if mesh is not None else make_mesh(devices)
         (self.axis,) = self.mesh.axis_names
         self.n_shards = self.mesh.devices.size
@@ -108,27 +157,45 @@ class MeshBucketStore:
 
         def _sync_body(state, gcols, cfg, dirty, now):
             sq = lambda t: jax.tree.map(lambda a: a[0], t)
-            ns, ngc, out, applied = global_ops.global_sync(
+            ns, ngc, out, applied, total = global_ops.global_sync(
                 sq(state), sq(gcols), cfg, dirty[0], now, axis=axis
             )
             ex = lambda t: jax.tree.map(lambda a: a[None], t)
-            return ex(ns), ex(ngc), ex(out), applied[None]
+            return ex(ns), ex(ngc), ex(out), applied[None], total[None]
 
         self._sync_fn = jax.jit(
             shard_map(
                 _sync_body,
                 mesh=self.mesh,
                 in_specs=(P(axis), P(axis), P(), P(axis), P()),
-                out_specs=(P(axis), P(axis), P(axis), P(axis)),
+                out_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
             ),
             donate_argnums=(0, 1),
         )
+
+        @partial(jax.jit, donate_argnums=0)
+        def _set_replica(gcols, gslots, status, limit, remaining, reset):
+            return jax.vmap(
+                global_ops.set_replica, in_axes=(0, None, None, None, None, None)
+            )(gcols, gslots, status, limit, remaining, reset)
+
+        self._set_replica_fn = _set_replica
 
         @partial(jax.jit, donate_argnums=0)
         def _clear(gcols, idx):
             return jax.vmap(global_ops.clear_gslots, in_axes=(0, None))(gcols, idx)
 
         self._clear_fn = _clear
+
+        @partial(jax.jit, donate_argnums=0)
+        def _write_row(state, s, slot, rows):
+            # Donated single-row scatter: store-miss injection / loader
+            # placement without copying the whole [S, C] state.
+            return jax.tree.map(
+                lambda col, val: col.at[s, slot].set(val[0]), state, rows
+            )
+
+        self._write_row_fn = _write_row
 
     def _stack_and_shard(self, single):
         stacked = jax.tree.map(
@@ -137,13 +204,22 @@ class MeshBucketStore:
         return jax.tree.map(lambda c: jax.device_put(c, self._sharding), stacked)
 
     # ------------------------------------------------------------------
+    @_locked
     def apply(
         self,
         requests: Sequence[RateLimitRequest],
         now_ms: int,
         home_shard: Optional[int] = None,
+        remote_global: bool = False,
     ) -> List[RateLimitResponse]:
-        """Evaluate a batch across all shards; responses in request order."""
+        """Evaluate a batch across all shards; responses in request order.
+
+        remote_global=True marks every GLOBAL request's authoritative
+        owner as a REMOTE daemon (V1Service sets this when the hash ring
+        maps the key to another peer): the key is answered locally from
+        its replica cache / fallback bucket, hits accumulate device-side,
+        and sync_globals() surfaces the totals for the host to forward.
+        """
         responses: List[Optional[RateLimitResponse]] = [None] * len(requests)
         prepared = prepare_requests(requests, now_ms, responses)
 
@@ -152,15 +228,17 @@ class MeshBucketStore:
             owner = shard_of_key(p.key, self.n_shards)
             target = owner
             if has_behavior(p.req.behavior, Behavior.GLOBAL):
-                g, evicted = self.gtable.lookup_or_assign(p.key, owner)
+                owner_mark = -1 if remote_global else owner
+                g, evicted = self.gtable.lookup_or_assign(p.key, owner_mark)
                 if evicted is not None:
                     self.gcols = self._clear_fn(self.gcols, np.array([evicted], np.int32))
                 self.gtable.update_config(g, p.req, p.greg_expire, p.greg_duration)
-                if home_shard is not None and home_shard != owner:
+                non_owner = remote_global or (home_shard is not None and home_shard != owner)
+                if non_owner:
                     # Non-owner: answer locally, forward hits at sync
                     # (gubernator.go:231-255).
                     p.gslot = g
-                    target = home_shard
+                    target = owner if remote_global else home_shard
                     if self.gtable.rep_expire[g] >= now_ms:
                         p.cached_hint = True
                 else:
@@ -170,7 +248,13 @@ class MeshBucketStore:
             by_shard[target].append(p)
 
         planners = [
-            RoundPlanner(self.tables[s], by_shard[s], now_ms) for s in range(self.n_shards)
+            RoundPlanner(
+                self.tables[s],
+                by_shard[s],
+                now_ms,
+                resolver=self._store_resolver(s, now_ms) if self.store is not None else None,
+            )
+            for s in range(self.n_shards)
         ]
         while True:
             chunks = [pl.next_chunk() for pl in planners]
@@ -226,15 +310,105 @@ class MeshBucketStore:
                     reset_time=int(out_reset[s, i]),
                 )
             self.tables[s].commit(commit_slots, commit_exp, commit_rm, keys=commit_keys)
+            if self.store is not None:
+                self._fire_store_callbacks(s, chunk, cached_np[s], out_removed[s])
 
     # ------------------------------------------------------------------
-    def sync_globals(self, now_ms: int) -> int:
+    # Store SPI (persistence) — same call pattern as ShardStore.
+    # ------------------------------------------------------------------
+    def _store_resolver(self, s: int, now_ms: int):
+        return make_store_resolver(
+            self.tables[s],
+            self.algo_mirror[s],
+            self.store,
+            lambda slot, item: self._inject(s, slot, item),
+            now_ms,
+        )
+
+    def _inject(self, s: int, slot: int, item) -> None:
+        rows = item_to_rows(item)
+        self.algo_mirror[s][slot] = int(rows.algo[0])
+        self.state = self._write_row_fn(
+            self.state, np.int32(s), np.int32(slot), rows
+        )
+        self.tables[s].expire_ms[slot] = item.expire_at
+
+    def _read_shard_rows(self, s: int, slots):
+        idx = np.asarray(slots, np.int32)
+        return jax.tree.map(lambda col: np.asarray(col[s][idx]), self.state)
+
+    def _fire_store_callbacks(self, s: int, chunk, cached_row, removed_row) -> None:
+        live = []
+        for i, p in enumerate(chunk):
+            if cached_row[i] or p.slot < 0:
+                continue  # replica-cache answers never touch the store
+            if removed_row[i]:
+                self.store.remove(p.key)
+            else:
+                live.append((i, p))
+        if not live:
+            return
+        rows = self._read_shard_rows(s, [p.slot for _, p in live])
+        items = _rows_to_items([p.key for _, p in live], rows)
+        for (_, p), item in zip(live, items):
+            self.store.on_change(p.req, item)
+
+    @_locked
+    def load_item(self, item) -> None:
+        """Loader.Load path (gubernator.go:78-90), routed to the owner shard."""
+        s = shard_of_key(item.key, self.n_shards)
+        slot, _ = self.tables[s].lookup_or_assign(item.key, 0)
+        self._inject(s, slot, item)
+
+    @_locked
+    def snapshot_items(self):
+        """Loader.Save path (gubernator.go:93-111) across all shards.
+        Materialized under the lock so a concurrent apply cannot swap
+        state buffers mid-snapshot."""
+        items = []
+        for s in range(self.n_shards):
+            keys = self.tables[s].keys()
+            if not keys:
+                continue
+            slots = [self.tables[s].get_slot(k) for k in keys]
+            rows = self._read_shard_rows(s, slots)
+            items.extend(_rows_to_items(keys, rows))
+        return items
+
+    # ------------------------------------------------------------------
+    @_locked
+    def set_replica(self, update, now_ms: int) -> None:
+        """Receive side of UpdatePeerGlobals (gubernator.go:259-272):
+        store the owner daemon's authoritative status in the replica
+        columns, expiring at ResetTime."""
+        g, evicted = self.gtable.lookup_or_assign(update.key, -1)
+        if evicted is not None:
+            self.gcols = self._clear_fn(self.gcols, np.array([evicted], np.int32))
+        st = update.status
+        self.gcols = self._set_replica_fn(
+            self.gcols,
+            np.array([g], np.int32),
+            np.array([int(st.status)], np.int32),
+            np.array([st.limit], np.int64),
+            np.array([st.remaining], np.int64),
+            np.array([st.reset_time], np.int64),
+        )
+        self.gtable.rep_expire[g] = st.reset_time
+        self.gtable.algorithm[g] = int(update.algorithm)
+
+    # ------------------------------------------------------------------
+    @_locked
+    def sync_globals(self, now_ms: int) -> "SyncResult":
         """Run one GLOBAL sync collective (the TPU-native stand-in for
-        GlobalSyncWait ticks of all three global.go pipelines).  Returns
-        the number of keys broadcast."""
+        GlobalSyncWait ticks of all three global.go pipelines).
+
+        The SyncResult carries what the HOST tier must fan out over the
+        peer transport: authoritative statuses for keys this daemon owns
+        (UpdatePeerGlobals broadcast) and aggregated hit totals for keys
+        owned by remote daemons (GetPeerRateLimits forward)."""
         active = self.gtable.active_gslots()
         if not active and not self.dirty.any():
-            return 0
+            return SyncResult()
 
         # Resolve each GLOBAL key's slot in its owner shard's table.
         # Assigning one key can evict another's slot under capacity
@@ -243,8 +417,10 @@ class MeshBucketStore:
         for _ in range(3):
             changed = False
             for g in active:
-                key = self.gtable.key_of(g)
                 o = int(self.gtable.owner_shard[g])
+                if o < 0:
+                    continue  # remote daemon owns it: no local slot
+                key = self.gtable.key_of(g)
                 slot = self.tables[o].get_slot(key)
                 if slot is None:
                     slot, _ = self.tables[o].lookup_or_assign(key, now_ms)
@@ -253,8 +429,10 @@ class MeshBucketStore:
             if not changed:
                 break
         for g in active:
-            key = self.gtable.key_of(g)
             o = int(self.gtable.owner_shard[g])
+            if o < 0:
+                continue
+            key = self.gtable.key_of(g)
             if self.tables[o].get_slot(key) != int(self.gtable.owner_slot[g]):
                 self.gtable.owner_slot[g] = -1
 
@@ -269,27 +447,61 @@ class MeshBucketStore:
             greg_duration=jnp.asarray(self.gtable.greg_duration),
         )
         dirty_dev = jax.device_put(jnp.asarray(self.dirty), self._sharding)
-        self.state, self.gcols, out, applied = self._sync_fn(
+        self.state, self.gcols, out, applied, totals = self._sync_fn(
             self.state, self.gcols, cfg, dirty_dev, now_ms
         )
 
         out_exp = np.asarray(out.new_expire)
         out_rm = np.asarray(out.removed)
         applied_np = np.asarray(applied)[0]
+        totals_np = np.asarray(totals)[0]
+        rep_status = np.asarray(self.gcols.rep_status)[0]
+        rep_limit = np.asarray(self.gcols.rep_limit)[0]
+        rep_remaining = np.asarray(self.gcols.rep_remaining)[0]
+        rep_reset = np.asarray(self.gcols.rep_reset)[0]
         self.gtable.rep_expire[:] = np.asarray(self.gcols.rep_expire)[0]
 
-        n_bcast = 0
+        result = SyncResult()
         for g in active:
+            key = self.gtable.key_of(g)
+            o = int(self.gtable.owner_shard[g])
+            if o < 0:
+                # Remote daemon owns this key: surface aggregated hits
+                # for the host sendHits leg (global.go:120-160).
+                if totals_np[g] > 0 and self.gtable.req_proto.get(g) is not None:
+                    req = replace(self.gtable.req_proto[g], hits=int(totals_np[g]))
+                    result.remote_hits.append(req)
+                continue
             slot = int(self.gtable.owner_slot[g])
             if slot < 0 or not applied_np[g]:
                 continue
-            o = int(self.gtable.owner_shard[g])
-            self.tables[o].commit(
-                [slot], [out_exp[o, g]], [out_rm[o, g]], keys=[self.gtable.key_of(g)]
+            self.tables[o].commit([slot], [out_exp[o, g]], [out_rm[o, g]], keys=[key])
+            # Store SPI parity: the owner-side apply of forwarded hits
+            # goes through the algorithms in the reference and fires
+            # OnChange/Remove (algorithms.go:64-68,38-40).
+            if self.store is not None:
+                req = self.gtable.req_proto.get(g)
+                if out_rm[o, g]:
+                    self.store.remove(key)
+                elif req is not None:
+                    rows = self._read_shard_rows(o, [slot])
+                    self.store.on_change(req, _rows_to_items([key], rows)[0])
+            # Authoritative status for the host broadcast leg
+            # (UpdatePeerGlobal payload, peers.proto:52-56).
+            result.broadcasts.append(
+                UpdatePeerGlobal(
+                    key=key,
+                    algorithm=int(self.gtable.algorithm[g]),
+                    status=RateLimitResponse(
+                        status=int(rep_status[g]),
+                        limit=int(rep_limit[g]),
+                        remaining=int(rep_remaining[g]),
+                        reset_time=int(rep_reset[g]),
+                    ),
+                )
             )
-            n_bcast += 1
         self.dirty[:] = False
-        return n_bcast
+        return result
 
     # ------------------------------------------------------------------
     def size(self) -> int:

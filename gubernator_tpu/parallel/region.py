@@ -1,0 +1,51 @@
+"""Region picker: data-center name -> per-region peer picker.
+
+Parity with region_picker.go:7-95: `get_clients(key)` returns the owner
+peer for the key in EVERY region (the MULTI_REGION fan-out set), and
+`pick(dc, key)` the owner within one region.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .hash_ring import ReplicatedConsistentHash
+
+
+class RegionPicker:
+    def __init__(self, template: Optional[ReplicatedConsistentHash] = None):
+        self._template = template or ReplicatedConsistentHash()
+        self.regions: Dict[str, ReplicatedConsistentHash] = {}
+
+    def new(self) -> "RegionPicker":
+        return RegionPicker(self._template.new())
+
+    def add(self, peer) -> None:
+        """peer must expose .info (PeerInfo); grouped by data_center
+        (region_picker.go:88-95)."""
+        dc = peer.info.data_center
+        ring = self.regions.get(dc)
+        if ring is None:
+            ring = self._template.new()
+            self.regions[dc] = ring
+        ring.add(peer.info.grpc_address, peer)
+
+    def get_clients(self, key: str) -> List[object]:
+        """Owner peer for the key in each region (region_picker.go:47-59)."""
+        out = []
+        for ring in self.regions.values():
+            owner_id = ring.get(key)
+            out.append(ring.get_by_peer_id(owner_id))
+        return out
+
+    def pick(self, dc: str, key: str):
+        ring = self.regions.get(dc)
+        if ring is None:
+            return None
+        return ring.get_by_peer_id(ring.get(key))
+
+    def peers(self) -> List[object]:
+        out = []
+        for ring in self.regions.values():
+            out.extend(ring.peers())
+        return out

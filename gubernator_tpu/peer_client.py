@@ -1,0 +1,221 @@
+"""Peer transport client: lazy connections, request batching, error LRU.
+
+Parity with peer_client.go: per-peer request queue drained into one
+GetPeerRateLimits call when BatchLimit is reached or the BatchWait
+window closes (peer_client.go:272-312); NO_BATCHING bypasses the queue
+(:143-152); last-error LRU with 5-minute TTL surfaced via HealthCheck
+(:206-235); graceful shutdown drains in-flight requests (:351-385).
+
+Transport is HTTP/JSON against the peer's gateway endpoints (the
+reference's gRPC data plane maps onto the same grpc-gateway JSON
+surface this framework serves).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import queue
+import ssl
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+from .config import BehaviorConfig
+from .types import (
+    Behavior,
+    GetRateLimitsRequest,
+    GetRateLimitsResponse,
+    PeerInfo,
+    RateLimitRequest,
+    RateLimitResponse,
+    has_behavior,
+)
+
+ERR_CLOSING = "grpc: the client connection is closing"
+
+
+class PeerError(Exception):
+    def __init__(self, message: str, not_ready: bool = False):
+        super().__init__(message)
+        self.not_ready = not_ready
+
+
+def is_not_ready(err: Exception) -> bool:
+    """Reference `IsNotReady` (peer_client.go:405-412)."""
+    return isinstance(err, PeerError) and err.not_ready
+
+
+class PeerClient:
+    LAST_ERR_TTL_S = 300.0  # peer_client.go:77 (5 minute TTL)
+
+    def __init__(
+        self,
+        info: PeerInfo,
+        behaviors: Optional[BehaviorConfig] = None,
+        tls_context: Optional[ssl.SSLContext] = None,
+    ):
+        self.info = info
+        self.behaviors = behaviors or BehaviorConfig()
+        self.tls_context = tls_context
+        self._conn_lock = threading.Lock()
+        self._conn: Optional[http.client.HTTPConnection] = None
+        self._queue: "queue.Queue[Tuple[RateLimitRequest, Future]]" = queue.Queue()
+        self._shutdown = threading.Event()
+        self._last_err: Dict[str, float] = {}  # message -> expiry timestamp
+        self._worker: Optional[threading.Thread] = None
+        self._worker_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def get_peer_rate_limit(
+        self, req: RateLimitRequest, timeout_s: Optional[float] = None
+    ) -> RateLimitResponse:
+        """One rate limit from the owning peer; batched unless the
+        request asks NO_BATCHING (peer_client.go:141-154)."""
+        if has_behavior(req.behavior, Behavior.NO_BATCHING):
+            resp = self.get_peer_rate_limits(
+                GetRateLimitsRequest(requests=[req]), timeout_s=timeout_s
+            )
+            return resp.responses[0]
+        if self._shutdown.is_set():
+            raise PeerError(ERR_CLOSING, not_ready=True)
+        self._ensure_worker()
+        fut: Future = Future()
+        self._queue.put((req, fut))
+        timeout = timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
+        return fut.result(timeout=timeout + 1.0)
+
+    def get_peer_rate_limits(
+        self, req: GetRateLimitsRequest, timeout_s: Optional[float] = None
+    ) -> GetRateLimitsResponse:
+        """Owner-authoritative batch (PeersV1.GetPeerRateLimits)."""
+        body = self._post("/v1/peer.GetPeerRateLimits", req.to_json(), timeout_s)
+        resp = GetRateLimitsResponse.from_json({"responses": body.get("rateLimits", [])})
+        if len(resp.responses) != len(req.requests):
+            raise PeerError("number of rate limits in peer response does not match request")
+        return resp
+
+    def update_peer_globals(self, globals_json: dict, timeout_s: Optional[float] = None) -> None:
+        """PeersV1.UpdatePeerGlobals."""
+        self._post("/v1/peer.UpdatePeerGlobals", globals_json, timeout_s)
+
+    # ------------------------------------------------------------------
+    def _ensure_worker(self) -> None:
+        with self._worker_lock:
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(target=self._run, daemon=True)
+                self._worker.start()
+
+    def _run(self) -> None:
+        """Batch loop (peer_client.go:272-312): first enqueue opens a
+        BatchWait window; flush on BatchLimit or window close."""
+        b = self.behaviors
+        while not self._shutdown.is_set():
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            batch = [first]
+            deadline = time.monotonic() + b.batch_wait_s
+            while len(batch) < b.batch_limit:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._queue.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            self._send_batch(batch)
+        # Drain anything left after shutdown was requested.
+        leftovers = []
+        while True:
+            try:
+                leftovers.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        if leftovers:
+            self._send_batch(leftovers)
+
+    def _send_batch(self, batch: List[Tuple[RateLimitRequest, Future]]) -> None:
+        """peer_client.go:316-348 sendQueue."""
+        try:
+            resp = self.get_peer_rate_limits(
+                GetRateLimitsRequest(requests=[r for r, _ in batch]),
+                timeout_s=self.behaviors.batch_timeout_s,
+            )
+        except Exception as e:  # noqa: BLE001
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        for (_, fut), rl in zip(batch, resp.responses):
+            if not fut.done():
+                fut.set_result(rl)
+
+    # ------------------------------------------------------------------
+    def _post(self, path: str, payload: dict, timeout_s: Optional[float]) -> dict:
+        timeout = timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
+        data = json.dumps(payload).encode("utf-8")
+        host = self.info.http_address or self.info.grpc_address
+        with self._conn_lock:
+            try:
+                if self._conn is None:
+                    hostname, _, port = host.partition(":")
+                    if self.tls_context is not None:
+                        self._conn = http.client.HTTPSConnection(
+                            hostname, int(port or 443), timeout=timeout,
+                            context=self.tls_context,
+                        )
+                    else:
+                        self._conn = http.client.HTTPConnection(
+                            hostname, int(port or 80), timeout=timeout
+                        )
+                self._conn.request(
+                    "POST", path, body=data, headers={"Content-Type": "application/json"}
+                )
+                r = self._conn.getresponse()
+                body = r.read()
+                if r.status != 200:
+                    raise PeerError(f"peer returned HTTP {r.status}: {body[:200]!r}")
+                return json.loads(body) if body else {}
+            except PeerError as e:
+                self._set_last_err(str(e))
+                self._reset_conn()
+                raise
+            except (OSError, http.client.HTTPException) as e:
+                msg = f"connect to peer {host} failed: {e}"
+                self._set_last_err(msg)
+                self._reset_conn()
+                raise PeerError(msg, not_ready=True) from e
+
+    def _reset_conn(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    # ------------------------------------------------------------------
+    def _set_last_err(self, msg: str) -> None:
+        """Error LRU with TTL (peer_client.go:206-220); messages include
+        the peer address for HealthCheck reporting."""
+        self._last_err[f"{msg} (peer: {self.info.grpc_address})"] = (
+            time.monotonic() + self.LAST_ERR_TTL_S
+        )
+
+    def get_last_err(self) -> List[str]:
+        now = time.monotonic()
+        self._last_err = {m: t for m, t in self._last_err.items() if t > now}
+        return list(self._last_err.keys())
+
+    # ------------------------------------------------------------------
+    def shutdown(self, timeout_s: float = 5.0) -> None:
+        """Drain in-flight batches, then close (peer_client.go:351-385)."""
+        self._shutdown.set()
+        worker = self._worker
+        if worker is not None and worker.is_alive():
+            worker.join(timeout=timeout_s)
+        with self._conn_lock:
+            self._reset_conn()

@@ -1,0 +1,109 @@
+"""Peer discovery pools.
+
+The reference ships three backends (etcd lease+watch, memberlist gossip,
+k8s informer — etcd.go / memberlist.go / kubernetes.go), all pushing
+`[]PeerInfo` through an OnUpdate callback.  This environment has none of
+those client libraries installed, so the zero-dependency backends are:
+
+  * static   — fixed list in DaemonConfig.peers (the cluster harness and
+               tests use this, like cluster/cluster.go bypasses
+               discovery entirely)
+  * file     — a watched JSON file of PeerInfo entries; editing the file
+               is the membership event (closest stand-in for an external
+               discovery plane)
+
+`make_pool` raises a clear error for etcd/member-list/k8s unless the
+optional client library is importable, keeping the reference's config
+surface (GUBER_PEER_DISCOVERY_TYPE) intact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, List, Optional
+
+from .types import PeerInfo
+
+OnUpdate = Callable[[List[PeerInfo]], None]
+
+
+class StaticPool:
+    """Fixed peer list, delivered once."""
+
+    def __init__(self, peers: List[PeerInfo], on_update: OnUpdate):
+        on_update(peers)
+
+    def close(self) -> None:
+        pass
+
+
+class FilePool:
+    """Watches a JSON file ([{"grpcAddress": ...}, ...]) by mtime poll;
+    pushes the parsed list on change."""
+
+    def __init__(self, path: str, on_update: OnUpdate, poll_s: float = 0.5):
+        self.path = path
+        self.on_update = on_update
+        self.poll_s = poll_s
+        self._stop = threading.Event()
+        self._mtime = 0.0
+        self._load()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _load(self) -> None:
+        try:
+            mtime = os.path.getmtime(self.path)
+        except OSError:
+            return
+        if mtime == self._mtime:
+            return
+        self._mtime = mtime
+        with open(self.path) as f:
+            data = json.load(f)
+        self.on_update([PeerInfo.from_json(p) for p in data])
+
+    def _run(self) -> None:
+        while not self._stop.wait(timeout=self.poll_s):
+            try:
+                self._load()
+            except (OSError, json.JSONDecodeError):
+                continue
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+
+def make_pool(kind: str, conf, on_update: OnUpdate):
+    """daemon.go:163-192 discovery switch."""
+    if kind == "static":
+        return StaticPool(conf.peers, on_update)
+    if kind == "file":
+        return FilePool(conf.peers_file, on_update)
+    if kind == "etcd":
+        try:
+            import etcd3  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "etcd peer discovery requires the 'etcd3' package, which is "
+                "not installed in this environment; use 'static' or 'file'"
+            ) from e
+        raise NotImplementedError("etcd pool: install etcd3 and wire EtcdPool here")
+    if kind == "member-list":
+        raise RuntimeError(
+            "member-list gossip discovery is not available in this build; "
+            "use 'static' or 'file' (the reference uses hashicorp/memberlist)"
+        )
+    if kind == "k8s":
+        try:
+            import kubernetes  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "k8s peer discovery requires the 'kubernetes' package, which "
+                "is not installed in this environment; use 'static' or 'file'"
+            ) from e
+        raise NotImplementedError("k8s pool: install kubernetes and wire K8sPool here")
+    raise ValueError(f"unknown peer discovery type '{kind}'")

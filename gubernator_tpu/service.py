@@ -1,0 +1,467 @@
+"""V1Service — the service core (reference V1Instance, gubernator.go).
+
+Routes each request in a GetRateLimits batch: keys this daemon owns are
+evaluated in ONE vectorized store call (the reference's 1000-goroutine
+fan-out collapses into the kernel batch); keys owned by another daemon
+are forwarded through the batching PeerClient; GLOBAL keys owned
+elsewhere answer from the local replica cache with async hit
+forwarding.  Host-tier GLOBAL and MULTI_REGION pipelines mirror
+global.go / multiregion.go on top of the device-tier collective sync.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from .config import MAX_BATCH_SIZE, BehaviorConfig
+from .metrics import Metrics
+from .parallel.hash_ring import ReplicatedConsistentHash
+from .parallel.mesh import MeshBucketStore
+from .parallel.region import RegionPicker
+from .peer_client import PeerClient, PeerError, is_not_ready
+from .types import (
+    Behavior,
+    GetRateLimitsRequest,
+    GetRateLimitsResponse,
+    HealthCheckResponse,
+    PeerInfo,
+    RateLimitRequest,
+    RateLimitResponse,
+    UpdatePeerGlobal,
+    has_behavior,
+    set_behavior,
+)
+from .utils.clock import DEFAULT_CLOCK, Clock
+
+HEALTHY = "healthy"
+UNHEALTHY = "unhealthy"
+
+
+class ApiError(Exception):
+    """Request-level error (maps to a gRPC status / HTTP error)."""
+
+    def __init__(self, code: str, message: str, http_status: int = 400):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.http_status = http_status
+
+
+@dataclass
+class ServiceConfig:
+    """Library-user config (reference Config, config.go:66-104)."""
+
+    store: Optional[MeshBucketStore] = None  # built from sizes when None
+    cache_size: int = 50_000
+    global_cache_size: int = 4096
+    behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
+    advertise_address: str = ""
+    data_center: str = ""
+    persist_store: object = None  # Store SPI
+    loader: object = None  # Loader SPI
+    clock: Clock = field(default_factory=lambda: DEFAULT_CLOCK)
+    metrics: Optional[Metrics] = None
+    devices: Optional[list] = None
+    local_picker: Optional[ReplicatedConsistentHash] = None
+    region_picker: Optional[RegionPicker] = None
+
+
+class V1Service:
+    def __init__(self, conf: ServiceConfig):
+        self.conf = conf
+        self.clock = conf.clock
+        self.metrics = conf.metrics or Metrics()
+        self.store = conf.store or MeshBucketStore(
+            capacity_per_shard=max(conf.cache_size // _n_local_devices(conf.devices), 1),
+            g_capacity=conf.global_cache_size,
+            devices=conf.devices,
+            store=conf.persist_store,
+        )
+        self.local_picker = conf.local_picker or ReplicatedConsistentHash()
+        self.region_picker = conf.region_picker or RegionPicker()
+        self._peer_mutex = threading.RLock()
+        self._health = HealthCheckResponse(status=HEALTHY)
+        self._forward_pool = ThreadPoolExecutor(max_workers=64)
+        self._closed = False
+
+        if conf.loader is not None:
+            for item in conf.loader.load():
+                self.store.load_item(item)
+
+        self.global_mgr = GlobalManager(self)
+        self.multi_region_mgr = MultiRegionManager(self)
+
+    # ------------------------------------------------------------------
+    @property
+    def advertise_address(self) -> str:
+        return self.conf.advertise_address
+
+    def get_peer(self, key: str) -> PeerClient:
+        """Owner peer for a key (gubernator.go:440-449)."""
+        with self._peer_mutex:
+            if self.local_picker.size() == 0:
+                raise PeerError("unable to pick a peer; pool is empty")
+            owner_id = self.local_picker.get(key)
+            return self.local_picker.get_by_peer_id(owner_id)
+
+    def get_peer_list(self) -> List[PeerClient]:
+        with self._peer_mutex:
+            return list(self.local_picker.peers())
+
+    def get_region_picker(self) -> RegionPicker:
+        return self.region_picker
+
+    # ------------------------------------------------------------------
+    def get_rate_limits(self, req: GetRateLimitsRequest) -> GetRateLimitsResponse:
+        """gubernator.go:116-227."""
+        start = time.perf_counter()
+        try:
+            if len(req.requests) > MAX_BATCH_SIZE:
+                raise ApiError(
+                    "OutOfRange",
+                    f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'",
+                )
+            resp = self._route(req.requests)
+            self.metrics.request_counts.labels(status="0", method="/pb.gubernator.V1/GetRateLimits").inc()
+            return resp
+        except ApiError:
+            self.metrics.request_counts.labels(status="1", method="/pb.gubernator.V1/GetRateLimits").inc()
+            raise
+        finally:
+            self.metrics.request_duration.labels(
+                method="/pb.gubernator.V1/GetRateLimits"
+            ).observe(time.perf_counter() - start)
+            self.metrics.observe_cache(self.store)
+
+    def _route(self, requests: Sequence[RateLimitRequest]) -> GetRateLimitsResponse:
+        n = len(requests)
+        out: List[Optional[RateLimitResponse]] = [None] * n
+        local: List[int] = []
+        global_remote: List[int] = []
+        owner_by_idx: Dict[int, str] = {}
+        forwards: List[tuple] = []  # (idx, req, peer)
+
+        for i, r in enumerate(requests):
+            # Validation (gubernator.go:142-152; note the reference's
+            # 'namespace' wording for an empty name).
+            if not r.unique_key:
+                out[i] = RateLimitResponse(error="field 'unique_key' cannot be empty")
+                continue
+            if not r.name:
+                out[i] = RateLimitResponse(error="field 'namespace' cannot be empty")
+                continue
+            key = r.hash_key()
+            peer, err = self._pick_ready_peer(key)
+            if peer is None:
+                out[i] = RateLimitResponse(
+                    error=f"while finding peer that owns rate limit '{key}' - '{err}'"
+                )
+                continue
+            if peer.info.is_owner:
+                local.append(i)
+                if has_behavior(r.behavior, Behavior.MULTI_REGION):
+                    self.multi_region_mgr.queue_hits(r)
+            elif has_behavior(r.behavior, Behavior.GLOBAL):
+                global_remote.append(i)
+                owner_by_idx[i] = peer.info.grpc_address
+            else:
+                forwards.append((i, r, peer))
+
+        now = self.clock.now_ms()
+
+        if local:
+            resps = self.store.apply([requests[i] for i in local], now)
+            for i, resp in zip(local, resps):
+                out[i] = resp
+        if global_remote:
+            resps = self.store.apply(
+                [requests[i] for i in global_remote], now, remote_global=True
+            )
+            for i, resp in zip(global_remote, resps):
+                resp.metadata = {"owner": owner_by_idx.get(i, "")}
+                out[i] = resp
+
+        if forwards:
+            futures = {
+                i: self._forward_pool.submit(self._forward_one, r, p)
+                for i, r, p in forwards
+            }
+            for i, fut in futures.items():
+                out[i] = fut.result()
+
+        return GetRateLimitsResponse(
+            responses=[r if r is not None else RateLimitResponse() for r in out]
+        )
+
+    def _pick_ready_peer(self, key: str):
+        """GetPeer for routing; the not-ready re-pick loop
+        (gubernator.go:154-162) lives in _forward_one, where readiness
+        is actually observed."""
+        try:
+            return self.get_peer(key), None
+        except PeerError as e:
+            return None, e
+
+    def _forward_one(self, r: RateLimitRequest, peer: PeerClient) -> RateLimitResponse:
+        """Forward to the owner (the BATCHING leg, gubernator.go:195-210),
+        retrying with a re-pick when the peer is not ready."""
+        key = r.hash_key()
+        attempts = 0
+        while True:
+            try:
+                resp = peer.get_peer_rate_limit(r)
+                resp.metadata = {"owner": peer.info.grpc_address}
+                return resp
+            except Exception as e:  # noqa: BLE001
+                if is_not_ready(e):
+                    attempts += 1
+                    if attempts > 5:
+                        return RateLimitResponse(
+                            error=(
+                                "GetPeer() keeps returning peers that are not connected "
+                                f"for '{key}' - '{e}'"
+                            )
+                        )
+                    try:
+                        peer = self.get_peer(key)
+                    except PeerError as pe:
+                        return RateLimitResponse(
+                            error=f"while finding peer that owns rate limit '{key}' - '{pe}'"
+                        )
+                    continue
+                return RateLimitResponse(
+                    error=f"while fetching rate limit '{key}' from peer - '{e}'"
+                )
+
+    # ------------------------------------------------------------------
+    # PeersV1 surface
+    # ------------------------------------------------------------------
+    def get_peer_rate_limits(self, req: GetRateLimitsRequest) -> GetRateLimitsResponse:
+        """Owner-authoritative batch (gubernator.go:275-292); never
+        re-forwards."""
+        if len(req.requests) > MAX_BATCH_SIZE:
+            raise ApiError(
+                "OutOfRange",
+                f"'PeerRequest.rate_limits' list too large; max size is '{MAX_BATCH_SIZE}'",
+            )
+        now = self.clock.now_ms()
+        resps = self.store.apply(list(req.requests), now)
+        for r in req.requests:
+            if has_behavior(r.behavior, Behavior.MULTI_REGION):
+                self.multi_region_mgr.queue_hits(r)
+        return GetRateLimitsResponse(responses=resps)
+
+    def update_peer_globals(self, updates: Sequence[UpdatePeerGlobal]) -> None:
+        """gubernator.go:259-272."""
+        now = self.clock.now_ms()
+        for u in updates:
+            self.store.set_replica(u, now)
+
+    # ------------------------------------------------------------------
+    def health_check(self) -> HealthCheckResponse:
+        """gubernator.go:295-333."""
+        errs: List[str] = []
+        with self._peer_mutex:
+            for peer in self.local_picker.peers():
+                errs.extend(peer.get_last_err())
+            for peer in self.region_picker.peers():
+                errs.extend(peer.get_last_err())
+            self._health.status = HEALTHY
+            self._health.message = ""
+            self._health.peer_count = self.local_picker.size()
+            if errs:
+                self._health.status = UNHEALTHY
+                self._health.message = "|".join(errs)
+            return HealthCheckResponse(
+                status=self._health.status,
+                message=self._health.message,
+                peer_count=self._health.peer_count,
+            )
+
+    # ------------------------------------------------------------------
+    def set_peers(self, peer_infos: Sequence[PeerInfo]) -> None:
+        """Rebuild pickers, reusing existing clients by address; drain
+        dropped peers in the background (gubernator.go:357-437)."""
+        local = [p for p in peer_infos if not p.data_center or p.data_center == self.conf.data_center]
+        regional = [p for p in peer_infos if p.data_center and p.data_center != self.conf.data_center]
+
+        with self._peer_mutex:
+            old_clients = {
+                c.info.grpc_address: c
+                for c in list(self.local_picker.peers()) + list(self.region_picker.peers())
+                if isinstance(c, PeerClient)
+            }
+            new_local = self.local_picker.new()
+            for info in local:
+                client = old_clients.pop(info.grpc_address, None)
+                if client is None:
+                    client = PeerClient(info, self.conf.behaviors)
+                client.info = info
+                new_local.add(info.grpc_address, client)
+            new_region = self.region_picker.new()
+            for info in regional:
+                client = old_clients.pop(info.grpc_address, None)
+                if client is None:
+                    client = PeerClient(info, self.conf.behaviors)
+                client.info = info
+                new_region.add(client)
+            self.local_picker = new_local
+            self.region_picker = new_region
+
+        # Shutdown dropped peers without blocking (gubernator.go:398-428).
+        for client in old_clients.values():
+            threading.Thread(target=client.shutdown, daemon=True).start()
+
+    # ------------------------------------------------------------------
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.global_mgr.stop()
+        self.multi_region_mgr.stop()
+        self._forward_pool.shutdown(wait=False)
+        if self.conf.loader is not None:
+            self.conf.loader.save(self.store.snapshot_items())
+        for peer in self.get_peer_list():
+            if isinstance(peer, PeerClient):
+                peer.shutdown(timeout_s=1.0)
+
+
+def _n_local_devices(devices) -> int:
+    if devices is not None:
+        return max(len(devices), 1)
+    import jax
+
+    return max(len(jax.devices()), 1)
+
+
+class GlobalManager:
+    """Host-tier GLOBAL pipelines (global.go:32-243) on top of the
+    device-tier collective sync: every GlobalSyncWait, run the on-mesh
+    sync; fan out the resulting owner broadcasts (UpdatePeerGlobals) to
+    every peer daemon and forward aggregated hits for remotely-owned
+    keys (GetPeerRateLimits) to their owner daemons."""
+
+    def __init__(self, service: V1Service):
+        self.service = service
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        wait = self.service.conf.behaviors.global_sync_wait_s
+        while not self._stop.wait(timeout=wait):
+            try:
+                self.run_once()
+            except Exception:  # noqa: BLE001 — pipeline must survive
+                pass
+
+    def run_once(self) -> None:
+        svc = self.service
+        res = svc.store.sync_globals(svc.clock.now_ms())
+        if res.remote_hits:
+            start = time.perf_counter()
+            by_owner: Dict[str, List[RateLimitRequest]] = {}
+            clients: Dict[str, PeerClient] = {}
+            for r in res.remote_hits:
+                try:
+                    peer = svc.get_peer(r.hash_key())
+                except PeerError:
+                    continue
+                addr = peer.info.grpc_address
+                by_owner.setdefault(addr, []).append(r)
+                clients[addr] = peer
+            for addr, reqs in by_owner.items():
+                try:
+                    clients[addr].get_peer_rate_limits(
+                        GetRateLimitsRequest(requests=reqs),
+                        timeout_s=svc.conf.behaviors.global_timeout_s,
+                    )
+                except Exception:  # noqa: BLE001 (logged-and-continue in ref)
+                    pass
+            svc.metrics.async_durations.observe(time.perf_counter() - start)
+        if res.broadcasts:
+            start = time.perf_counter()
+            payload = {"globals": [u.to_json() for u in res.broadcasts]}
+            for peer in svc.get_peer_list():
+                if peer.info.is_owner:
+                    continue  # exclude ourselves (global.go:223-226)
+                try:
+                    peer.update_peer_globals(
+                        payload, timeout_s=svc.conf.behaviors.global_timeout_s
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+            svc.metrics.broadcast_durations.observe(time.perf_counter() - start)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=1.0)
+
+
+class MultiRegionManager:
+    """MULTI_REGION hit pipeline (multiregion.go:8-83).  The reference's
+    send is an acknowledged stub (multiregion.go:79-83 TODOs); here the
+    aggregated hits ARE pushed to the owning peer of every OTHER region,
+    honoring those TODOs."""
+
+    def __init__(self, service: V1Service):
+        self.service = service
+        self._lock = threading.Lock()
+        self._hits: Dict[str, RateLimitRequest] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def queue_hits(self, r: RateLimitRequest) -> None:
+        """Aggregate by hash key, summing hits (multiregion.go:37-47)."""
+        with self._lock:
+            key = r.hash_key()
+            cur = self._hits.get(key)
+            if cur is None:
+                from dataclasses import replace
+
+                self._hits[key] = replace(r)
+            else:
+                cur.hits += r.hits
+
+    def _run(self) -> None:
+        wait = self.service.conf.behaviors.multi_region_sync_wait_s
+        while not self._stop.wait(timeout=wait):
+            try:
+                self.run_once()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def run_once(self) -> None:
+        with self._lock:
+            hits, self._hits = self._hits, {}
+        if not hits:
+            return
+        svc = self.service
+        my_dc = svc.conf.data_center
+        by_peer: Dict[str, List[RateLimitRequest]] = {}
+        clients: Dict[str, PeerClient] = {}
+        for key, r in hits.items():
+            for peer in svc.get_region_picker().get_clients(key):
+                if peer is None or peer.info.data_center == my_dc:
+                    continue
+                addr = peer.info.grpc_address
+                by_peer.setdefault(addr, []).append(r)
+                clients[addr] = peer
+        for addr, reqs in by_peer.items():
+            try:
+                clients[addr].get_peer_rate_limits(
+                    GetRateLimitsRequest(requests=reqs),
+                    timeout_s=svc.conf.behaviors.multi_region_timeout_s,
+                )
+            except Exception:  # noqa: BLE001
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=1.0)

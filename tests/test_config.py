@@ -1,0 +1,68 @@
+"""Config parsing tests (config.go:220-388 env precedence + validation)."""
+
+import pytest
+
+from gubernator_tpu.config import (
+    MAX_BATCH_SIZE,
+    from_env_file,
+    setup_daemon_config,
+)
+
+
+def test_defaults():
+    conf = setup_daemon_config(env={})
+    assert conf.listen_address == "127.0.0.1:1050"
+    assert conf.cache_size == 50_000
+    assert conf.behaviors.batch_limit == 1000
+    assert conf.behaviors.batch_wait_s == pytest.approx(0.0005)
+    assert conf.peer_discovery_type == "static"
+
+
+def test_env_overrides():
+    env = {
+        "GUBER_HTTP_ADDRESS": "0.0.0.0:9090",
+        "GUBER_CACHE_SIZE": "1234",
+        "GUBER_DATA_CENTER": "dc-west",
+        "GUBER_BATCH_LIMIT": "500",
+        "GUBER_BATCH_WAIT": "2ms",
+        "GUBER_GLOBAL_SYNC_WAIT": "50ms",
+        "GUBER_STATIC_PEERS": "10.0.0.1:81,10.0.0.2:81",
+        "GUBER_DEBUG": "true",
+    }
+    conf = setup_daemon_config(env=env)
+    assert conf.listen_address == "0.0.0.0:9090"
+    assert conf.cache_size == 1234
+    assert conf.data_center == "dc-west"
+    assert conf.behaviors.batch_limit == 500
+    assert conf.behaviors.batch_wait_s == pytest.approx(0.002)
+    assert conf.behaviors.global_sync_wait_s == pytest.approx(0.05)
+    assert [p.grpc_address for p in conf.peers] == ["10.0.0.1:81", "10.0.0.2:81"]
+    assert conf.debug
+
+
+def test_env_file_precedence(tmp_path):
+    """Env file loads first; process env (GUBER_*) wins (config.go:238+)."""
+    f = tmp_path / "guber.conf"
+    f.write_text("# comment\nGUBER_CACHE_SIZE=777\nGUBER_DATA_CENTER=dc-file\n")
+    conf = setup_daemon_config(
+        config_file=str(f), env={"GUBER_DATA_CENTER": "dc-env"}
+    )
+    assert conf.cache_size == 777
+    assert conf.data_center == "dc-env"
+
+
+def test_env_file_malformed(tmp_path):
+    f = tmp_path / "bad.conf"
+    f.write_text("NOT A KV LINE\n")
+    with pytest.raises(ValueError, match="malformed"):
+        from_env_file(str(f))
+
+
+def test_batch_limit_validation():
+    with pytest.raises(ValueError, match=f"cannot exceed '{MAX_BATCH_SIZE}'"):
+        setup_daemon_config(env={"GUBER_BATCH_LIMIT": "5000"})
+
+
+def test_discovery_type_validation():
+    with pytest.raises(ValueError, match="GUBER_PEER_DISCOVERY_TYPE is invalid"):
+        setup_daemon_config(env={"GUBER_PEER_DISCOVERY_TYPE": "zookeeper"})

@@ -41,8 +41,8 @@ def test_non_owner_answers_locally_then_converges():
     assert r.status == Status.UNDER_LIMIT and r.remaining == 9
 
     # Sync: the hit reaches the owner, owner broadcasts.
-    n = store.sync_globals(T0 + 1)
-    assert n == 1
+    res = store.sync_globals(T0 + 1)
+    assert res.broadcast_count == 1
     assert store.gtable.rep_expire[store.gtable.get("glob_k1")] > T0
 
     # Now the non-owner answers from the broadcast cache: remaining is
