@@ -253,14 +253,16 @@ def test_health_check_unhealthy_on_peer_failure(cluster, clock):
     peer, force a forwarded request to fail, health goes unhealthy with
     a connection error; restart recovers the cluster."""
     entry = cluster.daemons[1]
-    victim_idx = 2
-    victim_addr = cluster.daemons[victim_idx].peer_info.grpc_address
-    # find a key owned by the victim
-    key = None
+    # Pick any key owned by a daemon other than the entry: that owner
+    # becomes the victim (FNV-1 clusters common-prefix keys, so a fixed
+    # victim index may own none of them).
+    key = victim_idx = None
+    addr_to_idx = {d.peer_info.grpc_address: i for i, d in enumerate(cluster.daemons)}
     for i in range(200):
         k = f"hc_{i}"
-        if entry.service.get_peer(f"test_health_{k}").info.grpc_address == victim_addr:
-            key = k
+        addr = entry.service.get_peer(f"test_health_{k}").info.grpc_address
+        if addr != entry.peer_info.grpc_address:
+            key, victim_idx = k, addr_to_idx[addr]
             break
     assert key is not None
     cluster.daemons[victim_idx].close()
