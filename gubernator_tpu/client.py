@@ -16,17 +16,15 @@ import time
 from typing import List, Optional
 
 from .types import (
+    MILLISECOND,  # noqa: F401 — duration consts re-exported (client.go:30-34)
+    MINUTE,  # noqa: F401
+    SECOND,  # noqa: F401
     GetRateLimitsRequest,
     GetRateLimitsResponse,
     HealthCheckResponse,
     PeerInfo,
     RateLimitResponse,
 )
-
-# Duration constants in milliseconds (client.go:30-34).
-MILLISECOND = 1
-SECOND = 1000
-MINUTE = 60 * SECOND
 
 
 class V1Client:
@@ -40,14 +38,16 @@ class V1Client:
         self.timeout_s = timeout_s
         self.tls_context = tls_context
 
-    def _request(self, method: str, path: str, payload: Optional[dict] = None) -> dict:
+    def _connect(self):
         host, _, port = self.endpoint.partition(":")
         if self.tls_context is not None:
-            conn = http.client.HTTPSConnection(
+            return http.client.HTTPSConnection(
                 host, int(port or 443), timeout=self.timeout_s, context=self.tls_context
             )
-        else:
-            conn = http.client.HTTPConnection(host, int(port or 80), timeout=self.timeout_s)
+        return http.client.HTTPConnection(host, int(port or 80), timeout=self.timeout_s)
+
+    def _request(self, method: str, path: str, payload: Optional[dict] = None) -> dict:
+        conn = self._connect()
         try:
             body = json.dumps(payload).encode() if payload is not None else None
             conn.request(
@@ -73,8 +73,7 @@ class V1Client:
         return HealthCheckResponse.from_json(self._request("GET", "/v1/HealthCheck"))
 
     def metrics_text(self) -> str:
-        host, _, port = self.endpoint.partition(":")
-        conn = http.client.HTTPConnection(host, int(port), timeout=self.timeout_s)
+        conn = self._connect()
         try:
             conn.request("GET", "/metrics")
             return conn.getresponse().read().decode()

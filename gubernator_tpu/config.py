@@ -16,6 +16,7 @@ exactly like the reference's own test harness does
 from __future__ import annotations
 
 import os
+import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -75,20 +76,49 @@ def _env_int(env: Dict[str, str], name: str, default: int) -> int:
     return int(v) if v else default
 
 
+_DURATION_UNITS_S = {
+    "ns": 1e-9,
+    "us": 1e-6,
+    "µs": 1e-6,
+    "μs": 1e-6,
+    "ms": 1e-3,
+    "s": 1.0,
+    "m": 60.0,
+    "h": 3600.0,
+}
+
+_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|μs|ms|s|m|h)")
+
+
+def parse_duration(v: str) -> float:
+    """Go duration string -> seconds: '300ms', '1m30s', '1.5h', with the
+    same unit set as time.ParseDuration. A bare number is milliseconds."""
+    v = v.strip()
+    if not v:
+        raise ValueError("empty duration")
+    if re.fullmatch(r"\d+(?:\.\d+)?", v):
+        return float(v) / 1000.0
+    pos, total = 0, 0.0
+    for m in _DURATION_RE.finditer(v):
+        if m.start() != pos:
+            break
+        total += float(m.group(1)) * _DURATION_UNITS_S[m.group(2)]
+        pos = m.end()
+    if pos != len(v):
+        raise ValueError(f"invalid duration '{v}'")
+    return total
+
+
 def _env_float_ms(env: Dict[str, str], name: str, default_s: float) -> float:
-    """GUBER durations are Go duration strings in the reference; we accept
-    plain milliseconds or '<x>ms'/'<x>s' suffixes."""
+    """GUBER durations are Go duration strings in the reference
+    (config.go uses time.ParseDuration); a bare number means ms."""
     v = env.get(name, "")
     if not v:
         return default_s
-    v = v.strip()
-    if v.endswith("ms"):
-        return float(v[:-2]) / 1000.0
-    if v.endswith("us") or v.endswith("µs"):
-        return float(v[:-2]) / 1_000_000.0
-    if v.endswith("s"):
-        return float(v[:-1])
-    return float(v) / 1000.0
+    try:
+        return parse_duration(v)
+    except ValueError as e:
+        raise ValueError(f"{name}: {e}") from None
 
 
 def from_env_file(path: str) -> Dict[str, str]:

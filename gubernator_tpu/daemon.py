@@ -20,6 +20,7 @@ from .metrics import Metrics
 from .service import ServiceConfig, V1Service
 from .types import PeerInfo
 from .utils.clock import Clock, DEFAULT_CLOCK
+from .utils.net import resolve_host_ip
 
 
 class Daemon:
@@ -52,8 +53,10 @@ class Daemon:
             self.service, self.conf.listen_address, tls_context=server_tls
         )
         self.gateway.start()
-        # Port 0 resolves at bind time; advertise the real address.
-        self.service.conf.advertise_address = (
+        # Port 0 resolves at bind time; a wildcard host — bound OR
+        # explicitly configured — must be replaced by a routable IP
+        # before peers see it (net.go:12-33 via config.go:249).
+        self.service.conf.advertise_address = resolve_host_ip(
             self.conf.advertise_address or self.gateway.address
         )
 

@@ -63,6 +63,7 @@ class PeerClient:
         self._conn: Optional[http.client.HTTPConnection] = None
         self._queue: "queue.Queue[Tuple[RateLimitRequest, Future]]" = queue.Queue()
         self._shutdown = threading.Event()
+        self._err_lock = threading.Lock()
         self._last_err: Dict[str, float] = {}  # message -> expiry timestamp
         self._worker: Optional[threading.Thread] = None
         self._worker_lock = threading.Lock()
@@ -201,14 +202,16 @@ class PeerClient:
     def _set_last_err(self, msg: str) -> None:
         """Error LRU with TTL (peer_client.go:206-220); messages include
         the peer address for HealthCheck reporting."""
-        self._last_err[f"{msg} (peer: {self.info.grpc_address})"] = (
-            time.monotonic() + self.LAST_ERR_TTL_S
-        )
+        with self._err_lock:
+            self._last_err[f"{msg} (peer: {self.info.grpc_address})"] = (
+                time.monotonic() + self.LAST_ERR_TTL_S
+            )
 
     def get_last_err(self) -> List[str]:
         now = time.monotonic()
-        self._last_err = {m: t for m, t in self._last_err.items() if t > now}
-        return list(self._last_err.keys())
+        with self._err_lock:
+            self._last_err = {m: t for m, t in self._last_err.items() if t > now}
+            return list(self._last_err.keys())
 
     # ------------------------------------------------------------------
     def shutdown(self, timeout_s: float = 5.0) -> None:

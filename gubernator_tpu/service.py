@@ -14,7 +14,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Sequence
 
 from .config import MAX_BATCH_SIZE, BehaviorConfig
@@ -36,6 +36,7 @@ from .types import (
     set_behavior,
 )
 from .utils.clock import DEFAULT_CLOCK, Clock
+from .utils.interval import Interval
 
 HEALTHY = "healthy"
 UNHEALTHY = "unhealthy"
@@ -326,7 +327,7 @@ class V1Service:
         self._forward_pool.shutdown(wait=False)
         if self.conf.loader is not None:
             self.conf.loader.save(self.store.snapshot_items())
-        for peer in self.get_peer_list():
+        for peer in self.get_peer_list() + list(self.region_picker.peers()):
             if isinstance(peer, PeerClient):
                 peer.shutdown(timeout_s=1.0)
 
@@ -348,17 +349,18 @@ class GlobalManager:
 
     def __init__(self, service: V1Service):
         self.service = service
-        self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
+        self._stopped = False
+        self._interval = Interval(
+            service.conf.behaviors.global_sync_wait_s, self._tick
+        )
+        self._interval.next()
 
-    def _run(self) -> None:
-        wait = self.service.conf.behaviors.global_sync_wait_s
-        while not self._stop.wait(timeout=wait):
-            try:
-                self.run_once()
-            except Exception:  # noqa: BLE001 — pipeline must survive
-                pass
+    def _tick(self) -> None:
+        try:
+            self.run_once()
+        finally:
+            if not self._stopped:
+                self._interval.next()
 
     def run_once(self) -> None:
         svc = self.service
@@ -399,8 +401,8 @@ class GlobalManager:
             svc.metrics.broadcast_durations.observe(time.perf_counter() - start)
 
     def stop(self) -> None:
-        self._stop.set()
-        self._thread.join(timeout=1.0)
+        self._stopped = True
+        self._interval.stop()
 
 
 class MultiRegionManager:
@@ -413,9 +415,18 @@ class MultiRegionManager:
         self.service = service
         self._lock = threading.Lock()
         self._hits: Dict[str, RateLimitRequest] = {}
-        self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
+        self._stopped = False
+        self._interval = Interval(
+            service.conf.behaviors.multi_region_sync_wait_s, self._tick
+        )
+        self._interval.next()
+
+    def _tick(self) -> None:
+        try:
+            self.run_once()
+        finally:
+            if not self._stopped:
+                self._interval.next()
 
     def queue_hits(self, r: RateLimitRequest) -> None:
         """Aggregate by hash key, summing hits (multiregion.go:37-47)."""
@@ -423,19 +434,9 @@ class MultiRegionManager:
             key = r.hash_key()
             cur = self._hits.get(key)
             if cur is None:
-                from dataclasses import replace
-
                 self._hits[key] = replace(r)
             else:
                 cur.hits += r.hits
-
-    def _run(self) -> None:
-        wait = self.service.conf.behaviors.multi_region_sync_wait_s
-        while not self._stop.wait(timeout=wait):
-            try:
-                self.run_once()
-            except Exception:  # noqa: BLE001
-                pass
 
     def run_once(self) -> None:
         with self._lock:
@@ -446,12 +447,17 @@ class MultiRegionManager:
         my_dc = svc.conf.data_center
         by_peer: Dict[str, List[RateLimitRequest]] = {}
         clients: Dict[str, PeerClient] = {}
+        # Strip MULTI_REGION on the wire: the receiving region applies
+        # the hits but must not re-queue them, or two regions push the
+        # same hits back and forth forever (each origin already fans
+        # out to every other region itself).
         for key, r in hits.items():
+            wire = replace(r, behavior=set_behavior(r.behavior, Behavior.MULTI_REGION, False))
             for peer in svc.get_region_picker().get_clients(key):
                 if peer is None or peer.info.data_center == my_dc:
                     continue
                 addr = peer.info.grpc_address
-                by_peer.setdefault(addr, []).append(r)
+                by_peer.setdefault(addr, []).append(wire)
                 clients[addr] = peer
         for addr, reqs in by_peer.items():
             try:
@@ -463,5 +469,5 @@ class MultiRegionManager:
                 pass
 
     def stop(self) -> None:
-        self._stop.set()
-        self._thread.join(timeout=1.0)
+        self._stopped = True
+        self._interval.stop()

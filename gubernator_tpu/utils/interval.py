@@ -2,8 +2,8 @@
 
 `next()` arms the timer; `on_tick` fires once ~duration later.  Calls to
 `next()` while armed coalesce (the reference's 1-buffered channel with
-non-blocking send).  Drives every batch window: peer-client batching and
-the host-tier GLOBAL pipelines.
+non-blocking send).  Paces the host-tier GLOBAL and multi-region
+pipelines; the peer-client batch window is inlined in its queue loop.
 """
 
 from __future__ import annotations

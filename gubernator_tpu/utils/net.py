@@ -1,0 +1,46 @@
+"""Network discovery helpers (reference net.go).
+
+`resolve_host_ip` mirrors ResolveHostIP (net.go:12-33): when a daemon
+binds a wildcard address (0.0.0.0 / ::), the advertised peer address
+must be a routable interface IP, or every peer would "forward" to its
+own loopback and the ring would never agree on owners.
+"""
+
+from __future__ import annotations
+
+import socket
+
+
+def discover_ip() -> str:
+    """Best non-loopback IPv4 of this host (net.go:58-67).
+
+    The UDP connect never sends a packet; it only asks the kernel which
+    source interface routes toward a public address.
+    """
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            ip = s.getsockname()[0]
+            if not ip.startswith("127."):
+                return ip
+    except OSError:
+        pass
+    try:
+        for info in socket.getaddrinfo(socket.gethostname(), None, socket.AF_INET):
+            ip = info[4][0]
+            if not ip.startswith("127."):
+                return ip
+    except OSError:
+        pass
+    return "127.0.0.1"
+
+
+def resolve_host_ip(addr: str) -> str:
+    """Replace a wildcard host in 'host:port' with a routable IP
+    (net.go:12-33)."""
+    host, sep, port = addr.rpartition(":")
+    if not sep:
+        return addr
+    if host in ("", "0.0.0.0", "::", "[::]"):
+        return f"{discover_ip()}:{port}"
+    return addr

@@ -5,6 +5,7 @@ import pytest
 from gubernator_tpu.config import (
     MAX_BATCH_SIZE,
     from_env_file,
+    parse_duration,
     setup_daemon_config,
 )
 
@@ -66,3 +67,35 @@ def test_batch_limit_validation():
 def test_discovery_type_validation():
     with pytest.raises(ValueError, match="GUBER_PEER_DISCOVERY_TYPE is invalid"):
         setup_daemon_config(env={"GUBER_PEER_DISCOVERY_TYPE": "zookeeper"})
+
+
+def test_parse_duration_go_strings():
+    """Full Go time.ParseDuration unit set, incl. compound values."""
+    cases = {
+        "500ms": 0.5,
+        "500us": 0.0005,
+        "300ns": 3e-7,
+        "1m": 60.0,
+        "1m30s": 90.0,
+        "1.5h": 5400.0,
+        "2h45m": 9900.0,
+        "250": 0.25,  # bare number = milliseconds
+    }
+    for s, want in cases.items():
+        assert parse_duration(s) == pytest.approx(want), s
+
+
+def test_parse_duration_invalid_names_var():
+    with pytest.raises(ValueError, match="GUBER_GLOBAL_TIMEOUT"):
+        setup_daemon_config(env={"GUBER_GLOBAL_TIMEOUT": "fast"})
+    conf = setup_daemon_config(env={"GUBER_GLOBAL_TIMEOUT": "1m"})
+    assert conf.behaviors.global_timeout_s == pytest.approx(60.0)
+
+
+def test_resolve_host_ip():
+    from gubernator_tpu.utils.net import resolve_host_ip
+
+    assert resolve_host_ip("10.1.2.3:80") == "10.1.2.3:80"
+    host, _, port = resolve_host_ip("0.0.0.0:9090").rpartition(":")
+    assert port == "9090"
+    assert host not in ("", "0.0.0.0")

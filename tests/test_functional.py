@@ -137,9 +137,10 @@ def test_forwarding_sets_owner_metadata(cluster):
     """A key owned by a different daemon is forwarded; the response
     carries the owner's address (gubernator.go:190,209)."""
     entry = cluster.daemons[0]
-    # find a key NOT owned by daemon 0
+    # find a key NOT owned by daemon 0 (vary the PREFIX: FNV clusters
+    # common-prefix keys onto the same owner)
     for i in range(100):
-        key = f"fwd_{i}"
+        key = f"{i}_fwd"
         peer = entry.service.get_peer(f"test_forward_{key}")
         if not peer.info.is_owner:
             break
@@ -248,6 +249,48 @@ def test_multi_region_hits_propagate(cluster, clock):
     assert until_pass(landed)
 
 
+def test_multi_region_no_amplification(clock):
+    """Regression: with two NAMED regions, a MULTI_REGION hit pushed
+    cross-region must not be re-queued by the receiver, or the regions
+    ping-pong the same hits forever and drain the bucket."""
+    cl = Cluster().start_with(["region-us", "region-eu"], clock=clock)
+    try:
+        us, eu = cl.daemons
+        client = V1Client(us.peer_info.grpc_address)
+        rl = client.get_rate_limits(
+            GetRateLimitsRequest(
+                requests=[mk("test_amp", "account:1", hits=3, limit=100,
+                             duration=60 * SECOND, behavior=Behavior.MULTI_REGION)]
+            )
+        ).responses[0]
+        assert rl.error == ""
+        assert rl.remaining == 97
+
+        def eu_remaining():
+            resp = eu.service.get_peer_rate_limits(
+                GetRateLimitsRequest(
+                    requests=[mk("test_amp", "account:1", hits=0, limit=100,
+                                 duration=60 * SECOND)]
+                )
+            )
+            return resp.responses[0].remaining
+
+        assert until_pass(lambda: eu_remaining() == 97)
+        # Several sync windows later the count must be stable — not
+        # repeatedly re-applied by a cross-region echo.
+        time.sleep(0.5)
+        assert eu_remaining() == 97
+        us_resp = us.service.get_peer_rate_limits(
+            GetRateLimitsRequest(
+                requests=[mk("test_amp", "account:1", hits=0, limit=100,
+                             duration=60 * SECOND)]
+            )
+        )
+        assert us_resp.responses[0].remaining == 97
+    finally:
+        cl.stop()
+
+
 def test_health_check_unhealthy_on_peer_failure(cluster, clock):
     """TestHealthCheck (functional_test.go:715-782) simplified: kill a
     peer, force a forwarded request to fail, health goes unhealthy with
@@ -259,7 +302,7 @@ def test_health_check_unhealthy_on_peer_failure(cluster, clock):
     key = victim_idx = None
     addr_to_idx = {d.peer_info.grpc_address: i for i, d in enumerate(cluster.daemons)}
     for i in range(200):
-        k = f"hc_{i}"
+        k = f"{i}_hc"
         addr = entry.service.get_peer(f"test_health_{k}").info.grpc_address
         if addr != entry.peer_info.grpc_address:
             key, victim_idx = k, addr_to_idx[addr]
