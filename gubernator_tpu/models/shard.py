@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from .. import native
 from ..ops import buckets
 from ..types import (
     Algorithm,
@@ -32,15 +33,20 @@ from ..types import (
 from ..utils import gregorian
 from .slot_table import SlotTable
 
-# Batches are padded to one of these lane counts to bound XLA recompiles.
-_PAD_SIZES = (64, 256, 1024, 4096, 16384, 65536, 262144, 1048576)
+# Batches are padded to a power of FOUR >= 64: compiles are minutes on a
+# TPU tunnel while padded kernel lanes are microseconds, so few distinct
+# shapes beats tight padding (one compilation per size ever seen).
+_PAD_MIN = 64
+_PAD_MAX = 1 << 20
 
 
 def pad_size(n: int) -> int:
-    for p in _PAD_SIZES:
-        if n <= p:
-            return p
-    return ((n + _PAD_SIZES[-1] - 1) // _PAD_SIZES[-1]) * _PAD_SIZES[-1]
+    p = _PAD_MIN
+    while p < n and p < _PAD_MAX:
+        p <<= 2
+    if n <= p:
+        return p
+    return ((n + _PAD_MAX - 1) // _PAD_MAX) * _PAD_MAX
 
 
 @dataclass
@@ -65,6 +71,39 @@ class _Prepared:
     cached_hint: bool = False
 
 
+class GregResolver:
+    """Memoized Gregorian expiry/duration for one batch timestamp.
+
+    now is fixed for the whole batch, so the calendar math depends only
+    on req.duration — at most the 6 Gregorian interval kinds recur (the
+    host analogue of algorithms.go:90-95,140-145).  `resolve` returns
+    (expire_ms, duration_ms) or the GregorianError the reference would
+    surface as a per-request error.
+    """
+
+    def __init__(self, now_ms: int):
+        self.now_ms = now_ms
+        self._now_dt: Optional[_dt.datetime] = None
+        self._cache: Dict[int, object] = {}
+
+    def resolve(self, duration: int):
+        if self._now_dt is None:
+            self._now_dt = _dt.datetime.fromtimestamp(
+                self.now_ms / 1000.0, tz=_dt.timezone.utc
+            )
+        cached = self._cache.get(duration)
+        if cached is None:
+            try:
+                cached = (
+                    gregorian.gregorian_expiration(self._now_dt, duration),
+                    gregorian.gregorian_duration(self._now_dt, duration),
+                )
+            except gregorian.GregorianError as e:
+                cached = e
+            self._cache[duration] = cached
+        return cached
+
+
 def prepare_requests(
     requests: Sequence[RateLimitRequest],
     now_ms: int,
@@ -72,28 +111,16 @@ def prepare_requests(
     positions: Optional[Sequence[int]] = None,
 ) -> List[_Prepared]:
     """Precompute per-request host-side values (hash key, Gregorian
-    expiry/duration — the host analogue of algorithms.go:90-95,140-145).
-    Requests with invalid Gregorian durations get error responses
-    directly (reference returns the error per-request)."""
-    now_dt = _dt.datetime.fromtimestamp(now_ms / 1000.0, tz=_dt.timezone.utc)
-    # now_dt is fixed for the whole batch, so Gregorian math depends only
-    # on req.duration — memoize the (at most 6) distinct values.
-    greg_cache: Dict[int, object] = {}
+    expiry/duration).  Requests with invalid Gregorian durations get
+    error responses directly (reference returns the error per-request)."""
+    greg = GregResolver(now_ms)
     prepared: List[_Prepared] = []
 
     for i, req in enumerate(requests):
         pos = positions[i] if positions is not None else i
         p = _Prepared(pos=pos, slot=-1, exists=False, req=req, key=req.hash_key())
         if has_behavior(req.behavior, Behavior.DURATION_IS_GREGORIAN):
-            if req.duration not in greg_cache:
-                try:
-                    greg_cache[req.duration] = (
-                        gregorian.gregorian_expiration(now_dt, req.duration),
-                        gregorian.gregorian_duration(now_dt, req.duration),
-                    )
-                except gregorian.GregorianError as e:
-                    greg_cache[req.duration] = e
-            cached = greg_cache[req.duration]
+            cached = greg.resolve(req.duration)
             if isinstance(cached, gregorian.GregorianError):
                 responses[pos] = RateLimitResponse(error=str(cached))
                 continue
@@ -106,15 +133,18 @@ class RoundPlanner:
     """Splits a prepared request stream into kernel rounds.
 
     A round must have unique keys AND unique slots (the scatter is
-    race-free only then); a duplicate ends the round so the k-th request
-    for a key observes the (k-1)-th's committed state — the vectorized
-    equivalent of the reference's mutex serialization
-    (gubernator.go:336-337).  A slot collision can only happen when LRU
-    eviction under capacity pressure reuses a slot already scheduled in
-    the current round; the colliding request keeps its captured
-    (slot, exists) — re-resolving after the round would see the stale
-    mirror the evicted lane wrote — and runs next round, preserving
-    sequential evict-then-create semantics.
+    race-free only then).  Duplicates are skipped-and-deferred to a later
+    round so the k-th request for a key observes the (k-1)-th's committed
+    state — the vectorized equivalent of the reference's mutex
+    serialization (gubernator.go:336-337).  Cross-key order is NOT
+    preserved (matching the reference's arbitrary goroutine fan-out
+    order, gubernator.go:131-218), which keeps hot-key batches at
+    max-multiplicity rounds instead of one round per duplicate.  A slot
+    collision can only happen when LRU eviction under capacity pressure
+    reuses a slot already scheduled in the current round; the colliding
+    request keeps its captured (slot, exists) — re-resolving after the
+    round would see the stale mirror the evicted lane wrote — and runs
+    next round, preserving sequential evict-then-create semantics.
     """
 
     def __init__(
@@ -135,28 +165,68 @@ class RoundPlanner:
         cur: List[_Prepared] = []
         seen_keys: set = set()
         used_slots: set = set()
+        deferred: deque = deque()
         while self.queue:
-            p = self.queue[0]
+            p = self.queue.popleft()
             if p.cached_hint:
                 # Replica-cache lane: no local state touched, hit
                 # accumulation is scatter-add (duplicate-safe) — exempt
                 # from key/slot uniqueness.
                 p.slot, p.exists, p.resolved = -1, False, True
                 cur.append(p)
-                self.queue.popleft()
                 continue
             if p.key in seen_keys:
-                break  # duplicate key: must see this round's commit first
+                deferred.append(p)  # k-th occurrence waits for commit
+                continue
             if not p.resolved:
                 p.slot, p.exists = self.resolver(p)
                 p.resolved = True
             if p.slot in used_slots:
-                break  # eviction collision: run next round as-is
+                # Eviction collision: defer as-is; same-key successors
+                # must stay behind it.
+                deferred.append(p)
+                seen_keys.add(p.key)
+                continue
             cur.append(p)
             seen_keys.add(p.key)
             used_slots.add(p.slot)
-            self.queue.popleft()
+        self.queue = deferred
         return cur
+
+
+class _Columns:
+    """Request fields as contiguous arrays (one slot per valid lane)."""
+
+    __slots__ = ("algo", "behavior", "hits", "limit", "duration",
+                 "greg_expire", "greg_duration")
+
+    def __init__(self, n: int):
+        self.algo = np.empty(n, dtype=np.int32)
+        self.behavior = np.empty(n, dtype=np.int32)
+        self.hits = np.empty(n, dtype=np.int64)
+        self.limit = np.empty(n, dtype=np.int64)
+        self.duration = np.empty(n, dtype=np.int64)
+        self.greg_expire = np.zeros(n, dtype=np.int64)
+        self.greg_duration = np.zeros(n, dtype=np.int64)
+
+    def set(self, j: int, req: RateLimitRequest, ge: int, gd: int) -> None:
+        self.algo[j] = int(req.algorithm)
+        self.behavior[j] = int(req.behavior)
+        self.hits[j] = req.hits
+        self.limit[j] = req.limit
+        self.duration[j] = req.duration
+        self.greg_expire[j] = ge
+        self.greg_duration[j] = gd
+
+    def trim(self, m: int) -> None:
+        for f in self.__slots__:
+            setattr(self, f, getattr(self, f)[:m])
+
+
+def _pad(src: np.ndarray, padded: int, dtype) -> np.ndarray:
+    out = np.zeros(padded, dtype=dtype)
+    out[: len(src)] = src
+    return out
 
 
 def build_round_arrays(chunk: Sequence[_Prepared], padded: int) -> Tuple[np.ndarray, ...]:
@@ -197,9 +267,16 @@ class ShardStore:
         capacity: int = 50_000,
         device: Optional[jax.Device] = None,
         store=None,
+        use_native: bool = True,
     ):
         self.capacity = capacity
-        self.table = SlotTable(capacity)
+        # The C++ host runtime (native/host_runtime.cpp) handles key
+        # resolution + round planning at C speed; Python twin is the
+        # compiler-less fallback.
+        self._native = use_native and native.available()
+        self.table = (
+            native.NativeSlotTable(capacity) if self._native else SlotTable(capacity)
+        )
         self.device = device
         self.store = store
         # Serializes buffer-donating mutators for multi-threaded callers.
@@ -221,6 +298,9 @@ class ShardStore:
 
     def _apply_locked(self, requests, now_ms):
         responses: List[Optional[RateLimitResponse]] = [None] * len(requests)
+        if self._native and self.store is None:
+            self._apply_native(requests, now_ms, responses)
+            return [r if r is not None else RateLimitResponse() for r in responses]
         prepared = prepare_requests(requests, now_ms, responses)
         resolver = self._store_resolver(now_ms) if self.store is not None else None
         planner = RoundPlanner(self.table, prepared, now_ms, resolver=resolver)
@@ -230,6 +310,130 @@ class ShardStore:
                 break
             self._run_round(chunk, now_ms, responses)
         return [r if r is not None else RateLimitResponse() for r in responses]
+
+    # ------------------------------------------------------------------
+    # Native (C++) fast path: resolve + round-plan in host_runtime.cpp,
+    # column math in numpy, responses in one pass.
+    # ------------------------------------------------------------------
+    def _apply_native(self, requests, now_ms: int, responses) -> None:
+        n = len(requests)
+        keys: List[str] = []
+        vidx = np.empty(n, dtype=np.int64)
+        cols = _Columns(n)
+        greg = GregResolver(now_ms)
+        m = 0
+        for i, req in enumerate(requests):
+            ge = gd = 0
+            if has_behavior(req.behavior, Behavior.DURATION_IS_GREGORIAN):
+                cached = greg.resolve(req.duration)
+                if isinstance(cached, gregorian.GregorianError):
+                    responses[i] = RateLimitResponse(error=str(cached))
+                    continue
+                ge, gd = cached
+            keys.append(req.hash_key())
+            vidx[m] = i
+            cols.set(m, req, ge, gd)
+            m += 1
+        if m == 0:
+            return
+        cols.trim(m)
+        status, remaining, reset = self._run_columns(keys, cols, now_ms)
+        limit = cols.limit
+        for j in range(m):
+            responses[int(vidx[j])] = RateLimitResponse(
+                status=int(status[j]),
+                limit=int(limit[j]),
+                remaining=int(remaining[j]),
+                reset_time=int(reset[j]),
+            )
+
+    def _run_columns(self, keys: List[str], cols: "_Columns", now_ms: int):
+        """Round-planned kernel dispatch over pre-validated columns.
+        Returns (status, remaining, reset_time) arrays aligned to keys."""
+        n = len(keys)
+        out_status = np.zeros(n, dtype=np.int32)
+        out_rem = np.zeros(n, dtype=np.int64)
+        out_reset = np.zeros(n, dtype=np.int64)
+        planner = native.NativeBatchPlanner(self.table, keys, now_ms)
+        while True:
+            nxt = planner.next_round()
+            if nxt is None:
+                break
+            lane, slots, exists = nxt
+            m = len(lane)
+            padded = pad_size(m)
+            slot_col = np.full(padded, -1, dtype=np.int32)
+            slot_col[:m] = slots
+            ex_col = np.zeros(padded, dtype=bool)
+            ex_col[:m] = exists
+            batch = buckets.make_batch(
+                slot_col,
+                ex_col,
+                _pad(cols.algo[lane], padded, np.int32),
+                _pad(cols.behavior[lane], padded, np.int32),
+                _pad(cols.hits[lane], padded, np.int64),
+                _pad(cols.limit[lane], padded, np.int64),
+                _pad(cols.duration[lane], padded, np.int64),
+                _pad(cols.greg_expire[lane], padded, np.int64),
+                _pad(cols.greg_duration[lane], padded, np.int64),
+            )
+            self.state, out = buckets.apply_batch_jit(self.state, batch, now_ms)
+            out_exp = np.asarray(out.new_expire)
+            out_removed = np.asarray(out.removed)
+            planner.commit_round(out_exp[:m], out_removed[:m])
+            self.algo_mirror[slots] = cols.algo[lane]
+            out_status[lane] = np.asarray(out.status)[:m]
+            out_rem[lane] = np.asarray(out.remaining)[:m]
+            out_reset[lane] = np.asarray(out.reset_time)[:m]
+        return out_status, out_rem, out_reset
+
+    def apply_columns(
+        self,
+        keys: List[str],
+        algorithm,
+        behavior,
+        hits,
+        limit,
+        duration,
+        now_ms: int,
+        greg_expire=None,
+        greg_duration=None,
+    ):
+        """Columnar bulk API: the zero-dataclass ingress path.
+
+        `keys` are full hash keys (name + '_' + unique_key); the array
+        args align with them.  Gregorian expiry/duration must be
+        precomputed by the caller when DURATION_IS_GREGORIAN is set
+        (utils.gregorian).  Returns a dict of numpy arrays:
+        status/limit/remaining/reset_time.  Requires the native runtime
+        and no Store SPI (use `apply` otherwise).
+        """
+        if not (self._native and self.store is None):
+            raise RuntimeError(
+                "apply_columns requires the native host runtime and no Store SPI"
+            )
+        n = len(keys)
+        cols = _Columns(0)
+        cols.algo = np.ascontiguousarray(algorithm, dtype=np.int32)
+        cols.behavior = np.ascontiguousarray(behavior, dtype=np.int32)
+        cols.hits = np.ascontiguousarray(hits, dtype=np.int64)
+        cols.limit = np.ascontiguousarray(limit, dtype=np.int64)
+        cols.duration = np.ascontiguousarray(duration, dtype=np.int64)
+        z = np.zeros(n, dtype=np.int64)
+        cols.greg_expire = (
+            z if greg_expire is None else np.ascontiguousarray(greg_expire, np.int64)
+        )
+        cols.greg_duration = (
+            z if greg_duration is None else np.ascontiguousarray(greg_duration, np.int64)
+        )
+        with self._lock:
+            status, remaining, reset = self._run_columns(keys, cols, now_ms)
+        return {
+            "status": status,
+            "limit": cols.limit,
+            "remaining": remaining,
+            "reset_time": reset,
+        }
 
     # ------------------------------------------------------------------
     # Store SPI integration
@@ -244,7 +448,7 @@ class ShardStore:
         rows = item_to_rows(item)
         self.algo_mirror[slot] = int(rows.algo[0])
         self.state = buckets.write_rows(self.state, np.array([slot], np.int32), rows)
-        self.table.expire_ms[slot] = item.expire_at
+        self.table.set_expire(slot, item.expire_at)
 
     def load_item(self, item) -> None:
         """Loader.Load path: place one persisted item (gubernator.go:78-90)."""

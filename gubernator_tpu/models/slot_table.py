@@ -100,6 +100,9 @@ class SlotTable:
             else:
                 self.expire_ms[slot] = exp
 
+    def set_expire(self, slot: int, expire_ms: int) -> None:
+        self.expire_ms[slot] = expire_ms
+
     def remove_slot(self, slot: int) -> None:
         key = self._slot_to_key[slot]
         if key is None:

@@ -1,0 +1,266 @@
+"""ctypes loader for the C++ host runtime (host_runtime.cpp).
+
+Compiles the shared library on first import with g++ (cached next to
+the source, rebuilt when the source hash changes) and wraps it in
+Python classes with the same interface as the pure-Python twins
+(models/slot_table.py).  If no compiler is available the package
+falls back to the Python implementation — `available()` reports which
+path is active.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "host_runtime.cpp")
+_LIB_TMPL = os.path.join(_HERE, "_host_runtime_{digest}.so")
+
+_lib = None
+_lib_err: Optional[str] = None
+_build_lock = threading.Lock()
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _lib_err
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    lib_path = _LIB_TMPL.format(digest=digest)
+    if not os.path.exists(lib_path):
+        tmp = f"{lib_path}.{os.getpid()}.tmp"
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, lib_path)
+        except (OSError, subprocess.SubprocessError) as e:
+            _lib_err = f"native build failed: {e}"
+            return None
+    try:
+        lib = ctypes.CDLL(lib_path)
+    except OSError as e:
+        _lib_err = f"native load failed: {e}"
+        return None
+
+    c = ctypes
+    lib.gt_table_new.restype = c.c_void_p
+    lib.gt_table_new.argtypes = [c.c_int64]
+    lib.gt_table_free.argtypes = [c.c_void_p]
+    lib.gt_table_len.restype = c.c_int64
+    lib.gt_table_len.argtypes = [c.c_void_p]
+    lib.gt_table_stats.argtypes = [c.c_void_p, c.POINTER(c.c_int64)]
+    lib.gt_table_get_slot.restype = c.c_int32
+    lib.gt_table_get_slot.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+    lib.gt_table_lookup_or_assign.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_int64, c.c_int64,
+        c.POINTER(c.c_int32), c.POINTER(c.c_uint8),
+    ]
+    lib.gt_table_remove.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+    lib.gt_table_set_expire.argtypes = [c.c_void_p, c.c_int32, c.c_int64]
+    lib.gt_table_commit.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64]
+    lib.gt_table_commit_keys.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64,
+    ]
+    lib.gt_table_keys_size.argtypes = [c.c_void_p, c.POINTER(c.c_int64), c.POINTER(c.c_int64)]
+    lib.gt_table_keys.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p]
+    lib.gt_batch_begin.restype = c.c_void_p
+    lib.gt_batch_begin.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64, c.c_int64]
+    lib.gt_batch_next_round.restype = c.c_int64
+    lib.gt_batch_next_round.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p]
+    lib.gt_batch_commit_round.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
+    lib.gt_batch_free.argtypes = [c.c_void_p]
+    lib.gt_fnv1_batch.argtypes = [c.c_void_p, c.c_void_p, c.c_int64, c.c_int32, c.c_void_p]
+    return lib
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is None and _lib_err is None:
+        with _build_lock:
+            if _lib is None and _lib_err is None:
+                _lib = _build()
+    return _lib
+
+
+def available() -> bool:
+    return _get_lib() is not None
+
+
+def build_error() -> Optional[str]:
+    _get_lib()
+    return _lib_err
+
+
+def pack_keys(keys) -> Tuple[np.ndarray, np.ndarray]:
+    """Concatenate utf-8 keys into (bytes buffer, offsets[n+1])."""
+    bs = [k.encode("utf-8") if isinstance(k, str) else k for k in keys]
+    offsets = np.zeros(len(bs) + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in bs], out=offsets[1:])
+    return np.frombuffer(b"".join(bs), dtype=np.uint8), offsets
+
+
+def fnv1_batch(keys, variant_1a: bool = False) -> np.ndarray:
+    """Batch FNV-1/1a 64 hash (replicated_hash.go:31); pure-Python
+    fallback when the native build is unavailable."""
+    lib = _get_lib()
+    out = np.empty(len(keys), dtype=np.uint64)
+    if len(keys) == 0:
+        return out
+    if lib is None:
+        from ..utils import hashing
+
+        fn = hashing.fnv1a_64 if variant_1a else hashing.fnv1_64
+        for i, k in enumerate(keys):
+            out[i] = fn(k.encode("utf-8") if isinstance(k, str) else k)
+        return out
+    buf, offsets = pack_keys(keys)
+    lib.gt_fnv1_batch(
+        buf.ctypes.data, offsets.ctypes.data, len(keys),
+        1 if variant_1a else 0, out.ctypes.data,
+    )
+    return out
+
+
+class NativeSlotTable:
+    """Drop-in for models.slot_table.SlotTable backed by the C++ table.
+
+    Same semantics: strict expiry (cache.go:151), same-slot recycling on
+    expiry (cache.go:138-163), LRU eviction at capacity (cache.go:115-130).
+    """
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError(_lib_err or "native runtime unavailable")
+        self._lib = lib
+        self.capacity = capacity
+        self._ptr = lib.gt_table_new(capacity)
+
+    def __del__(self):
+        ptr = getattr(self, "_ptr", None)
+        if ptr:
+            self._lib.gt_table_free(ptr)
+            self._ptr = None
+
+    def __len__(self) -> int:
+        return int(self._lib.gt_table_len(self._ptr))
+
+    # -- stats (hit/miss/eviction counters for metrics parity) ---------
+    @property
+    def _stats(self):
+        out = (ctypes.c_int64 * 3)()
+        self._lib.gt_table_stats(self._ptr, out)
+        return int(out[0]), int(out[1]), int(out[2])
+
+    @property
+    def hits(self) -> int:
+        return self._stats[0]
+
+    @property
+    def misses(self) -> int:
+        return self._stats[1]
+
+    @property
+    def evictions(self) -> int:
+        return self._stats[2]
+
+    # ------------------------------------------------------------------
+    def get_slot(self, key: str) -> Optional[int]:
+        b = key.encode("utf-8")
+        s = self._lib.gt_table_get_slot(self._ptr, b, len(b))
+        return None if s < 0 else int(s)
+
+    def lookup_or_assign(self, key: str, now_ms: int) -> Tuple[int, bool]:
+        b = key.encode("utf-8")
+        slot = ctypes.c_int32()
+        exists = ctypes.c_uint8()
+        self._lib.gt_table_lookup_or_assign(
+            self._ptr, b, len(b), now_ms, ctypes.byref(slot), ctypes.byref(exists)
+        )
+        return int(slot.value), bool(exists.value)
+
+    def remove(self, key: str) -> None:
+        b = key.encode("utf-8")
+        self._lib.gt_table_remove(self._ptr, b, len(b))
+
+    def set_expire(self, slot: int, expire_ms: int) -> None:
+        self._lib.gt_table_set_expire(self._ptr, slot, expire_ms)
+
+    def commit(self, slots, new_expire_ms, removed, keys=None) -> None:
+        slots = np.ascontiguousarray(slots, dtype=np.int32)
+        expire = np.ascontiguousarray(new_expire_ms, dtype=np.int64)
+        rm = np.ascontiguousarray(removed, dtype=np.uint8)
+        if keys is not None:
+            # Staleness-guarded commit (slot_table.py::commit keys check).
+            buf, offsets = pack_keys(keys)
+            self._lib.gt_table_commit_keys(
+                self._ptr, slots.ctypes.data, expire.ctypes.data, rm.ctypes.data,
+                buf.ctypes.data if len(buf) else None, offsets.ctypes.data, len(slots),
+            )
+            return
+        self._lib.gt_table_commit(
+            self._ptr, slots.ctypes.data, expire.ctypes.data, rm.ctypes.data, len(slots)
+        )
+
+    def keys(self) -> List[str]:
+        count = ctypes.c_int64()
+        total = ctypes.c_int64()
+        self._lib.gt_table_keys_size(self._ptr, ctypes.byref(count), ctypes.byref(total))
+        n, nb = int(count.value), int(total.value)
+        if n == 0:
+            return []
+        slots = np.empty(n, dtype=np.int32)
+        offsets = np.empty(n + 1, dtype=np.int64)
+        buf = ctypes.create_string_buffer(max(nb, 1))
+        self._lib.gt_table_keys(self._ptr, slots.ctypes.data, offsets.ctypes.data, buf)
+        raw = buf.raw[:nb]
+        return [raw[offsets[i]:offsets[i + 1]].decode("utf-8") for i in range(n)]
+
+
+class NativeBatchPlanner:
+    """Round planner over a NativeSlotTable: resolve + split a whole key
+    batch into race-free kernel rounds in C++ (shard.py::RoundPlanner).
+    """
+
+    def __init__(self, table: NativeSlotTable, keys, now_ms: int):
+        self._lib = table._lib
+        self._table = table
+        self.n = len(keys)
+        self._buf, self._offsets = pack_keys(keys)
+        self._ptr = self._lib.gt_batch_begin(
+            table._ptr, self._buf.ctypes.data if self.n else None,
+            self._offsets.ctypes.data, self.n, now_ms,
+        )
+        self._lane = np.empty(max(self.n, 1), dtype=np.int32)
+        self._slot = np.empty(max(self.n, 1), dtype=np.int32)
+        self._exists = np.empty(max(self.n, 1), dtype=np.uint8)
+
+    def __del__(self):
+        ptr = getattr(self, "_ptr", None)
+        if ptr:
+            self._lib.gt_batch_free(ptr)
+            self._ptr = None
+
+    def next_round(self):
+        """Returns (lane_idx, slots, exists) views for the next round, or
+        None when the batch is exhausted."""
+        m = self._lib.gt_batch_next_round(
+            self._ptr, self._lane.ctypes.data, self._slot.ctypes.data,
+            self._exists.ctypes.data,
+        )
+        if m == 0:
+            return None
+        return self._lane[:m], self._slot[:m], self._exists[:m].astype(bool)
+
+    def commit_round(self, new_expire_ms, removed) -> None:
+        expire = np.ascontiguousarray(new_expire_ms, dtype=np.int64)
+        rm = np.ascontiguousarray(removed, dtype=np.uint8)
+        self._lib.gt_batch_commit_round(self._ptr, expire.ctypes.data, rm.ctypes.data)

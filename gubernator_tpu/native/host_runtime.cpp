@@ -1,0 +1,337 @@
+// Native host runtime: key -> device-slot table + batch round planner.
+//
+// This is the C++ twin of models/slot_table.py (the reference's LRU
+// cache role, cache.go:52-218) plus the round-planning loop of
+// models/shard.py::RoundPlanner. The TPU kernel wants whole batches of
+// unique (key, slot) lanes; the host must resolve string keys to dense
+// slots, keep LRU order for eviction, mirror expiry (expiry == miss,
+// cache.go:138-163), and split duplicate-key batches into sequential
+// rounds (the vectorized equivalent of the reference's mutex
+// serialization, gubernator.go:336-337). All of that is pure pointer
+// chasing that Python does 50-100x slower than C++ — this module exists
+// so the device kernel, not the host, is the bottleneck.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+// Thread-safety contract: one ShardStore lock guards each table, same
+// as the Python twin; no internal locking here.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Table {
+  int64_t capacity;
+  // slot -> key (empty string + mapped=false when free)
+  std::vector<std::string> slot_key;
+  std::vector<uint8_t> slot_mapped;
+  std::vector<int64_t> expire_ms;
+  // LRU intrusive list over slots; head = least recent. -1 = null.
+  std::vector<int32_t> lru_prev, lru_next;
+  int32_t lru_head = -1, lru_tail = -1;
+  std::vector<int32_t> free_slots;  // stack, top = back
+  std::unordered_map<std::string, int32_t> key_to_slot;
+  int64_t hits = 0, misses = 0, evictions = 0;
+
+  explicit Table(int64_t cap)
+      : capacity(cap),
+        slot_key(cap),
+        slot_mapped(cap, 0),
+        expire_ms(cap, 0),
+        lru_prev(cap, -1),
+        lru_next(cap, -1) {
+    free_slots.reserve(cap);
+    for (int64_t i = cap - 1; i >= 0; --i) free_slots.push_back((int32_t)i);
+    key_to_slot.reserve((size_t)cap * 2);
+  }
+
+  void lru_unlink(int32_t s) {
+    int32_t p = lru_prev[s], n = lru_next[s];
+    if (p >= 0) lru_next[p] = n; else if (lru_head == s) lru_head = n;
+    if (n >= 0) lru_prev[n] = p; else if (lru_tail == s) lru_tail = p;
+    lru_prev[s] = lru_next[s] = -1;
+  }
+
+  void lru_push_back(int32_t s) {  // most recently used
+    lru_prev[s] = lru_tail;
+    lru_next[s] = -1;
+    if (lru_tail >= 0) lru_next[lru_tail] = s;
+    lru_tail = s;
+    if (lru_head < 0) lru_head = s;
+  }
+
+  void touch(int32_t s) {
+    if (lru_tail == s) return;
+    lru_unlink(s);
+    lru_push_back(s);
+  }
+
+  void unmap_slot(int32_t s) {
+    if (!slot_mapped[s]) return;
+    key_to_slot.erase(slot_key[s]);
+    slot_key[s].clear();
+    slot_mapped[s] = 0;
+    expire_ms[s] = 0;
+    lru_unlink(s);
+    free_slots.push_back(s);
+  }
+
+  // (slot, exists): exists=false means kernel treats as fresh create.
+  // Mirrors slot_table.py::lookup_or_assign exactly.
+  std::pair<int32_t, bool> lookup_or_assign(const char* key, size_t len,
+                                            int64_t now_ms) {
+    std::string k(key, len);
+    auto it = key_to_slot.find(k);
+    if (it != key_to_slot.end()) {
+      int32_t s = it->second;
+      touch(s);
+      if (expire_ms[s] >= now_ms) {  // strict expiry (cache.go:151)
+        ++hits;
+        return {s, true};
+      }
+      ++misses;  // expired: recycle same slot in place
+      return {s, false};
+    }
+    ++misses;
+    int32_t s;
+    if (!free_slots.empty()) {
+      s = free_slots.back();
+      free_slots.pop_back();
+    } else {
+      s = lru_head;  // evict LRU (cache.go:115-130)
+      lru_unlink(s);
+      key_to_slot.erase(slot_key[s]);
+      slot_mapped[s] = 0;
+      ++evictions;
+    }
+    key_to_slot.emplace(std::move(k), s);
+    slot_key[s].assign(key, len);
+    slot_mapped[s] = 1;
+    expire_ms[s] = 0;
+    lru_push_back(s);
+    return {s, false};
+  }
+};
+
+struct Batch {
+  Table* table;
+  const char* keys;        // concatenated key bytes (borrowed)
+  const int64_t* offsets;  // n+1 offsets into keys (borrowed)
+  int64_t n;
+  int64_t now_ms;
+  // Lanes not yet scheduled, in request order (per-key order is what
+  // matters; cross-key order is free, as in the reference's goroutine
+  // fan-out).
+  std::vector<int32_t> pending;
+  // per-lane resolution cache (a deferred lane keeps its captured slot)
+  std::vector<int32_t> slot;
+  std::vector<uint8_t> exists, resolved;
+  // last emitted round
+  std::vector<int32_t> round_lane;
+
+  Batch(Table* t, const char* k, const int64_t* off, int64_t n_, int64_t now)
+      : table(t), keys(k), offsets(off), n(n_), now_ms(now),
+        slot(n_, -1), exists(n_, 0), resolved(n_, 0) {
+    pending.reserve(n_);
+    for (int64_t i = 0; i < n_; ++i) pending.push_back((int32_t)i);
+  }
+
+  const char* key_ptr(int64_t i) const { return keys + offsets[i]; }
+  size_t key_len(int64_t i) const { return (size_t)(offsets[i + 1] - offsets[i]); }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* gt_table_new(int64_t capacity) { return new Table(capacity); }
+void gt_table_free(void* t) { delete (Table*)t; }
+int64_t gt_table_len(void* t) { return (int64_t)((Table*)t)->key_to_slot.size(); }
+
+void gt_table_stats(void* tv, int64_t* out) {  // hits, misses, evictions
+  Table* t = (Table*)tv;
+  out[0] = t->hits; out[1] = t->misses; out[2] = t->evictions;
+}
+
+int32_t gt_table_get_slot(void* tv, const char* key, int64_t len) {
+  Table* t = (Table*)tv;
+  auto it = t->key_to_slot.find(std::string(key, (size_t)len));
+  return it == t->key_to_slot.end() ? -1 : it->second;
+}
+
+// Single-key resolve (Store-SPI path drives lookups one at a time).
+void gt_table_lookup_or_assign(void* tv, const char* key, int64_t len,
+                               int64_t now_ms, int32_t* out_slot,
+                               uint8_t* out_exists) {
+  auto [s, e] = ((Table*)tv)->lookup_or_assign(key, (size_t)len, now_ms);
+  *out_slot = s;
+  *out_exists = e ? 1 : 0;
+}
+
+void gt_table_remove(void* tv, const char* key, int64_t len) {
+  Table* t = (Table*)tv;
+  auto it = t->key_to_slot.find(std::string(key, (size_t)len));
+  if (it != t->key_to_slot.end()) t->unmap_slot(it->second);
+}
+
+void gt_table_set_expire(void* tv, int32_t slot, int64_t expire) {
+  ((Table*)tv)->expire_ms[slot] = expire;
+}
+
+// Fold kernel outputs back (slot_table.py::commit): slots<0 skipped.
+void gt_table_commit(void* tv, const int32_t* slots, const int64_t* expire,
+                     const uint8_t* removed, int64_t n) {
+  Table* t = (Table*)tv;
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t s = slots[i];
+    if (s < 0) continue;
+    if (removed[i]) t->unmap_slot(s);
+    else t->expire_ms[s] = expire[i];
+  }
+}
+
+// Commit with the staleness guard (slot_table.py::commit keys check): a
+// lane whose slot was remapped to a different key after scheduling (LRU
+// eviction mid-batch) must not touch the slot's new owner. Used by the
+// Python round loop (Store-SPI path); the planner path enforces this
+// per-round in gt_batch_commit_round.
+void gt_table_commit_keys(void* tv, const int32_t* slots,
+                          const int64_t* expire, const uint8_t* removed,
+                          const char* keys, const int64_t* offsets,
+                          int64_t n) {
+  Table* t = (Table*)tv;
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t s = slots[i];
+    if (s < 0) continue;
+    size_t len = (size_t)(offsets[i + 1] - offsets[i]);
+    if (!t->slot_mapped[s] ||
+        t->slot_key[s].compare(0, std::string::npos, keys + offsets[i], len) != 0)
+      continue;  // slot remapped mid-batch; this lane is stale
+    if (removed[i]) t->unmap_slot(s);
+    else t->expire_ms[s] = expire[i];
+  }
+}
+
+// Snapshot protocol: first call gt_table_keys_size for total bytes, then
+// gt_table_keys to fill (slots, offsets[count+1], bytes).
+void gt_table_keys_size(void* tv, int64_t* count, int64_t* total_bytes) {
+  Table* t = (Table*)tv;
+  *count = (int64_t)t->key_to_slot.size();
+  int64_t bytes = 0;
+  for (auto& kv : t->key_to_slot) bytes += (int64_t)kv.first.size();
+  *total_bytes = bytes;
+}
+
+void gt_table_keys(void* tv, int32_t* slots, int64_t* offsets, char* bytes) {
+  Table* t = (Table*)tv;
+  int64_t i = 0, off = 0;
+  for (auto& kv : t->key_to_slot) {
+    slots[i] = kv.second;
+    offsets[i] = off;
+    std::memcpy(bytes + off, kv.first.data(), kv.first.size());
+    off += (int64_t)kv.first.size();
+    ++i;
+  }
+  offsets[i] = off;
+}
+
+void* gt_batch_begin(void* tv, const char* keys, const int64_t* offsets,
+                     int64_t n, int64_t now_ms) {
+  return new Batch((Table*)tv, keys, offsets, n, now_ms);
+}
+
+// Emit the next round: walk the pending lanes in request order, taking
+// every lane whose key AND slot are not yet used this round; duplicates
+// stay pending for a later round (skip-and-defer). The k-th request for
+// a key still observes the (k-1)-th's committed state — per-key order
+// is preserved because the earlier occurrence is always taken first —
+// while hot-key batches need only max-multiplicity rounds instead of
+// one round per duplicate. Returns lane count m; fills lane_idx
+// (original positions), slots, exists.
+int64_t gt_batch_next_round(void* bv, int32_t* lane_idx, int32_t* slots,
+                            uint8_t* exists) {
+  Batch* b = (Batch*)bv;
+  Table* t = b->table;
+  if (b->pending.empty()) return 0;
+  std::unordered_map<std::string, int> seen_keys;
+  std::unordered_map<int32_t, int> used_slots;
+  seen_keys.reserve(b->pending.size() * 2);
+  used_slots.reserve(b->pending.size() * 2);
+  b->round_lane.clear();
+  std::vector<int32_t> deferred;
+  int64_t m = 0;
+  for (int32_t i : b->pending) {
+    std::string k(b->key_ptr(i), b->key_len(i));
+    if (seen_keys.count(k)) {  // duplicate: must see this round's commit
+      deferred.push_back(i);
+      continue;
+    }
+    if (!b->resolved[i]) {
+      auto [s, e] = t->lookup_or_assign(b->key_ptr(i), b->key_len(i), b->now_ms);
+      b->slot[i] = s;
+      b->exists[i] = e ? 1 : 0;
+      b->resolved[i] = 1;
+    }
+    if (used_slots.count(b->slot[i])) {  // eviction collision: defer as-is
+      deferred.push_back(i);
+      seen_keys.emplace(std::move(k), 1);  // later same-key lanes defer too
+      continue;
+    }
+    lane_idx[m] = i;
+    slots[m] = b->slot[i];
+    exists[m] = b->exists[i];
+    b->round_lane.push_back(i);
+    seen_keys.emplace(std::move(k), 1);
+    used_slots.emplace(b->slot[i], 1);
+    ++m;
+  }
+  b->pending.swap(deferred);
+  return m;
+}
+
+// Commit kernel outputs for the lanes of the LAST emitted round.
+void gt_batch_commit_round(void* bv, const int64_t* new_expire,
+                           const uint8_t* removed) {
+  Batch* b = (Batch*)bv;
+  Table* t = b->table;
+  for (size_t j = 0; j < b->round_lane.size(); ++j) {
+    int32_t i = b->round_lane[j];
+    int32_t s = b->slot[i];
+    if (s < 0) continue;
+    // Staleness guard (slot_table.py::commit keys check): only commit
+    // if the slot still maps this lane's key.
+    if (!t->slot_mapped[s] ||
+        t->slot_key[s].compare(0, std::string::npos, b->key_ptr(i),
+                               b->key_len(i)) != 0)
+      continue;
+    if (removed[j]) t->unmap_slot(s);
+    else t->expire_ms[s] = new_expire[j];
+  }
+}
+
+void gt_batch_free(void* bv) { delete (Batch*)bv; }
+
+// ---------------------------------------------------------------------
+// FNV-1 / FNV-1a 64 over a packed key batch (replicated_hash.go:31 uses
+// fasthash/fnv1; host-side ring lookups hash every key of every batch).
+void gt_fnv1_batch(const char* keys, const int64_t* offsets, int64_t n,
+                   int32_t variant_1a, uint64_t* out) {
+  const uint64_t kOffset = 14695981039346656037ull;
+  const uint64_t kPrime = 1099511628211ull;
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t h = kOffset;
+    const unsigned char* p = (const unsigned char*)(keys + offsets[i]);
+    const unsigned char* end = (const unsigned char*)(keys + offsets[i + 1]);
+    if (variant_1a) {
+      for (; p < end; ++p) { h ^= (uint64_t)*p; h *= kPrime; }
+    } else {
+      for (; p < end; ++p) { h *= kPrime; h ^= (uint64_t)*p; }
+    }
+    out[i] = h;
+  }
+}
+
+}  // extern "C"

@@ -114,6 +114,39 @@ def _muldiv128(a, b, d):
     return q.astype(_I64), r.astype(_I64)
 
 
+def _leak_amounts(el_c, lim_nn, rn):
+    """Exact (floor(el*lim/rn), floor((el*lim mod rn) * SCALE / rn)).
+
+    Fast path (pure int64, no loop): decompose lim = qL*rn + rL, so
+    el*lim/rn = el*qL + el*rL/rn.  el <= rn (callers clip), hence
+    el*qL <= lim fits; el*rL fits whenever el <= MAX64/rL.  That covers
+    every realistic config (any duration < ~24.8 days, or any
+    limit%duration small); only when BOTH duration > 2**31.5 ms AND
+    elapsed*remainder actually overflow does the whole batch fall back
+    to the 128-bit long-division loop (_muldiv128) via lax.cond — the
+    branch is data-dependent, so the loop costs nothing when unused.
+    """
+    qL = lim_nn // rn
+    rL = lim_nn % rn
+    max64 = jnp.asarray((1 << 63) - 1, _I64)
+    safe_rl = jnp.maximum(rL, 1)
+    ok = ((rL == 0) | (el_c <= max64 // safe_rl)) & (rn < (1 << 43))
+
+    def fast(_):
+        prod = el_c * rL
+        lw = el_c * qL + prod // rn
+        lr = prod % rn
+        frac = (lr * LEAKY_SCALE) // rn
+        return lw, frac
+
+    def slow(_):
+        lw, lr = _muldiv128(el_c, lim_nn, rn)
+        frac, _ = _muldiv128(lr, jnp.full_like(lr, LEAKY_SCALE), rn)
+        return lw, frac
+
+    return jax.lax.cond(jnp.all(ok), fast, slow, None)
+
+
 class BucketState(NamedTuple):
     """Struct-of-arrays bucket table for one shard (capacity C).
 
@@ -287,9 +320,8 @@ def apply_batch(state: BucketState, req: RequestBatch, now_ms) -> "tuple[BucketS
     rn = jnp.maximum(rate_num, 1)  # duration<=0 degenerates to instant refill
     el_c = jnp.clip(elapsed, 0, rn)  # leak can't exceed one full refill
     lim_nn = jnp.maximum(req.limit, 0)
-    # leak = elapsed * limit / duration, overflow-safe (see _muldiv128).
-    leak_whole, leak_rem = _muldiv128(el_c, lim_nn, rn)
-    leak_frac, _ = _muldiv128(leak_rem, jnp.full_like(leak_rem, LEAKY_SCALE), rn)
+    # leak = elapsed * limit / duration, exact + overflow-safe.
+    leak_whole, leak_frac = _leak_amounts(el_c, lim_nn, rn)
     leak_s = leak_whole * LEAKY_SCALE + leak_frac
     do_leak = leak_whole > 0  # only whole tokens trigger (algorithms.go:238-241)
     l_rem = jnp.where(do_leak, l_rem + leak_s, l_rem)

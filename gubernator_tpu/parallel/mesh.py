@@ -67,6 +67,62 @@ def shard_of_key(key: str, n_shards: int) -> int:
     return hashing.hash_string_64(key) % n_shards
 
 
+@partial(jax.jit, donate_argnums=(0, 1))
+def _answer_jit(state, gcols, batch, extra, now):
+    return jax.vmap(global_ops.answer_batch, in_axes=(0, 0, 0, 0, None))(
+        state, gcols, batch, extra, now
+    )
+
+
+@partial(jax.jit, donate_argnums=0)
+def _set_replica_jit(gcols, gslots, status, limit, remaining, reset):
+    return jax.vmap(
+        global_ops.set_replica, in_axes=(0, None, None, None, None, None)
+    )(gcols, gslots, status, limit, remaining, reset)
+
+
+@partial(jax.jit, donate_argnums=0)
+def _clear_jit(gcols, idx):
+    return jax.vmap(global_ops.clear_gslots, in_axes=(0, None))(gcols, idx)
+
+
+@partial(jax.jit, donate_argnums=0)
+def _write_row_jit(state, s, slot, rows):
+    # Donated single-row scatter: store-miss injection / loader placement
+    # without copying the whole [S, C] state.
+    return jax.tree.map(lambda col, val: col.at[s, slot].set(val[0]), state, rows)
+
+
+_SYNC_FN_CACHE: dict = {}
+
+
+def _get_sync_fn(mesh: Mesh, axis: str):
+    """One compiled GLOBAL-sync collective program per (mesh, axis)."""
+    key = (mesh, axis)
+    fn = _SYNC_FN_CACHE.get(key)
+    if fn is None:
+
+        def _sync_body(state, gcols, cfg, dirty, now):
+            sq = lambda t: jax.tree.map(lambda a: a[0], t)
+            ns, ngc, out, applied, total = global_ops.global_sync(
+                sq(state), sq(gcols), cfg, dirty[0], now, axis=axis
+            )
+            ex = lambda t: jax.tree.map(lambda a: a[None], t)
+            return ex(ns), ex(ngc), ex(out), applied[None], total[None]
+
+        fn = jax.jit(
+            shard_map(
+                _sync_body,
+                mesh=mesh,
+                in_specs=(P(axis), P(axis), P(), P(axis), P()),
+                out_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
+            ),
+            donate_argnums=(0, 1),
+        )
+        _SYNC_FN_CACHE[key] = fn
+    return fn
+
+
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None, axis: str = "shard") -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     return Mesh(np.array(devices), (axis,))
@@ -145,57 +201,14 @@ class MeshBucketStore:
         self.state = self._stack_and_shard(buckets.init_state(capacity_per_shard))
         self.gcols = self._stack_and_shard(global_ops.init_global_columns(g_capacity))
 
-        axis = self.axis
-
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def _answer(state, gcols, batch, extra, now):
-            return jax.vmap(global_ops.answer_batch, in_axes=(0, 0, 0, 0, None))(
-                state, gcols, batch, extra, now
-            )
-
-        self._answer_fn = _answer
-
-        def _sync_body(state, gcols, cfg, dirty, now):
-            sq = lambda t: jax.tree.map(lambda a: a[0], t)
-            ns, ngc, out, applied, total = global_ops.global_sync(
-                sq(state), sq(gcols), cfg, dirty[0], now, axis=axis
-            )
-            ex = lambda t: jax.tree.map(lambda a: a[None], t)
-            return ex(ns), ex(ngc), ex(out), applied[None], total[None]
-
-        self._sync_fn = jax.jit(
-            shard_map(
-                _sync_body,
-                mesh=self.mesh,
-                in_specs=(P(axis), P(axis), P(), P(axis), P()),
-                out_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
-            ),
-            donate_argnums=(0, 1),
-        )
-
-        @partial(jax.jit, donate_argnums=0)
-        def _set_replica(gcols, gslots, status, limit, remaining, reset):
-            return jax.vmap(
-                global_ops.set_replica, in_axes=(0, None, None, None, None, None)
-            )(gcols, gslots, status, limit, remaining, reset)
-
-        self._set_replica_fn = _set_replica
-
-        @partial(jax.jit, donate_argnums=0)
-        def _clear(gcols, idx):
-            return jax.vmap(global_ops.clear_gslots, in_axes=(0, None))(gcols, idx)
-
-        self._clear_fn = _clear
-
-        @partial(jax.jit, donate_argnums=0)
-        def _write_row(state, s, slot, rows):
-            # Donated single-row scatter: store-miss injection / loader
-            # placement without copying the whole [S, C] state.
-            return jax.tree.map(
-                lambda col, val: col.at[s, slot].set(val[0]), state, rows
-            )
-
-        self._write_row_fn = _write_row
+        # Jitted programs are MODULE-level (or cached per mesh) so every
+        # store/daemon in a process shares one XLA compilation cache —
+        # per-instance closures would recompile everything per daemon.
+        self._answer_fn = _answer_jit
+        self._sync_fn = _get_sync_fn(self.mesh, self.axis)
+        self._set_replica_fn = _set_replica_jit
+        self._clear_fn = _clear_jit
+        self._write_row_fn = _write_row_jit
 
     def _stack_and_shard(self, single):
         stacked = jax.tree.map(
@@ -331,7 +344,7 @@ class MeshBucketStore:
         self.state = self._write_row_fn(
             self.state, np.int32(s), np.int32(slot), rows
         )
-        self.tables[s].expire_ms[slot] = item.expire_at
+        self.tables[s].set_expire(slot, item.expire_at)
 
     def _read_shard_rows(self, s: int, slots):
         idx = np.asarray(slots, np.int32)

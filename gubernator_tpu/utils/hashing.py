@@ -3,16 +3,14 @@
 The reference uses github.com/segmentio/fasthash fnv1/fnv1a for its
 consistent-hash ring (`replicated_hash.go:31,59-64`).  These are the
 standard FNV-64 parameter sets, reimplemented here from the published
-algorithm.  A batched C implementation (native/hashing.c, loaded via
-ctypes) accelerates the hot host-side path of hashing many keys per
-request batch; the pure-Python path is the fallback and the semantics
-oracle.
+algorithm.  The batched C++ implementation in the host runtime
+(native/host_runtime.cpp) accelerates the hot host-side path of hashing
+many keys per request batch; the pure-Python path is the fallback and
+the semantics oracle.
 """
 
 from __future__ import annotations
 
-import ctypes
-import os
 from typing import Iterable, List
 
 _FNV_OFFSET64 = 0xCBF29CE484222325
@@ -43,52 +41,12 @@ def hash_string_64(s: str) -> int:
     return fnv1a_64(s.encode("utf-8"))
 
 
-class _NativeHasher:
-    """ctypes binding to the batched C hasher (native/libguberhash.so)."""
-
-    def __init__(self, path: str):
-        lib = ctypes.CDLL(path)
-        lib.fnv1a64_batch.argtypes = [
-            ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_uint32),
-            ctypes.c_size_t,
-            ctypes.POINTER(ctypes.c_uint64),
-        ]
-        lib.fnv1a64_batch.restype = None
-        self._lib = lib
-
-    def hash_batch(self, keys: List[bytes]) -> List[int]:
-        n = len(keys)
-        if n == 0:
-            return []
-        blob = b"".join(keys)
-        lens = (ctypes.c_uint32 * n)(*[len(k) for k in keys])
-        out = (ctypes.c_uint64 * n)()
-        self._lib.fnv1a64_batch(blob, lens, n, out)
-        return list(out)
-
-
-_native: "_NativeHasher | None" = None
-
-
-def _load_native() -> "_NativeHasher | None":
-    global _native
-    if _native is not None:
-        return _native
-    so = os.path.join(os.path.dirname(__file__), "..", "..", "native", "libguberhash.so")
-    so = os.path.abspath(so)
-    if os.path.exists(so):
-        try:
-            _native = _NativeHasher(so)
-        except OSError:
-            _native = None
-    return _native
-
-
 def hash_batch_64(keys: Iterable[str]) -> List[int]:
-    """FNV-1a-64 over a batch of string keys; uses the C fast path if built."""
-    encoded = [k.encode("utf-8") for k in keys]
-    native = _load_native()
-    if native is not None:
-        return native.hash_batch(encoded)
-    return [fnv1a_64(k) for k in encoded]
+    """FNV-1a-64 over a batch of string keys; delegates to the C++ host
+    runtime (native/host_runtime.cpp::gt_fnv1_batch) when built."""
+    keys = list(keys)
+    from .. import native
+
+    if native.available():
+        return [int(h) for h in native.fnv1_batch(keys, variant_1a=True)]
+    return [fnv1a_64(k.encode("utf-8")) for k in keys]
