@@ -60,11 +60,9 @@ class DaemonConfig:
     store: object = None
     loader: object = None
     debug: bool = False
-    # TLS (reference tls.go); served by the gateway when set.
-    tls_cert_file: str = ""
-    tls_key_file: str = ""
-    tls_ca_file: str = ""
-    client_auth: str = ""  # "", "request", "require-and-verify"
+    # TLS (reference tls.go); wraps the gateway listener and the peer
+    # transport when set.  See gubernator_tpu.tls.TLSConfig.
+    tls: object = None  # Optional[tls.TLSConfig]
     devices: Optional[list] = None  # jax devices for the mesh (None = all)
 
     def resolved_advertise(self) -> str:
@@ -207,8 +205,27 @@ def setup_daemon_config(
             if a.strip()
         ]
 
-    conf.tls_cert_file = merged.get("GUBER_TLS_CERT", "")
-    conf.tls_key_file = merged.get("GUBER_TLS_KEY", "")
-    conf.tls_ca_file = merged.get("GUBER_TLS_CA", "")
-    conf.client_auth = merged.get("GUBER_TLS_CLIENT_AUTH", "")
+    tls_keys = (
+        "GUBER_TLS_CA", "GUBER_TLS_CA_KEY", "GUBER_TLS_CERT", "GUBER_TLS_KEY",
+        "GUBER_TLS_AUTO", "GUBER_TLS_CLIENT_AUTH", "GUBER_TLS_CLIENT_AUTH_CA_CERT",
+        "GUBER_TLS_CLIENT_AUTH_CERT", "GUBER_TLS_CLIENT_AUTH_KEY",
+        "GUBER_TLS_INSECURE_SKIP_VERIFY",
+    )
+    if any(merged.get(k) for k in tls_keys):
+        from .tls import TLSConfig
+
+        conf.tls = TLSConfig(
+            ca_file=merged.get("GUBER_TLS_CA", ""),
+            ca_key_file=merged.get("GUBER_TLS_CA_KEY", ""),
+            cert_file=merged.get("GUBER_TLS_CERT", ""),
+            key_file=merged.get("GUBER_TLS_KEY", ""),
+            auto_tls=merged.get("GUBER_TLS_AUTO", "").lower() in ("true", "1", "yes"),
+            client_auth=merged.get("GUBER_TLS_CLIENT_AUTH", ""),
+            client_auth_ca_file=merged.get("GUBER_TLS_CLIENT_AUTH_CA_CERT", ""),
+            client_auth_cert_file=merged.get("GUBER_TLS_CLIENT_AUTH_CERT", ""),
+            client_auth_key_file=merged.get("GUBER_TLS_CLIENT_AUTH_KEY", ""),
+            insecure_skip_verify=merged.get(
+                "GUBER_TLS_INSECURE_SKIP_VERIFY", ""
+            ).lower() in ("true", "1", "yes"),
+        )
     return conf

@@ -9,13 +9,13 @@ IsOwner by advertise-address compare exactly like daemon.go:277-287.
 from __future__ import annotations
 
 import socket
-import ssl
 import threading
 import time
 from typing import List, Optional, Sequence
 
 from .config import DaemonConfig
 from .gateway import GatewayServer
+from .tls import setup_tls
 from .metrics import Metrics
 from .service import ServiceConfig, V1Service
 from .types import PeerInfo
@@ -35,7 +35,8 @@ class Daemon:
     # ------------------------------------------------------------------
     def start(self) -> "Daemon":
         """daemon.go:72-251."""
-        server_tls, _ = _build_tls(self.conf)
+        tls_conf = setup_tls(self.conf.tls)
+        server_tls = tls_conf.server_ctx if tls_conf else None
         metrics = Metrics()
         svc_conf = ServiceConfig(
             cache_size=self.conf.cache_size,
@@ -47,6 +48,7 @@ class Daemon:
             clock=self.clock,
             metrics=metrics,
             devices=self.conf.devices,
+            peer_tls_context=tls_conf.client_ctx if tls_conf else None,
         )
         self.service = V1Service(svc_conf)
         self.gateway = GatewayServer(
@@ -130,23 +132,3 @@ class Daemon:
 def spawn_daemon(conf: DaemonConfig, clock: Optional[Clock] = None) -> Daemon:
     """daemon.go:59-70."""
     return Daemon(conf, clock=clock).start()
-
-
-def _build_tls(conf: DaemonConfig):
-    """Assemble server/client ssl contexts from DaemonConfig (tls.go
-    equivalent).  Returns (server_ctx, client_ctx); (None, None) when TLS
-    is not configured."""
-    if not conf.tls_cert_file:
-        return None, None
-    server = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-    server.load_cert_chain(conf.tls_cert_file, conf.tls_key_file)
-    if conf.tls_ca_file:
-        server.load_verify_locations(conf.tls_ca_file)
-    if conf.client_auth == "require-and-verify":
-        server.verify_mode = ssl.CERT_REQUIRED
-    elif conf.client_auth == "request":
-        server.verify_mode = ssl.CERT_OPTIONAL
-    client = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
-    if conf.tls_ca_file:
-        client.load_verify_locations(conf.tls_ca_file)
-    return server, client

@@ -69,6 +69,9 @@ class ServiceConfig:
     devices: Optional[list] = None
     local_picker: Optional[ReplicatedConsistentHash] = None
     region_picker: Optional[RegionPicker] = None
+    # ssl.SSLContext used by PeerClients (mTLS peer data plane,
+    # daemon.go:102-106 -> peer_client.go:87-132).
+    peer_tls_context: object = None
 
 
 class V1Service:
@@ -244,23 +247,34 @@ class V1Service:
     def get_peer_rate_limits(self, req: GetRateLimitsRequest) -> GetRateLimitsResponse:
         """Owner-authoritative batch (gubernator.go:275-292); never
         re-forwards."""
-        if len(req.requests) > MAX_BATCH_SIZE:
-            raise ApiError(
-                "OutOfRange",
-                f"'PeerRequest.rate_limits' list too large; max size is '{MAX_BATCH_SIZE}'",
+        method = "/pb.gubernator.PeersV1/GetPeerRateLimits"
+        start = time.perf_counter()
+        try:
+            if len(req.requests) > MAX_BATCH_SIZE:
+                self.metrics.request_counts.labels(status="1", method=method).inc()
+                raise ApiError(
+                    "OutOfRange",
+                    f"'PeerRequest.rate_limits' list too large; max size is '{MAX_BATCH_SIZE}'",
+                )
+            now = self.clock.now_ms()
+            resps = self.store.apply(list(req.requests), now)
+            for r in req.requests:
+                if has_behavior(r.behavior, Behavior.MULTI_REGION):
+                    self.multi_region_mgr.queue_hits(r)
+            self.metrics.request_counts.labels(status="0", method=method).inc()
+            return GetRateLimitsResponse(responses=resps)
+        finally:
+            self.metrics.request_duration.labels(method=method).observe(
+                time.perf_counter() - start
             )
-        now = self.clock.now_ms()
-        resps = self.store.apply(list(req.requests), now)
-        for r in req.requests:
-            if has_behavior(r.behavior, Behavior.MULTI_REGION):
-                self.multi_region_mgr.queue_hits(r)
-        return GetRateLimitsResponse(responses=resps)
 
     def update_peer_globals(self, updates: Sequence[UpdatePeerGlobal]) -> None:
         """gubernator.go:259-272."""
+        method = "/pb.gubernator.PeersV1/UpdatePeerGlobals"
         now = self.clock.now_ms()
         for u in updates:
             self.store.set_replica(u, now)
+        self.metrics.request_counts.labels(status="0", method=method).inc()
 
     # ------------------------------------------------------------------
     def health_check(self) -> HealthCheckResponse:
@@ -300,14 +314,20 @@ class V1Service:
             for info in local:
                 client = old_clients.pop(info.grpc_address, None)
                 if client is None:
-                    client = PeerClient(info, self.conf.behaviors)
+                    client = PeerClient(
+                        info, self.conf.behaviors,
+                        tls_context=self.conf.peer_tls_context,
+                    )
                 client.info = info
                 new_local.add(info.grpc_address, client)
             new_region = self.region_picker.new()
             for info in regional:
                 client = old_clients.pop(info.grpc_address, None)
                 if client is None:
-                    client = PeerClient(info, self.conf.behaviors)
+                    client = PeerClient(
+                        info, self.conf.behaviors,
+                        tls_context=self.conf.peer_tls_context,
+                    )
                 client.info = info
                 new_region.add(client)
             self.local_picker = new_local
