@@ -33,9 +33,10 @@ from ..types import (
 from ..utils import gregorian
 from .slot_table import SlotTable
 
-# Batches are padded to a power of FOUR >= 64: compiles are minutes on a
-# TPU tunnel while padded kernel lanes are microseconds, so few distinct
-# shapes beats tight padding (one compilation per size ever seen).
+# Batches are padded to a power of TWO >= 64: compiles are expensive on
+# a TPU tunnel while padded kernel lanes are microseconds, so few
+# distinct shapes beats tight padding (one compilation per size ever
+# seen); power-of-two growth keeps wasted transfer bytes under 2x.
 _PAD_MIN = 64
 _PAD_MAX = 1 << 20
 
@@ -43,7 +44,7 @@ _PAD_MAX = 1 << 20
 def pad_size(n: int) -> int:
     p = _PAD_MIN
     while p < n and p < _PAD_MAX:
-        p <<= 2
+        p <<= 1
     if n <= p:
         return p
     return ((n + _PAD_MAX - 1) // _PAD_MAX) * _PAD_MAX
@@ -348,44 +349,43 @@ class ShardStore:
             )
 
     def _run_columns(self, keys: List[str], cols: "_Columns", now_ms: int):
-        """Round-planned kernel dispatch over pre-validated columns.
-        Returns (status, remaining, reset_time) arrays aligned to keys."""
+        """Single-dispatch kernel path over pre-validated columns: the
+        C++ planner assigns every lane a (round, slot, exists) upfront,
+        the whole duplicate-round loop runs inside one jitted program
+        (buckets.apply_rounds), and all outputs come back in ONE packed
+        device->host transfer.  Returns (status, remaining, reset_time)
+        arrays aligned to keys."""
         n = len(keys)
-        out_status = np.zeros(n, dtype=np.int32)
-        out_rem = np.zeros(n, dtype=np.int64)
-        out_reset = np.zeros(n, dtype=np.int64)
         planner = native.NativeBatchPlanner(self.table, keys, now_ms)
-        while True:
-            nxt = planner.next_round()
-            if nxt is None:
-                break
-            lane, slots, exists = nxt
-            m = len(lane)
-            padded = pad_size(m)
-            slot_col = np.full(padded, -1, dtype=np.int32)
-            slot_col[:m] = slots
-            ex_col = np.zeros(padded, dtype=bool)
-            ex_col[:m] = exists
-            batch = buckets.make_batch(
-                slot_col,
-                ex_col,
-                _pad(cols.algo[lane], padded, np.int32),
-                _pad(cols.behavior[lane], padded, np.int32),
-                _pad(cols.hits[lane], padded, np.int64),
-                _pad(cols.limit[lane], padded, np.int64),
-                _pad(cols.duration[lane], padded, np.int64),
-                _pad(cols.greg_expire[lane], padded, np.int64),
-                _pad(cols.greg_duration[lane], padded, np.int64),
-            )
-            self.state, out = buckets.apply_batch_jit(self.state, batch, now_ms)
-            out_exp = np.asarray(out.new_expire)
-            out_removed = np.asarray(out.removed)
-            planner.commit_round(out_exp[:m], out_removed[:m])
-            self.algo_mirror[slots] = cols.algo[lane]
-            out_status[lane] = np.asarray(out.status)[:m]
-            out_rem[lane] = np.asarray(out.remaining)[:m]
-            out_reset[lane] = np.asarray(out.reset_time)[:m]
-        return out_status, out_rem, out_reset
+        round_id, slots, exists, n_rounds = planner.plan()
+        padded = pad_size(n)
+        slot_col = np.full(padded, -1, dtype=np.int32)
+        slot_col[:n] = slots
+        rid_col = np.zeros(padded, dtype=np.int32)
+        rid_col[:n] = round_id
+        ex_col = np.zeros(padded, dtype=bool)
+        ex_col[:n] = exists
+        batch = buckets.make_batch(
+            slot_col,
+            ex_col,
+            _pad(cols.algo, padded, np.int32),
+            _pad(cols.behavior, padded, np.int32),
+            _pad(cols.hits, padded, np.int64),
+            _pad(cols.limit, padded, np.int64),
+            _pad(cols.duration, padded, np.int64),
+            _pad(cols.greg_expire, padded, np.int64),
+            _pad(cols.greg_duration, padded, np.int64),
+        )
+        self.state, packed = buckets.apply_rounds_jit(
+            self.state, batch, rid_col, n_rounds, now_ms
+        )
+        packed = np.asarray(packed)  # the one blocking transfer
+        status, removed, remaining, reset, new_exp = buckets.unpack_output(
+            packed[:, :n]
+        )
+        planner.commit_plan(new_exp, removed)
+        self.algo_mirror[slots] = cols.algo
+        return status, remaining, reset
 
     def apply_columns(
         self,
@@ -482,11 +482,14 @@ class ShardStore:
         batch = buckets.make_batch(*arrays)
         self.state, out = buckets.apply_batch_jit(self.state, batch, now_ms)
 
-        out_status = np.asarray(out.status)
-        out_rem = np.asarray(out.remaining)
-        out_reset = np.asarray(out.reset_time)
-        out_exp = np.asarray(out.new_expire)
-        out_removed = np.asarray(out.removed)
+        # device_get on the whole pytree overlaps the transfers (one
+        # round-trip instead of five sequential blocking readbacks).
+        out = jax.device_get(out)
+        out_status = out.status
+        out_rem = out.remaining
+        out_reset = out.reset_time
+        out_exp = out.new_expire
+        out_removed = out.removed
 
         slot = arrays[0]
         self.table.commit(

@@ -74,6 +74,9 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.gt_batch_next_round.restype = c.c_int64
     lib.gt_batch_next_round.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p]
     lib.gt_batch_commit_round.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
+    lib.gt_batch_plan.restype = c.c_int64
+    lib.gt_batch_plan.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p]
+    lib.gt_batch_commit_plan.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
     lib.gt_batch_free.argtypes = [c.c_void_p]
     lib.gt_fnv1_batch.argtypes = [c.c_void_p, c.c_void_p, c.c_int64, c.c_int32, c.c_void_p]
     return lib
@@ -264,3 +267,27 @@ class NativeBatchPlanner:
         expire = np.ascontiguousarray(new_expire_ms, dtype=np.int64)
         rm = np.ascontiguousarray(removed, dtype=np.uint8)
         self._lib.gt_batch_commit_round(self._ptr, expire.ctypes.data, rm.ctypes.data)
+
+    def plan(self):
+        """Plan ALL rounds upfront (no interleaved commits): returns
+        (round_id[n] i32, slot[n] i32, exists[n] bool, n_rounds) for the
+        single-dispatch kernel path (ops/buckets.py apply_rounds)."""
+        round_id = np.empty(max(self.n, 1), dtype=np.int32)
+        slots = np.empty(max(self.n, 1), dtype=np.int32)
+        exists = np.empty(max(self.n, 1), dtype=np.uint8)
+        n_rounds = self._lib.gt_batch_plan(
+            self._ptr, round_id.ctypes.data, slots.ctypes.data, exists.ctypes.data
+        )
+        return (
+            round_id[: self.n],
+            slots[: self.n],
+            exists[: self.n].astype(bool),
+            int(n_rounds),
+        )
+
+    def commit_plan(self, new_expire_ms, removed) -> None:
+        """Fold kernel outputs (indexed by ORIGINAL lane order) back into
+        the table, last-write-per-key wins."""
+        expire = np.ascontiguousarray(new_expire_ms, dtype=np.int64)
+        rm = np.ascontiguousarray(removed, dtype=np.uint8)
+        self._lib.gt_batch_commit_plan(self._ptr, expire.ctypes.data, rm.ctypes.data)

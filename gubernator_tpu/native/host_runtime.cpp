@@ -131,6 +131,9 @@ struct Batch {
   std::vector<uint8_t> exists, resolved;
   // last emitted round
   std::vector<int32_t> round_lane;
+  // full-plan mode (gt_batch_plan): lanes in emission order across all
+  // rounds, consumed by gt_batch_commit_plan
+  std::vector<int32_t> plan_order;
 
   Batch(Table* t, const char* k, const int64_t* off, int64_t n_, int64_t now)
       : table(t), keys(k), offsets(off), n(n_), now_ms(now),
@@ -309,6 +312,116 @@ void gt_batch_commit_round(void* bv, const int64_t* new_expire,
       continue;
     if (removed[j]) t->unmap_slot(s);
     else t->expire_ms[s] = new_expire[j];
+  }
+}
+
+// Plan EVERY round upfront — no interleaved device commits — so the
+// whole batch runs as ONE device dispatch (ops/buckets.py apply_rounds:
+// a lax.while_loop over rounds).  Per lane i fills round_id / slot /
+// exists and returns the round count.
+//
+// Chained lanes (key already emitted in an earlier round of this batch)
+// get exists=1: the device row was just written by this very batch, so
+// device-side liveness (expire_at >= now) is authoritative — including
+// the remove-then-recreate chain, where the earlier round stamped
+// expire_at=0.  This removes the need for host expire updates between
+// rounds, which is exactly what forces a blocking device->host readback
+// per round in the interleaved design.
+int64_t gt_batch_plan(void* bv, int32_t* round_id, int32_t* slots,
+                      uint8_t* exists) {
+  Batch* b = (Batch*)bv;
+  Table* t = b->table;
+  b->plan_order.clear();
+  b->plan_order.reserve((size_t)b->n);
+  // key -> slot at first emission: a later lane is chained (device-
+  // authoritative) only while it still resolves to that same slot; a
+  // mid-batch eviction reassigning the key to a fresh slot falls back
+  // to the host's exists (the state was lost, as in the reference's
+  // LRU eviction of a live item).
+  std::unordered_map<std::string, int32_t> emitted;
+  emitted.reserve((size_t)b->n * 2);
+  int64_t round = 0;
+  while (!b->pending.empty()) {
+    std::unordered_map<std::string, int> seen_keys;
+    std::unordered_map<int32_t, int> used_slots;
+    seen_keys.reserve(b->pending.size() * 2);
+    used_slots.reserve(b->pending.size() * 2);
+    std::vector<int32_t> deferred;
+    for (int32_t i : b->pending) {
+      std::string k(b->key_ptr(i), b->key_len(i));
+      if (seen_keys.count(k)) {
+        deferred.push_back(i);
+        continue;
+      }
+      if (!b->resolved[i]) {
+        auto [s, e] = t->lookup_or_assign(b->key_ptr(i), b->key_len(i), b->now_ms);
+        b->slot[i] = s;
+        b->exists[i] = e ? 1 : 0;
+        b->resolved[i] = 1;
+      }
+      if (used_slots.count(b->slot[i])) {  // eviction collision: defer as-is
+        deferred.push_back(i);
+        seen_keys.emplace(std::move(k), 1);
+        continue;
+      }
+      round_id[i] = (int32_t)round;
+      slots[i] = b->slot[i];
+      auto em = emitted.find(k);
+      exists[i] = (em != emitted.end() && em->second == b->slot[i])
+                      ? 1
+                      : b->exists[i];
+      b->plan_order.push_back(i);
+      seen_keys.emplace(k, 1);
+      emitted.emplace(std::move(k), b->slot[i]);
+      used_slots.emplace(b->slot[i], 1);
+    }
+    b->pending.swap(deferred);
+    ++round;
+  }
+  return round;
+}
+
+// Fold the planned batch's kernel outputs (indexed by ORIGINAL lane)
+// back into the table, in emission order so the last write per key
+// wins.  Unlike the per-round staleness guard, an unmapped slot is
+// re-mapped to the lane's key: that is the remove-then-recreate chain
+// (token RESET_REMAINING freed it, a later round recreated it on
+// device).  A slot owned by a DIFFERENT key means a later in-batch
+// eviction took it over — this lane's write is stale, skip.
+void gt_batch_commit_plan(void* bv, const int64_t* new_expire,
+                          const uint8_t* removed) {
+  Batch* b = (Batch*)bv;
+  Table* t = b->table;
+  for (int32_t i : b->plan_order) {
+    int32_t s = b->slot[i];
+    if (s < 0) continue;
+    bool mine = t->slot_mapped[s] &&
+                t->slot_key[s].compare(0, std::string::npos, b->key_ptr(i),
+                                       b->key_len(i)) == 0;
+    if (removed[i]) {
+      if (mine) t->unmap_slot(s);
+      continue;
+    }
+    if (mine) {
+      t->expire_ms[s] = new_expire[i];
+    } else if (!t->slot_mapped[s]) {
+      std::string k(b->key_ptr(i), b->key_len(i));
+      // Guard: if the key meanwhile maps elsewhere (mid-batch eviction
+      // reassigned it), that newer mapping owns the key — skip.
+      if (!t->key_to_slot.emplace(k, s).second) continue;
+      t->slot_key[s] = std::move(k);
+      t->slot_mapped[s] = 1;
+      t->expire_ms[s] = new_expire[i];
+      // slot was unmapped (free-listed); pull it back into LRU order
+      for (size_t j = 0; j < t->free_slots.size(); ++j) {
+        if (t->free_slots[j] == s) {
+          t->free_slots[j] = t->free_slots.back();
+          t->free_slots.pop_back();
+          break;
+        }
+      }
+      t->lru_push_back(s);
+    }
   }
 }
 

@@ -421,6 +421,69 @@ def apply_batch(state: BucketState, req: RequestBatch, now_ms) -> "tuple[BucketS
 apply_batch_jit = jax.jit(apply_batch, donate_argnums=0)
 
 
+def _pack_output(out: BatchOutput) -> jax.Array:
+    """Fuse the per-lane outputs into ONE i64[4, B] array so the host
+    pays a single device->host transfer per batch instead of five (each
+    blocking readback is a full RTT — the dominant cost when the device
+    sits behind a network tunnel).  Row 0 packs status (bit 0) and
+    removed (bit 1); rows 1-3 are remaining / reset_time / new_expire.
+    `limit` is an echo of the request and never leaves the device."""
+    row0 = out.status.astype(_I64) | (out.removed.astype(_I64) << 1)
+    return jnp.stack((row0, out.remaining, out.reset_time, out.new_expire))
+
+
+def unpack_output(packed):
+    """Host-side twin of _pack_output: (status, removed, remaining,
+    reset_time, new_expire) numpy views from the packed i64[4, B]."""
+    row0 = packed[0]
+    return (
+        (row0 & 1).astype("int32"),
+        (row0 >> 1).astype(bool),
+        packed[1],
+        packed[2],
+        packed[3],
+    )
+
+
+def apply_rounds(
+    state: BucketState, req: RequestBatch, round_id, n_rounds, now_ms
+) -> "tuple[BucketState, jax.Array]":
+    """Evaluate a whole duplicate-key batch in ONE dispatch.
+
+    `round_id[i]` assigns each lane to a sequential round (computed by
+    the host planner: unique keys+slots per round); the loop applies
+    round r's lanes while masking the rest, so the k-th request for a
+    key observes the (k-1)-th's state — the reference's mutex
+    serialization (gubernator.go:336-337) — without a host round-trip
+    between rounds.  `n_rounds` is a traced scalar: one compilation
+    serves every round count at a given batch width.
+
+    Returns (new_state, packed_output i64[4, B]); decode with
+    unpack_output.
+    """
+    B = req.slot.shape[0]
+    packed0 = jnp.zeros((4, B), _I64)
+
+    def cond(c):
+        return c[0] < n_rounds
+
+    def body(c):
+        r, st, packed = c
+        active = round_id == r
+        req_r = req._replace(slot=jnp.where(active, req.slot, -1))
+        st, out = apply_batch(st, req_r, now_ms)
+        packed = jnp.where(active[None, :], _pack_output(out), packed)
+        return r + 1, st, packed
+
+    _, state, packed = jax.lax.while_loop(
+        cond, body, (jnp.asarray(0, _I32), state, packed0)
+    )
+    return state, packed
+
+
+apply_rounds_jit = jax.jit(apply_rounds, donate_argnums=0)
+
+
 @jax.jit
 def read_rows(state: BucketState, slots) -> BucketState:
     """Gather full bucket rows for the given slots (host-bound: Store

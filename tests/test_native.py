@@ -166,3 +166,86 @@ def test_native_store_capacity_eviction_parity():
         reqs = [_req(f"e{(t + j) % 20}", limit=1000) for j in range(6)]
         assert a.apply(reqs, now + t) == b.apply(reqs, now + t)
     assert sorted(a.table.keys()) == sorted(b.table.keys())
+
+
+def test_plan_single_dispatch_round_ids():
+    """gt_batch_plan assigns the same rounds as the interleaved planner
+    without needing per-round commits."""
+    t = native.NativeSlotTable(16)
+    keys = ["a", "b", "a", "a", "c", "b"]
+    p = native.NativeBatchPlanner(t, keys, 100)
+    round_id, slots, exists, n_rounds = p.plan()
+    assert n_rounds == 3
+    assert list(round_id) == [0, 0, 1, 2, 0, 1]
+    # First occurrences are misses; chained occurrences trust the device.
+    assert list(exists) == [False, False, True, True, False, True]
+    assert slots[0] == slots[2] == slots[3]
+    assert slots[1] == slots[5]
+    # commit_plan folds the last write per key into the table.
+    exp = np.arange(100, 106, dtype=np.int64) + 1000
+    p.commit_plan(exp, np.zeros(6, np.uint8))
+    assert t.lookup_or_assign("a", 1100) == (int(slots[3]), True)  # expire 1103
+
+
+def test_reset_remaining_then_hit_same_batch():
+    """Token RESET_REMAINING followed by hits on the same key in ONE
+    batch: the reset removes the bucket, the next hit recreates it, and
+    the recreated bucket must survive into the next batch (the remove-
+    then-recreate commit chain)."""
+    now = 1_700_000_000_000
+    a = ShardStore(capacity=32, use_native=True)
+    b = ShardStore(capacity=32, use_native=False)
+    warm = [_req("rr", hits=4, limit=10)]
+    batch = [
+        _req("rr", hits=0, behavior=int(Behavior.RESET_REMAINING), limit=10),
+        _req("rr", hits=3, limit=10),
+    ]
+    after = [_req("rr", hits=1, limit=10)]
+    for st in (a, b):
+        st.apply(warm, now)
+        st.apply(batch, now + 1)
+        (r,) = st.apply(after, now + 2)
+        assert r.remaining == 6, r  # 10 - 3 - 1: recreation persisted
+    assert a.table.get_slot("nat_rr") is not None
+
+
+def test_plan_path_overlimit_chain():
+    """Duplicate chain crossing the limit: k-th request sees (k-1)-th's
+    state exactly as the mutex-serialized reference would."""
+    now = 1_700_000_000_000
+    a = ShardStore(capacity=32, use_native=True)
+    b = ShardStore(capacity=32, use_native=False)
+    # remaining=5: [hits=7 OVER no-mutate, hits=3 UNDER ->2, hits=3 OVER, hits=2 UNDER ->0]
+    reqs = [_req("ol", hits=h, limit=5) for h in (7, 3, 3, 2)]
+    ra, rb = a.apply(reqs, now), b.apply(reqs, now)
+    assert ra == rb
+    assert [r.status for r in ra] == [1, 0, 1, 0]
+    assert [r.remaining for r in ra] == [5, 2, 2, 0]
+
+
+def test_plan_path_random_stress_vs_python():
+    """Randomized mixed workload (dups, resets, algo switches, expiry,
+    capacity pressure) through the single-dispatch path vs the Python
+    twin."""
+    now = 1_700_000_000_000
+    a = ShardStore(capacity=16, use_native=True)
+    b = ShardStore(capacity=16, use_native=False)
+    rng = np.random.RandomState(11)
+    for t in range(30):
+        reqs = []
+        for _ in range(24):
+            behavior = int(Behavior.RESET_REMAINING) if rng.random() < 0.1 else 0
+            reqs.append(
+                _req(
+                    f"s{rng.randint(0, 10)}",
+                    hits=int(rng.randint(0, 4)),
+                    limit=6,
+                    duration=int(rng.choice([200, 5000])),
+                    algo=Algorithm(int(rng.randint(0, 2))),
+                    behavior=behavior,
+                )
+            )
+        step = now + t * 150
+        ra, rb = a.apply(reqs, step), b.apply(reqs, step)
+        assert ra == rb, t
+    assert sorted(a.table.keys()) == sorted(b.table.keys())
