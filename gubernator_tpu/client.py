@@ -1,8 +1,10 @@
 """Client helpers (reference client.go + python/gubernator/__init__.py).
 
-`V1Client` speaks the HTTP/JSON gateway (the reference's
-DialV1Server gRPC channel maps to the same surface).  Includes the
-Python client's `sleep_until_reset` convenience.
+`GrpcV1Client` (via `dial_v1_server`) speaks the gRPC V1 service — the
+reference's DialV1Server path (client.go:41-57).  `V1Client` speaks the
+HTTP/JSON gateway.  Both expose the same get_rate_limits / health_check
+surface; `sleep_until_reset` is the Python client's convenience
+(python/gubernator/__init__.py:12-17).
 """
 
 from __future__ import annotations
@@ -79,6 +81,55 @@ class V1Client:
             return conn.getresponse().read().decode()
         finally:
             conn.close()
+
+
+class GrpcV1Client:
+    """gRPC client for the V1 service (client.go:41-57 DialV1Server)."""
+
+    def __init__(self, endpoint: str, timeout_s: float = 5.0, credentials=None):
+        import grpc
+
+        from .proto import V1_SERVICE
+        from .proto import gubernator_pb2 as pb
+
+        self.endpoint = endpoint
+        self.timeout_s = timeout_s
+        if credentials is not None:
+            self._channel = grpc.secure_channel(endpoint, credentials)
+        else:
+            self._channel = grpc.insecure_channel(endpoint)
+        self._get_rate_limits = self._channel.unary_unary(
+            f"/{V1_SERVICE}/GetRateLimits",
+            request_serializer=pb.GetRateLimitsReq.SerializeToString,
+            response_deserializer=pb.GetRateLimitsResp.FromString,
+        )
+        self._health_check = self._channel.unary_unary(
+            f"/{V1_SERVICE}/HealthCheck",
+            request_serializer=pb.HealthCheckReq.SerializeToString,
+            response_deserializer=pb.HealthCheckResp.FromString,
+        )
+
+    def get_rate_limits(self, req: GetRateLimitsRequest) -> GetRateLimitsResponse:
+        from . import wire
+
+        m = self._get_rate_limits(
+            wire.get_rate_limits_req_to_pb(req), timeout=self.timeout_s
+        )
+        return wire.get_rate_limits_resp_from_pb(m)
+
+    def health_check(self) -> HealthCheckResponse:
+        from . import wire
+        from .proto import gubernator_pb2 as pb
+
+        return wire.health_from_pb(self._health_check(pb.HealthCheckReq(), timeout=self.timeout_s))
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+def dial_v1_server(address: str, credentials=None, timeout_s: float = 5.0) -> GrpcV1Client:
+    """client.go:41-57."""
+    return GrpcV1Client(address, timeout_s=timeout_s, credentials=credentials)
 
 
 def sleep_until_reset(rate_limit: RateLimitResponse) -> None:

@@ -51,6 +51,7 @@ class Cluster:
         for dc in data_centers:
             conf = DaemonConfig(
                 listen_address="127.0.0.1:0",
+                grpc_listen_address="127.0.0.1:0",
                 cache_size=cache_size,
                 global_cache_size=g_capacity,
                 data_center=dc,
@@ -85,12 +86,13 @@ class Cluster:
         raise KeyError(peer.grpc_address)
 
     def restart(self, idx: int, clock: Optional[Clock] = None) -> None:
-        """cluster/cluster.go:87-93: close and respawn at the same address."""
+        """cluster/cluster.go:87-93: close and respawn at the same addresses."""
         old = self.daemons[idx]
-        addr = old.peer_info.grpc_address
+        info = old.peer_info
         old.close()
         conf = DaemonConfig(
-            listen_address=addr,
+            listen_address=info.http_address,
+            grpc_listen_address=info.grpc_address,
             cache_size=old.conf.cache_size,
             global_cache_size=old.conf.global_cache_size,
             data_center=old.conf.data_center,

@@ -44,9 +44,16 @@ class BehaviorConfig:
 
 @dataclass
 class DaemonConfig:
-    """config.go:155-202 equivalent for the HTTP/JSON daemon."""
+    """config.go:155-202 equivalent.
+
+    `grpc_listen_address` is the gRPC data plane (client V1 + peer
+    PeersV1, the reference's GUBER_GRPC_ADDRESS); `listen_address` is
+    the HTTP/JSON gateway + /metrics (GUBER_HTTP_ADDRESS).  An empty
+    grpc_listen_address binds an ephemeral port on the gateway host.
+    """
 
     listen_address: str = "127.0.0.1:1050"
+    grpc_listen_address: str = ""
     advertise_address: str = ""
     cache_size: int = 50_000
     global_cache_size: int = 4096
@@ -144,14 +151,8 @@ def setup_daemon_config(
     merged.update({k: v for k, v in (env or os.environ).items() if k.startswith("GUBER_")})
 
     conf = DaemonConfig()
-    # The reference listens gRPC on GUBER_GRPC_ADDRESS and HTTP on
-    # GUBER_HTTP_ADDRESS; this daemon serves one HTTP/JSON port, so
-    # GUBER_HTTP_ADDRESS wins and GRPC_ADDRESS is accepted as an alias.
-    conf.listen_address = (
-        merged.get("GUBER_HTTP_ADDRESS")
-        or merged.get("GUBER_GRPC_ADDRESS")
-        or conf.listen_address
-    )
+    conf.listen_address = merged.get("GUBER_HTTP_ADDRESS") or conf.listen_address
+    conf.grpc_listen_address = merged.get("GUBER_GRPC_ADDRESS", "")
     conf.advertise_address = merged.get(
         "GUBER_ADVERTISE_ADDRESS", merged.get("GUBER_GRPC_ADVERTISE_ADDRESS", "")
     )
@@ -194,16 +195,26 @@ def setup_daemon_config(
         merged, "GUBER_MULTI_REGION_BATCH_LIMIT", b.multi_region_batch_limit
     )
 
-    # Static peers: GUBER_STATIC_PEERS=addr1,addr2 (our addition for the
-    # zero-dependency mode; the reference's equivalent is the member-list
-    # seed GUBER_MEMBERLIST_KNOWN_NODES).
+    # Static peers: GUBER_STATIC_PEERS=grpcAddr[|httpAddr],... (our
+    # addition for the zero-dependency mode; the reference's equivalent
+    # is the member-list seed GUBER_MEMBERLIST_KNOWN_NODES).  Entries
+    # are gRPC data-plane addresses, like the reference's peer lists;
+    # the optional |httpAddr names the peer's gateway for the HTTP
+    # fallback transport (required by insecure_skip_verify TLS).
     static = merged.get("GUBER_STATIC_PEERS", "")
     if static:
-        conf.peers = [
-            PeerInfo(grpc_address=a.strip(), http_address=a.strip())
-            for a in static.split(",")
-            if a.strip()
-        ]
+        conf.peers = []
+        for entry in static.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            grpc_addr, _, http_addr = entry.partition("|")
+            conf.peers.append(
+                PeerInfo(
+                    grpc_address=grpc_addr.strip(),
+                    http_address=http_addr.strip() or grpc_addr.strip(),
+                )
+            )
 
     tls_keys = (
         "GUBER_TLS_CA", "GUBER_TLS_CA_KEY", "GUBER_TLS_CERT", "GUBER_TLS_KEY",

@@ -15,6 +15,7 @@ from typing import List, Optional, Sequence
 
 from .config import DaemonConfig
 from .gateway import GatewayServer
+from .grpc_server import GrpcServer, channel_credentials
 from .tls import setup_tls
 from .metrics import Metrics
 from .service import ServiceConfig, V1Service
@@ -29,6 +30,7 @@ class Daemon:
         self.clock = clock or DEFAULT_CLOCK
         self.service: Optional[V1Service] = None
         self.gateway: Optional[GatewayServer] = None
+        self.grpc: Optional[GrpcServer] = None
         self._pool = None
         self._closed = False
 
@@ -37,6 +39,12 @@ class Daemon:
         """daemon.go:72-251."""
         tls_conf = setup_tls(self.conf.tls)
         server_tls = tls_conf.server_ctx if tls_conf else None
+        # Peer data plane credentials: gRPC channel creds unless the
+        # config demands skipped verification, which only the ssl-context
+        # HTTP fallback honors (PeerClient picks the transport).
+        peer_creds = None
+        if tls_conf is not None and not tls_conf.insecure_skip_verify:
+            peer_creds = channel_credentials(tls_conf)
         metrics = Metrics()
         svc_conf = ServiceConfig(
             cache_size=self.conf.cache_size,
@@ -49,18 +57,26 @@ class Daemon:
             metrics=metrics,
             devices=self.conf.devices,
             peer_tls_context=tls_conf.client_ctx if tls_conf else None,
+            peer_channel_credentials=peer_creds,
         )
         self.service = V1Service(svc_conf)
+        grpc_listen = self.conf.grpc_listen_address
+        if not grpc_listen:
+            host, _, _ = self.conf.listen_address.partition(":")
+            grpc_listen = f"{host or '127.0.0.1'}:0"
+        self.grpc = GrpcServer(self.service, grpc_listen, tls_conf=tls_conf).start()
         self.gateway = GatewayServer(
             self.service, self.conf.listen_address, tls_context=server_tls
         )
         self.gateway.start()
         # Port 0 resolves at bind time; a wildcard host — bound OR
         # explicitly configured — must be replaced by a routable IP
-        # before peers see it (net.go:12-33 via config.go:249).
+        # before peers see it (net.go:12-33 via config.go:249).  The
+        # advertise address names the gRPC data plane (config.go:249).
         self.service.conf.advertise_address = resolve_host_ip(
-            self.conf.advertise_address or self.gateway.address
+            self.conf.advertise_address or self.grpc.address
         )
+        self.http_advertise = resolve_host_ip(self.gateway.address)
 
         if self.conf.peer_discovery_type == "static":
             # A static daemon with no peer list serves standalone: it is
@@ -82,38 +98,46 @@ class Daemon:
     # ------------------------------------------------------------------
     @property
     def peer_info(self) -> PeerInfo:
-        addr = self.service.conf.advertise_address
         return PeerInfo(
-            grpc_address=addr, http_address=addr, data_center=self.conf.data_center
+            grpc_address=self.service.conf.advertise_address,
+            http_address=self.http_advertise,
+            data_center=self.conf.data_center,
         )
 
     def set_peers(self, peers: Sequence[PeerInfo]) -> None:
         """Stamp IsOwner by address compare, then hand to the service
-        (daemon.go:277-287)."""
-        mine = self.service.conf.advertise_address
+        (daemon.go:277-287).  Both of this daemon's addresses count as
+        "me": a static peer list naming only the HTTP address (the
+        reference's lists name gRPC addresses, but a gateway-only config
+        is legal here) must still self-identify."""
+        mine = {self.service.conf.advertise_address, self.http_advertise}
         stamped = []
         for p in peers:
             q = PeerInfo(
                 grpc_address=p.grpc_address,
                 http_address=p.http_address or p.grpc_address,
                 data_center=p.data_center,
-                is_owner=(p.grpc_address == mine or p.http_address == mine),
+                is_owner=(p.grpc_address in mine or p.http_address in mine),
             )
             stamped.append(q)
         self.service.set_peers(stamped)
 
     # ------------------------------------------------------------------
     def wait_for_connect(self, timeout_s: float = 10.0) -> None:
-        """Block until the gateway socket accepts (daemon.go:305-344)."""
-        host, _, port = self.gateway.address.partition(":")
+        """Block until every listener accepts (daemon.go:305-344)."""
         deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            try:
-                with socket.create_connection((host, int(port)), timeout=0.5):
-                    return
-            except OSError:
-                time.sleep(0.05)
-        raise TimeoutError(f"gateway at {self.gateway.address} never became reachable")
+        for address in (self.gateway.address, self.grpc.address):
+            host, _, port = address.partition(":")
+            while True:
+                try:
+                    with socket.create_connection((host, int(port)), timeout=0.5):
+                        break
+                except OSError:
+                    if time.monotonic() >= deadline:
+                        raise TimeoutError(
+                            f"listener at {address} never became reachable"
+                        )
+                    time.sleep(0.05)
 
     # ------------------------------------------------------------------
     def close(self) -> None:
@@ -125,6 +149,8 @@ class Daemon:
             self._pool.close()
         if self.service is not None:
             self.service.close()
+        if self.grpc is not None:
+            self.grpc.close()
         if self.gateway is not None:
             self.gateway.close()
 

@@ -6,9 +6,11 @@ window closes (peer_client.go:272-312); NO_BATCHING bypasses the queue
 (:143-152); last-error LRU with 5-minute TTL surfaced via HealthCheck
 (:206-235); graceful shutdown drains in-flight requests (:351-385).
 
-Transport is HTTP/JSON against the peer's gateway endpoints (the
-reference's gRPC data plane maps onto the same grpc-gateway JSON
-surface this framework serves).
+Default transport is gRPC against the peer's PeersV1 service — the
+same data plane as the reference (lazy channel = the reference's lazy
+`connect()`, peer_client.go:87-132).  An HTTP/JSON fallback speaks the
+peer's gateway, used when TLS is configured with insecure_skip_verify
+(gRPC channel credentials cannot skip verification) or on request.
 """
 
 from __future__ import annotations
@@ -20,9 +22,14 @@ import ssl
 import threading
 import time
 from concurrent.futures import Future
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
+import grpc
+
+from . import wire
 from .config import BehaviorConfig
+from .proto import PEERS_V1_SERVICE
+from .proto import peers_pb2 as peers_pb
 from .types import (
     Behavior,
     GetRateLimitsRequest,
@@ -30,10 +37,16 @@ from .types import (
     PeerInfo,
     RateLimitRequest,
     RateLimitResponse,
+    UpdatePeerGlobal,
     has_behavior,
 )
 
 ERR_CLOSING = "grpc: the client connection is closing"
+
+_NOT_READY_CODES = (
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+)
 
 
 class PeerError(Exception):
@@ -55,12 +68,27 @@ class PeerClient:
         info: PeerInfo,
         behaviors: Optional[BehaviorConfig] = None,
         tls_context: Optional[ssl.SSLContext] = None,
+        channel_credentials: Optional[grpc.ChannelCredentials] = None,
+        transport: str = "",  # "" = auto, "grpc", "http"
     ):
         self.info = info
         self.behaviors = behaviors or BehaviorConfig()
         self.tls_context = tls_context
+        self.channel_credentials = channel_credentials
+        if not transport:
+            # insecure_skip_verify TLS has no gRPC equivalent: the ssl
+            # context fallback is the only transport that can honor it.
+            transport = (
+                "http"
+                if tls_context is not None and channel_credentials is None
+                else "grpc"
+            )
+        self.transport = transport
         self._conn_lock = threading.Lock()
         self._conn: Optional[http.client.HTTPConnection] = None
+        self._channel: Optional[grpc.Channel] = None
+        self._rpc_get_peer_rate_limits = None
+        self._rpc_update_peer_globals = None
         self._queue: "queue.Queue[Tuple[RateLimitRequest, Future]]" = queue.Queue()
         self._shutdown = threading.Event()
         self._err_lock = threading.Lock()
@@ -91,15 +119,33 @@ class PeerClient:
         self, req: GetRateLimitsRequest, timeout_s: Optional[float] = None
     ) -> GetRateLimitsResponse:
         """Owner-authoritative batch (PeersV1.GetPeerRateLimits)."""
-        body = self._post("/v1/peer.GetPeerRateLimits", req.to_json(), timeout_s)
-        resp = GetRateLimitsResponse.from_json({"responses": body.get("rateLimits", [])})
+        if self.transport == "http":
+            body = self._post("/v1/peer.GetPeerRateLimits", req.to_json(), timeout_s)
+            resp = GetRateLimitsResponse.from_json(
+                {"responses": body.get("rateLimits", [])}
+            )
+        else:
+            m = self._grpc_call(
+                "GetPeerRateLimits",
+                wire.peer_rate_limits_req_to_pb(req),
+                timeout_s,
+            )
+            resp = wire.peer_rate_limits_resp_from_pb(m)
         if len(resp.responses) != len(req.requests):
             raise PeerError("number of rate limits in peer response does not match request")
         return resp
 
-    def update_peer_globals(self, globals_json: dict, timeout_s: Optional[float] = None) -> None:
+    def update_peer_globals(
+        self, updates: Sequence[UpdatePeerGlobal], timeout_s: Optional[float] = None
+    ) -> None:
         """PeersV1.UpdatePeerGlobals."""
-        self._post("/v1/peer.UpdatePeerGlobals", globals_json, timeout_s)
+        if self.transport == "http":
+            payload = {"globals": [u.to_json() for u in updates]}
+            self._post("/v1/peer.UpdatePeerGlobals", payload, timeout_s)
+        else:
+            self._grpc_call(
+                "UpdatePeerGlobals", wire.update_globals_req_to_pb(updates), timeout_s
+            )
 
     # ------------------------------------------------------------------
     def _ensure_worker(self) -> None:
@@ -154,6 +200,67 @@ class PeerClient:
             if not fut.done():
                 fut.set_result(rl)
 
+    # ------------------------------------------------------------------
+    # gRPC transport (lazy channel = peer_client.go:87-132 connect())
+    # ------------------------------------------------------------------
+    def _ensure_channel(self):
+        """Returns (get_peer_rate_limits, update_peer_globals) stubs,
+        building the channel lazily.  The stubs are captured and
+        returned under the lock: _reset_channel may null the attributes
+        concurrently (a racing thread observing a torn state must not
+        see None)."""
+        with self._conn_lock:
+            if self._channel is None:
+                target = self.info.grpc_address
+                options = [("grpc.max_receive_message_length", 1024 * 1024)]
+                if self.channel_credentials is not None:
+                    self._channel = grpc.secure_channel(
+                        target, self.channel_credentials, options=options
+                    )
+                else:
+                    self._channel = grpc.insecure_channel(target, options=options)
+                self._rpc_get_peer_rate_limits = self._channel.unary_unary(
+                    f"/{PEERS_V1_SERVICE}/GetPeerRateLimits",
+                    request_serializer=peers_pb.GetPeerRateLimitsReq.SerializeToString,
+                    response_deserializer=peers_pb.GetPeerRateLimitsResp.FromString,
+                )
+                self._rpc_update_peer_globals = self._channel.unary_unary(
+                    f"/{PEERS_V1_SERVICE}/UpdatePeerGlobals",
+                    request_serializer=peers_pb.UpdatePeerGlobalsReq.SerializeToString,
+                    response_deserializer=peers_pb.UpdatePeerGlobalsResp.FromString,
+                )
+            return self._rpc_get_peer_rate_limits, self._rpc_update_peer_globals
+
+    def _grpc_call(self, method: str, request, timeout_s: Optional[float]):
+        if self._shutdown.is_set():
+            raise PeerError(ERR_CLOSING, not_ready=True)
+        get_rl, update_g = self._ensure_channel()
+        rpc = get_rl if method == "GetPeerRateLimits" else update_g
+        timeout = timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
+        try:
+            return rpc(request, timeout=timeout)
+        except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            msg = f"{method} to peer {self.info.grpc_address} failed: {code}: {e.details() if hasattr(e, 'details') else e}"
+            self._set_last_err(msg)
+            # Drop the channel so the next call redials immediately
+            # instead of sitting in gRPC's reconnect backoff (the lazy
+            # reconnect of peer_client.go:87-132; a restarted peer at
+            # the same address must be reachable right away).
+            if code == grpc.StatusCode.UNAVAILABLE:
+                self._reset_channel()
+            raise PeerError(msg, not_ready=code in _NOT_READY_CODES) from e
+
+    def _reset_channel(self) -> None:
+        with self._conn_lock:
+            if self._channel is not None:
+                self._channel.close()
+                self._channel = None
+                self._rpc_get_peer_rate_limits = None
+                self._rpc_update_peer_globals = None
+
+    # ------------------------------------------------------------------
+    # HTTP/JSON fallback transport (the peer's gateway surface)
     # ------------------------------------------------------------------
     def _post(self, path: str, payload: dict, timeout_s: Optional[float]) -> dict:
         timeout = timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
@@ -222,3 +329,6 @@ class PeerClient:
             worker.join(timeout=timeout_s)
         with self._conn_lock:
             self._reset_conn()
+            if self._channel is not None:
+                self._channel.close()
+                self._channel = None

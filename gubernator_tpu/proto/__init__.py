@@ -1,0 +1,12 @@
+"""Wire schema (reference proto/gubernator.proto, proto/peers.proto).
+
+`gubernator_pb2` / `peers_pb2` are protoc-generated from the .proto
+files in this directory (regenerate with scripts/proto.sh).  Service
+and message names are wire-compatible with the reference so stock
+Gubernator gRPC clients interoperate unchanged.
+"""
+
+from . import gubernator_pb2, peers_pb2  # noqa: F401
+
+V1_SERVICE = "pb.gubernator.V1"
+PEERS_V1_SERVICE = "pb.gubernator.PeersV1"

@@ -69,9 +69,13 @@ class ServiceConfig:
     devices: Optional[list] = None
     local_picker: Optional[ReplicatedConsistentHash] = None
     region_picker: Optional[RegionPicker] = None
-    # ssl.SSLContext used by PeerClients (mTLS peer data plane,
-    # daemon.go:102-106 -> peer_client.go:87-132).
+    # ssl.SSLContext used by PeerClients on the HTTP fallback transport
+    # (mTLS peer data plane, daemon.go:102-106 -> peer_client.go:87-132).
     peer_tls_context: object = None
+    # grpc.ChannelCredentials for the gRPC peer transport (None => an
+    # insecure channel, or — when peer_tls_context is set — the HTTP
+    # fallback, which is the only transport able to skip verification).
+    peer_channel_credentials: object = None
 
 
 class V1Service:
@@ -317,6 +321,7 @@ class V1Service:
                     client = PeerClient(
                         info, self.conf.behaviors,
                         tls_context=self.conf.peer_tls_context,
+                        channel_credentials=self.conf.peer_channel_credentials,
                     )
                 client.info = info
                 new_local.add(info.grpc_address, client)
@@ -327,6 +332,7 @@ class V1Service:
                     client = PeerClient(
                         info, self.conf.behaviors,
                         tls_context=self.conf.peer_tls_context,
+                        channel_credentials=self.conf.peer_channel_credentials,
                     )
                 client.info = info
                 new_region.add(client)
@@ -408,13 +414,12 @@ class GlobalManager:
             svc.metrics.async_durations.observe(time.perf_counter() - start)
         if res.broadcasts:
             start = time.perf_counter()
-            payload = {"globals": [u.to_json() for u in res.broadcasts]}
             for peer in svc.get_peer_list():
                 if peer.info.is_owner:
                     continue  # exclude ourselves (global.go:223-226)
                 try:
                     peer.update_peer_globals(
-                        payload, timeout_s=svc.conf.behaviors.global_timeout_s
+                        res.broadcasts, timeout_s=svc.conf.behaviors.global_timeout_s
                     )
                 except Exception:  # noqa: BLE001
                     pass

@@ -1,0 +1,140 @@
+"""Converters between the internal dataclasses and the protobuf wire
+messages (gubernator_pb2 / peers_pb2).
+
+The dataclasses in `types.py` stay the in-process currency (the JSON
+gateway and the stores use them directly); protobuf enters only at the
+gRPC edge, mirroring how the reference's generated pb types live at its
+gRPC boundary (gubernator.pb.go / peers.pb.go).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from .proto import gubernator_pb2 as pb
+from .proto import peers_pb2 as peers_pb
+from .types import (
+    GetRateLimitsRequest,
+    GetRateLimitsResponse,
+    HealthCheckResponse,
+    RateLimitRequest,
+    RateLimitResponse,
+    UpdatePeerGlobal,
+)
+
+
+# ---- RateLimitReq ----------------------------------------------------
+def req_to_pb(r: RateLimitRequest) -> pb.RateLimitReq:
+    return pb.RateLimitReq(
+        name=r.name,
+        unique_key=r.unique_key,
+        hits=int(r.hits),
+        limit=int(r.limit),
+        duration=int(r.duration),
+        algorithm=int(r.algorithm),
+        behavior=int(r.behavior),
+    )
+
+
+def req_from_pb(m: pb.RateLimitReq) -> RateLimitRequest:
+    return RateLimitRequest(
+        name=m.name,
+        unique_key=m.unique_key,
+        hits=m.hits,
+        limit=m.limit,
+        duration=m.duration,
+        algorithm=int(m.algorithm),
+        behavior=int(m.behavior),
+    )
+
+
+# ---- RateLimitResp ---------------------------------------------------
+def resp_to_pb(r: RateLimitResponse) -> pb.RateLimitResp:
+    m = pb.RateLimitResp(
+        status=int(r.status),
+        limit=int(r.limit),
+        remaining=int(r.remaining),
+        reset_time=int(r.reset_time),
+        error=r.error,
+    )
+    for k, v in (r.metadata or {}).items():
+        m.metadata[k] = v
+    return m
+
+
+def resp_from_pb(m: pb.RateLimitResp) -> RateLimitResponse:
+    return RateLimitResponse(
+        status=int(m.status),
+        limit=m.limit,
+        remaining=m.remaining,
+        reset_time=m.reset_time,
+        error=m.error,
+        metadata=dict(m.metadata),
+    )
+
+
+# ---- batch envelopes -------------------------------------------------
+def get_rate_limits_req_to_pb(req: GetRateLimitsRequest) -> pb.GetRateLimitsReq:
+    return pb.GetRateLimitsReq(requests=[req_to_pb(r) for r in req.requests])
+
+
+def get_rate_limits_req_from_pb(m: pb.GetRateLimitsReq) -> GetRateLimitsRequest:
+    return GetRateLimitsRequest(requests=[req_from_pb(r) for r in m.requests])
+
+
+def get_rate_limits_resp_to_pb(resp: GetRateLimitsResponse) -> pb.GetRateLimitsResp:
+    return pb.GetRateLimitsResp(responses=[resp_to_pb(r) for r in resp.responses])
+
+
+def get_rate_limits_resp_from_pb(m: pb.GetRateLimitsResp) -> GetRateLimitsResponse:
+    return GetRateLimitsResponse(responses=[resp_from_pb(r) for r in m.responses])
+
+
+def peer_rate_limits_req_to_pb(req: GetRateLimitsRequest) -> peers_pb.GetPeerRateLimitsReq:
+    return peers_pb.GetPeerRateLimitsReq(requests=[req_to_pb(r) for r in req.requests])
+
+
+def peer_rate_limits_req_from_pb(m: peers_pb.GetPeerRateLimitsReq) -> GetRateLimitsRequest:
+    return GetRateLimitsRequest(requests=[req_from_pb(r) for r in m.requests])
+
+
+def peer_rate_limits_resp_to_pb(resp: GetRateLimitsResponse) -> peers_pb.GetPeerRateLimitsResp:
+    return peers_pb.GetPeerRateLimitsResp(rate_limits=[resp_to_pb(r) for r in resp.responses])
+
+
+def peer_rate_limits_resp_from_pb(m: peers_pb.GetPeerRateLimitsResp) -> GetRateLimitsResponse:
+    return GetRateLimitsResponse(responses=[resp_from_pb(r) for r in m.rate_limits])
+
+
+# ---- GLOBAL broadcast ------------------------------------------------
+def update_global_to_pb(u: UpdatePeerGlobal) -> peers_pb.UpdatePeerGlobal:
+    return peers_pb.UpdatePeerGlobal(
+        key=u.key, status=resp_to_pb(u.status), algorithm=int(u.algorithm)
+    )
+
+
+def update_global_from_pb(m: peers_pb.UpdatePeerGlobal) -> UpdatePeerGlobal:
+    return UpdatePeerGlobal(
+        key=m.key, status=resp_from_pb(m.status), algorithm=int(m.algorithm)
+    )
+
+
+def update_globals_req_to_pb(updates: Iterable[UpdatePeerGlobal]) -> peers_pb.UpdatePeerGlobalsReq:
+    return peers_pb.UpdatePeerGlobalsReq(globals=[update_global_to_pb(u) for u in updates])
+
+
+def update_globals_req_from_pb(m: peers_pb.UpdatePeerGlobalsReq) -> List[UpdatePeerGlobal]:
+    return [update_global_from_pb(u) for u in m.globals]
+
+
+# ---- HealthCheck -----------------------------------------------------
+def health_to_pb(h: HealthCheckResponse) -> pb.HealthCheckResp:
+    return pb.HealthCheckResp(
+        status=h.status, message=h.message, peer_count=int(h.peer_count)
+    )
+
+
+def health_from_pb(m: pb.HealthCheckResp) -> HealthCheckResponse:
+    return HealthCheckResponse(
+        status=m.status, message=m.message, peer_count=m.peer_count
+    )

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Regenerate the Python protobuf stubs (reference scripts/proto.sh).
+#
+# Only `protoc --python_out` is needed: gRPC service wiring is
+# hand-rolled with grpc generic handlers (gubernator_tpu/grpc_server.py,
+# peer_client.py) so the grpc_python_plugin is not required.
+set -euo pipefail
+cd "$(dirname "$0")/../gubernator_tpu/proto"
+
+protoc --python_out=. gubernator.proto peers.proto
+
+# protoc emits an absolute sibling import; rewrite it for package use.
+sed -i 's/^import gubernator_pb2 as gubernator__pb2$/from gubernator_tpu.proto import gubernator_pb2 as gubernator__pb2/' peers_pb2.py
+
+echo "generated: $(ls *_pb2.py)"

@@ -44,7 +44,7 @@ def cluster(clock):
 
 
 def client_for(cluster, dc=DATA_CENTER_NONE):
-    return V1Client(cluster.get_random_peer(dc).grpc_address)
+    return V1Client(cluster.get_random_peer(dc).http_address)
 
 
 def mk(name, key, hits=1, limit=10, duration=9 * SECOND, algo=Algorithm.TOKEN_BUCKET, behavior=0):
@@ -146,7 +146,7 @@ def test_forwarding_sets_owner_metadata(cluster):
             break
     else:
         pytest.skip("no foreign key found")
-    client = V1Client(entry.peer_info.grpc_address)
+    client = V1Client(entry.peer_info.http_address)
     resp = client.get_rate_limits(
         GetRateLimitsRequest(requests=[mk("test_forward", key, limit=5)])
     )
@@ -156,7 +156,7 @@ def test_forwarding_sets_owner_metadata(cluster):
     assert rl.metadata.get("owner") == peer.info.grpc_address
     # hitting it again via the owner's daemon shows shared state
     owner_daemon = cluster.daemon_for(peer.info)
-    oc = V1Client(owner_daemon.peer_info.grpc_address)
+    oc = V1Client(owner_daemon.peer_info.http_address)
     rl = oc.get_rate_limits(
         GetRateLimitsRequest(requests=[mk("test_forward", key, limit=5)])
     ).responses[0]
@@ -184,7 +184,7 @@ def test_global_rate_limits(cluster, clock):
             break
     assert entry is not None
     owner_daemon = cluster.daemon_for(entry.service.get_peer(hash_key).info)
-    client = V1Client(entry.peer_info.grpc_address)
+    client = V1Client(entry.peer_info.http_address)
 
     def send(hits=1):
         return client.get_rate_limits(
@@ -202,8 +202,8 @@ def test_global_rate_limits(cluster, clock):
 
     # Async hit pipeline on the entry daemon; broadcast pipeline on the
     # owner — observed via prometheus, like the reference.
-    ec = V1Client(entry.peer_info.grpc_address)
-    oc = V1Client(owner_daemon.peer_info.grpc_address)
+    ec = V1Client(entry.peer_info.http_address)
+    oc = V1Client(owner_daemon.peer_info.http_address)
     assert until_pass(
         lambda: get_metric(ec.metrics_text(), "gubernator_async_durations_count") > 0
     )
@@ -214,6 +214,19 @@ def test_global_rate_limits(cluster, clock):
     # count from the broadcast cache.
     assert until_pass(lambda: send(hits=0).remaining == 4)
 
+    # Now land hits directly at the OWNER: the entry can only learn
+    # about them through the UpdatePeerGlobals broadcast, so this pins
+    # actual broadcast delivery (not just the pipeline metrics).
+    rl = oc.get_rate_limits(
+        GetRateLimitsRequest(
+            requests=[mk(name, key, hits=2, limit=5, duration=60 * SECOND,
+                         behavior=Behavior.GLOBAL)]
+        )
+    ).responses[0]
+    assert rl.error == ""
+    assert rl.remaining == 2
+    assert until_pass(lambda: send(hits=0).remaining == 2)
+
 
 def test_multi_region_hits_propagate(cluster, clock):
     """TestMutliRegion is a stub in the reference (functional_test.go:
@@ -222,7 +235,7 @@ def test_multi_region_hits_propagate(cluster, clock):
     name, key = "test_multi", "account:6789"
     hash_key = f"{name}_{key}"
     entry = cluster.daemons[0]  # DataCenterNone
-    client = V1Client(entry.peer_info.grpc_address)
+    client = V1Client(entry.peer_info.http_address)
     rl = client.get_rate_limits(
         GetRateLimitsRequest(
             requests=[mk(name, key, hits=3, limit=100, duration=60 * SECOND,
@@ -256,7 +269,7 @@ def test_multi_region_no_amplification(clock):
     cl = Cluster().start_with(["region-us", "region-eu"], clock=clock)
     try:
         us, eu = cl.daemons
-        client = V1Client(us.peer_info.grpc_address)
+        client = V1Client(us.peer_info.http_address)
         rl = client.get_rate_limits(
             GetRateLimitsRequest(
                 requests=[mk("test_amp", "account:1", hits=3, limit=100,
@@ -310,7 +323,7 @@ def test_health_check_unhealthy_on_peer_failure(cluster, clock):
     assert key is not None
     cluster.daemons[victim_idx].close()
 
-    client = V1Client(entry.peer_info.grpc_address)
+    client = V1Client(entry.peer_info.http_address)
     resp = client.get_rate_limits(
         GetRateLimitsRequest(requests=[mk("test_health", key, limit=5)])
     )

@@ -72,7 +72,7 @@ def test_server_tls_with_file_certs(certs):
     try:
         ctx = tlsmod.client_context(ca_file=certs["ca"])
         ctx.check_hostname = False  # cert SANs cover IPs, not required here
-        client = V1Client(d.peer_info.grpc_address, tls_context=ctx)
+        client = V1Client(d.peer_info.http_address, tls_context=ctx)
         rl = one(client, "file_certs")
         assert rl.error == "" and rl.remaining == 9
         assert "gubernator_cache_size" in client.metrics_text()
@@ -85,7 +85,7 @@ def test_auto_tls(certs):
     d = spawn(tlsmod.TLSConfig(auto_tls=True))
     try:
         ctx = tlsmod.client_context(insecure_skip_verify=True)
-        client = V1Client(d.peer_info.grpc_address, tls_context=ctx)
+        client = V1Client(d.peer_info.http_address, tls_context=ctx)
         assert one(client, "auto_tls").error == ""
     finally:
         d.close()
@@ -104,14 +104,14 @@ def test_mtls_require_and_verify(certs):
             ca_file=certs["ca"], cert_file=certs["cli_crt"], key_file=certs["cli_key"]
         )
         ctx.check_hostname = False
-        client = V1Client(d.peer_info.grpc_address, tls_context=ctx)
+        client = V1Client(d.peer_info.http_address, tls_context=ctx)
         assert one(client, "mtls_ok").error == ""
 
         # Negative: no client cert -> handshake/request must fail
         # (tls_test.go:157-204).
         bare = tlsmod.client_context(ca_file=certs["ca"])
         bare.check_hostname = False
-        bad = V1Client(d.peer_info.grpc_address, tls_context=bare, timeout_s=2.0)
+        bad = V1Client(d.peer_info.http_address, tls_context=bare, timeout_s=2.0)
         with pytest.raises((ssl.SSLError, OSError, RuntimeError)):
             one(bad, "mtls_missing_cert")
     finally:
@@ -135,7 +135,7 @@ def test_two_node_tls_cluster_peer_forwarding(certs):
             ca_file=certs["ca"], cert_file=certs["crt"], key_file=certs["key"]
         )
         ctx.check_hostname = False
-        client = V1Client(d1.peer_info.grpc_address, tls_context=ctx)
+        client = V1Client(d1.peer_info.http_address, tls_context=ctx)
         # find a key d1 does NOT own so the call crosses the TLS peer leg
         for i in range(100):
             key = f"{i}_fwd_tls"
@@ -145,7 +145,7 @@ def test_two_node_tls_cluster_peer_forwarding(certs):
             pytest.skip("no foreign key found")
         rl = one(client, key)
         assert rl.error == "" and rl.remaining == 9
-        oc = V1Client(d2.peer_info.grpc_address, tls_context=ctx)
+        oc = V1Client(d2.peer_info.http_address, tls_context=ctx)
         metrics = oc.metrics_text()
         assert 'method="/pb.gubernator.PeersV1/GetPeerRateLimits"' in metrics
     finally:
