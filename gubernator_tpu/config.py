@@ -64,6 +64,11 @@ class DaemonConfig:
     peers: List[PeerInfo] = field(default_factory=list)
     peer_discovery_type: str = "static"  # static | file | etcd | member-list | k8s
     peers_file: str = ""
+    # member-list gossip knobs (reference MemberListPoolConfig,
+    # memberlist.go:44-66 / config.go:314-317).
+    member_list_address: str = ""  # bind host:port, default advertise_host:7946
+    member_list_known_nodes: List[str] = field(default_factory=list)
+    member_list_node_name: str = ""
     store: object = None
     loader: object = None
     debug: bool = False
@@ -169,6 +174,18 @@ def setup_daemon_config(
             f"'etcd', 'member-list' or 'k8s' got '{conf.peer_discovery_type}'"
         )
     conf.peers_file = merged.get("GUBER_PEERS_FILE", "")
+    conf.member_list_address = merged.get("GUBER_MEMBERLIST_ADDRESS", "")
+    conf.member_list_known_nodes = [
+        n.strip()
+        for n in merged.get("GUBER_MEMBERLIST_KNOWN_NODES", "").split(",")
+        if n.strip()
+    ]
+    conf.member_list_node_name = merged.get("GUBER_MEMBERLIST_NODE_NAME", "")
+    if conf.peer_discovery_type == "member-list" and not conf.member_list_known_nodes:
+        raise ValueError(
+            "when member-list is used for peer discovery, you MUST provide a "
+            "list of known nodes via GUBER_MEMBERLIST_KNOWN_NODES"
+        )  # config.go:366-370
 
     b = conf.behaviors
     b.batch_timeout_s = _env_float_ms(merged, "GUBER_BATCH_TIMEOUT", b.batch_timeout_s)

@@ -36,7 +36,16 @@ class Daemon:
 
     # ------------------------------------------------------------------
     def start(self) -> "Daemon":
-        """daemon.go:72-251."""
+        """daemon.go:72-251.  On any startup failure, tear down whatever
+        was already running — a half-started daemon must not leak bound
+        ports and service threads to a retrying supervisor."""
+        try:
+            return self._start()
+        except BaseException:
+            self.close()
+            raise
+
+    def _start(self) -> "Daemon":
         tls_conf = setup_tls(self.conf.tls)
         server_tls = tls_conf.server_ctx if tls_conf else None
         # Peer data plane credentials: gRPC channel creds unless the
@@ -60,6 +69,10 @@ class Daemon:
             peer_channel_credentials=peer_creds,
         )
         self.service = V1Service(svc_conf)
+        # Compile the device programs BEFORE accepting traffic: a cold
+        # first dispatch (remote-tunnel compiles take tens of seconds)
+        # would otherwise land inside a client's RPC deadline.
+        self.service.store.warmup(self.clock.now_ms())
         grpc_listen = self.conf.grpc_listen_address
         if not grpc_listen:
             host, _, _ = self.conf.listen_address.partition(":")
@@ -90,7 +103,10 @@ class Daemon:
             from .peers import make_pool
 
             self._pool = make_pool(
-                self.conf.peer_discovery_type, self.conf, on_update=self.set_peers
+                self.conf.peer_discovery_type,
+                self.conf,
+                on_update=self.set_peers,
+                advertise=self.peer_info,
             )
         self.wait_for_connect()
         return self

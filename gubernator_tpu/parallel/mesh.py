@@ -69,9 +69,27 @@ def shard_of_key(key: str, n_shards: int) -> int:
 
 @partial(jax.jit, donate_argnums=(0, 1))
 def _answer_jit(state, gcols, batch, extra, now):
-    return jax.vmap(global_ops.answer_batch, in_axes=(0, 0, 0, 0, None))(
-        state, gcols, batch, extra, now
-    )
+    """Per-shard answer kernel with PACKED output: one i64[S, 5, B]
+    array carries status/removed/cached (bit-packed), limit, remaining,
+    reset_time, new_expire, so the host pays ONE device->host transfer
+    per round instead of seven (each blocking readback is a full RTT —
+    the dominant cost when the device sits behind a network tunnel)."""
+
+    def one(state_s, gcols_s, batch_s, extra_s):
+        ns, ng, out, cached = global_ops.answer_batch(
+            state_s, gcols_s, batch_s, extra_s, now
+        )
+        row0 = (
+            out.status.astype(jnp.int64)
+            | (out.removed.astype(jnp.int64) << 1)
+            | (cached.astype(jnp.int64) << 2)
+        )
+        packed = jnp.stack(
+            (row0, out.limit, out.remaining, out.reset_time, out.new_expire)
+        )
+        return ns, ng, packed
+
+    return jax.vmap(one)(state, gcols, batch, extra)
 
 
 @partial(jax.jit, donate_argnums=0)
@@ -107,15 +125,32 @@ def _get_sync_fn(mesh: Mesh, axis: str):
             ns, ngc, out, applied, total = global_ops.global_sync(
                 sq(state), sq(gcols), cfg, dirty[0], now, axis=axis
             )
+            # Pack every host-bound column into one i64[8, G] per shard
+            # (one readback per sync, not nine): row 0 bit-packs
+            # removed/applied; the rep_* rows are identical across
+            # shards post-broadcast, so the host reads shard 0's copy.
+            i64 = jnp.int64
+            packed = jnp.stack(
+                (
+                    out.removed.astype(i64) | (applied.astype(i64) << 1),
+                    out.new_expire,
+                    total,
+                    ngc.rep_status.astype(i64),
+                    ngc.rep_limit,
+                    ngc.rep_remaining,
+                    ngc.rep_reset,
+                    ngc.rep_expire,
+                )
+            )
             ex = lambda t: jax.tree.map(lambda a: a[None], t)
-            return ex(ns), ex(ngc), ex(out), applied[None], total[None]
+            return ex(ns), ex(ngc), packed[None]
 
         fn = jax.jit(
             shard_map(
                 _sync_body,
                 mesh=mesh,
                 in_specs=(P(axis), P(axis), P(), P(axis), P()),
-                out_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
+                out_specs=(P(axis), P(axis), P(axis)),
             ),
             donate_argnums=(0, 1),
         )
@@ -293,17 +328,19 @@ class MeshBucketStore:
             gslot=jax.device_put(jnp.asarray(gslot), self._sharding)
         )
 
-        self.state, self.gcols, out, cached = self._answer_fn(
+        self.state, self.gcols, packed = self._answer_fn(
             self.state, self.gcols, batch, extra, now_ms
         )
 
-        out_status = np.asarray(out.status)
-        out_limit = np.asarray(out.limit)
-        out_rem = np.asarray(out.remaining)
-        out_reset = np.asarray(out.reset_time)
-        out_exp = np.asarray(out.new_expire)
-        out_removed = np.asarray(out.removed)
-        cached_np = np.asarray(cached)
+        packed_np = np.asarray(packed)  # [S, 5, B] — the one blocking transfer
+        row0 = packed_np[:, 0]
+        out_status = (row0 & 1).astype(np.int32)
+        out_removed = ((row0 >> 1) & 1).astype(bool)
+        cached_np = ((row0 >> 2) & 1).astype(bool)
+        out_limit = packed_np[:, 1]
+        out_rem = packed_np[:, 2]
+        out_reset = packed_np[:, 3]
+        out_exp = packed_np[:, 4]
 
         for s, chunk in enumerate(chunks):
             if not chunk:
@@ -460,19 +497,21 @@ class MeshBucketStore:
             greg_duration=jnp.asarray(self.gtable.greg_duration),
         )
         dirty_dev = jax.device_put(jnp.asarray(self.dirty), self._sharding)
-        self.state, self.gcols, out, applied, totals = self._sync_fn(
+        self.state, self.gcols, packed = self._sync_fn(
             self.state, self.gcols, cfg, dirty_dev, now_ms
         )
 
-        out_exp = np.asarray(out.new_expire)
-        out_rm = np.asarray(out.removed)
-        applied_np = np.asarray(applied)[0]
-        totals_np = np.asarray(totals)[0]
-        rep_status = np.asarray(self.gcols.rep_status)[0]
-        rep_limit = np.asarray(self.gcols.rep_limit)[0]
-        rep_remaining = np.asarray(self.gcols.rep_remaining)[0]
-        rep_reset = np.asarray(self.gcols.rep_reset)[0]
-        self.gtable.rep_expire[:] = np.asarray(self.gcols.rep_expire)[0]
+        packed_np = np.asarray(packed)  # [S, 8, G] — the one blocking transfer
+        out_rm = (packed_np[:, 0] & 1).astype(bool)
+        out_exp = packed_np[:, 1]
+        # psum results are replicated across shards; read shard 0's copy.
+        applied_np = ((packed_np[0, 0] >> 1) & 1).astype(bool)
+        totals_np = packed_np[0, 2]
+        rep_status = packed_np[0, 3]
+        rep_limit = packed_np[0, 4]
+        rep_remaining = packed_np[0, 5]
+        rep_reset = packed_np[0, 6]
+        self.gtable.rep_expire[:] = packed_np[0, 7]
 
         result = SyncResult()
         for g in active:
@@ -517,5 +556,20 @@ class MeshBucketStore:
         return result
 
     # ------------------------------------------------------------------
+    @_locked
+    def warmup(self, now_ms: int) -> None:
+        """Compile the hot programs before serving traffic.  A daemon
+        that starts answering RPCs cold pays the first-dispatch XLA
+        compile (tens of seconds over a remote-device tunnel) inside a
+        client's 500ms deadline; run it here instead, behind the same
+        readiness gate as WaitForConnect (daemon.go:242-248).  Uses a
+        reserved key with a 1ms duration so the slot recycles on the
+        next eviction scan."""
+        req = RateLimitRequest(
+            name="__warmup__", unique_key="__warmup__", hits=0, limit=1, duration=1
+        )
+        self.apply([req], now_ms)  # reentrant: the instance lock is an RLock
+        self.sync_globals(now_ms)
+
     def size(self) -> int:
         return sum(len(t) for t in self.tables)

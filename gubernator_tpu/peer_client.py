@@ -43,10 +43,12 @@ from .types import (
 
 ERR_CLOSING = "grpc: the client connection is closing"
 
-_NOT_READY_CODES = (
-    grpc.StatusCode.UNAVAILABLE,
-    grpc.StatusCode.DEADLINE_EXCEEDED,
-)
+# Only connection-level failures count as "not ready" (the reference's
+# IsNotReady checks the connecting state machine, peer_client.go:405-412).
+# DEADLINE_EXCEEDED is deliberately NOT here: a timed-out RPC may still
+# have executed server-side (Python gRPC handlers run to completion after
+# the client deadline), so retrying it would double-count hits.
+_NOT_READY_CODES = (grpc.StatusCode.UNAVAILABLE,)
 
 
 class PeerError(Exception):

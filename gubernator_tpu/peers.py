@@ -2,19 +2,19 @@
 
 The reference ships three backends (etcd lease+watch, memberlist gossip,
 k8s informer — etcd.go / memberlist.go / kubernetes.go), all pushing
-`[]PeerInfo` through an OnUpdate callback.  This environment has none of
-those client libraries installed, so the zero-dependency backends are:
+`[]PeerInfo` through an OnUpdate callback.  This build keeps the same
+config surface (GUBER_PEER_DISCOVERY_TYPE) with zero-dependency
+implementations:
 
-  * static   — fixed list in DaemonConfig.peers (the cluster harness and
-               tests use this, like cluster/cluster.go bypasses
-               discovery entirely)
-  * file     — a watched JSON file of PeerInfo entries; editing the file
-               is the membership event (closest stand-in for an external
-               discovery plane)
+  * static       — fixed list in DaemonConfig.peers (the cluster harness
+                   and tests use this, like cluster/cluster.go bypasses
+                   discovery entirely)
+  * file         — a watched JSON file of PeerInfo entries; editing the
+                   file is the membership event
+  * member-list  — native SWIM gossip (gubernator_tpu.gossip), the
+                   hashicorp/memberlist equivalent
 
-`make_pool` raises a clear error for etcd/member-list/k8s unless the
-optional client library is importable, keeping the reference's config
-surface (GUBER_PEER_DISCOVERY_TYPE) intact.
+etcd and k8s still raise until their native client planes land.
 """
 
 from __future__ import annotations
@@ -77,8 +77,10 @@ class FilePool:
         self._thread.join(timeout=2.0)
 
 
-def make_pool(kind: str, conf, on_update: OnUpdate):
-    """daemon.go:163-192 discovery switch."""
+def make_pool(kind: str, conf, on_update: OnUpdate, advertise: Optional[PeerInfo] = None):
+    """daemon.go:163-192 discovery switch.  `advertise` is this daemon's
+    own PeerInfo, required by the backends that register/gossip
+    themselves (member-list, etcd)."""
     if kind == "static":
         return StaticPool(conf.peers, on_update)
     if kind == "file":
@@ -93,9 +95,19 @@ def make_pool(kind: str, conf, on_update: OnUpdate):
             ) from e
         raise NotImplementedError("etcd pool: install etcd3 and wire EtcdPool here")
     if kind == "member-list":
-        raise RuntimeError(
-            "member-list gossip discovery is not available in this build; "
-            "use 'static' or 'file' (the reference uses hashicorp/memberlist)"
+        from .gossip import GossipPool
+
+        if not advertise:
+            raise ValueError("member-list discovery requires an advertise PeerInfo")
+        # Default bind: advertise_host:7946 (config.go:315) — binding
+        # loopback would gossip an unreachable address to remote peers.
+        adv_host = advertise.grpc_address.partition(":")[0]
+        return GossipPool(
+            advertise=advertise,
+            member_list_address=conf.member_list_address or f"{adv_host}:7946",
+            on_update=on_update,
+            known_nodes=conf.member_list_known_nodes,
+            node_name=conf.member_list_node_name,
         )
     if kind == "k8s":
         try:

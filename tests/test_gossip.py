@@ -1,0 +1,179 @@
+"""SWIM gossip membership tests (reference memberlist backend,
+memberlist.go).  All nodes run in-process on loopback with ephemeral
+ports and aggressive timers, mirroring how the reference's cluster
+harness shortens behavior knobs for tests (cluster/cluster.go:104-110).
+"""
+
+import time
+
+import pytest
+
+from gubernator_tpu.gossip import Gossip, GossipPool
+from gubernator_tpu.types import PeerInfo
+
+FAST = dict(
+    probe_interval_s=0.05,
+    probe_timeout_s=0.1,
+    suspect_timeout_s=0.3,
+    sync_interval_s=0.2,
+)
+
+
+def wait_until(fn, timeout_s=5.0, every_s=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(every_s)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def make_node(name, **kw):
+    opts = {**FAST, **kw}
+    return Gossip("127.0.0.1:0", name=name, **opts)
+
+
+class TestGossip:
+    def test_three_nodes_converge(self):
+        nodes = [make_node(f"n{i}") for i in range(3)]
+        try:
+            nodes[1].join([nodes[0].address])
+            nodes[2].join([nodes[0].address])
+            for n in nodes:
+                wait_until(
+                    lambda n=n: len(n.members()) == 3,
+                    msg=f"{n.name} sees 3 members",
+                )
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_graceful_leave_disseminates(self):
+        nodes = [make_node(f"l{i}") for i in range(3)]
+        try:
+            nodes[1].join([nodes[0].address])
+            nodes[2].join([nodes[1].address])
+            for n in nodes:
+                wait_until(lambda n=n: len(n.members()) == 3, msg="join")
+            nodes[2].leave()
+            nodes[2].close()
+            for n in nodes[:2]:
+                wait_until(
+                    lambda n=n: {m.name for m in n.members()} == {"l0", "l1"},
+                    msg=f"{n.name} drops l2",
+                )
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_crash_detected_via_suspicion(self):
+        nodes = [make_node(f"c{i}") for i in range(3)]
+        try:
+            nodes[1].join([nodes[0].address])
+            nodes[2].join([nodes[0].address])
+            for n in nodes:
+                wait_until(lambda n=n: len(n.members()) == 3, msg="join")
+            nodes[2].close()  # crash: no leave broadcast
+            for n in nodes[:2]:
+                wait_until(
+                    lambda n=n: {m.name for m in n.members()} == {"c0", "c1"},
+                    timeout_s=10.0,
+                    msg=f"{n.name} detects c2 dead",
+                )
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_meta_update_propagates(self):
+        a = make_node("ma")
+        b = make_node("mb")
+        try:
+            b.join([a.address])
+            wait_until(lambda: len(a.members()) == 2, msg="join")
+            b.set_meta({"grpcAddress": "10.0.0.9:81"})
+            wait_until(
+                lambda: next(
+                    (m for m in a.members() if m.name == "mb"), None
+                ) is not None
+                and next(m for m in a.members() if m.name == "mb").meta.get("grpcAddress")
+                == "10.0.0.9:81",
+                msg="meta propagates",
+            )
+        finally:
+            a.close()
+            b.close()
+
+    def test_join_unreachable_seed_times_out(self):
+        a = make_node("t0")
+        try:
+            with pytest.raises(TimeoutError):
+                a.join(["127.0.0.1:1"], timeout_s=0.5)
+        finally:
+            a.close()
+
+
+class TestGossipPool:
+    def test_pool_delivers_peerinfo(self):
+        updates = {0: [], 1: [], 2: []}
+        pools = []
+        try:
+            for i in range(3):
+                seeds = [pools[0].address] if pools else []
+                pools.append(
+                    GossipPool(
+                        advertise=PeerInfo(
+                            grpc_address=f"127.0.0.1:{9000 + i}",
+                            http_address=f"127.0.0.1:{9100 + i}",
+                            data_center="dc-1" if i == 2 else "",
+                        ),
+                        member_list_address="127.0.0.1:0",
+                        on_update=lambda peers, i=i: updates[i].append(peers),
+                        known_nodes=seeds,
+                        node_name=f"p{i}",
+                        **FAST,
+                    )
+                )
+            want = {f"127.0.0.1:{9000 + i}" for i in range(3)}
+            for i in range(3):
+                wait_until(
+                    lambda i=i: updates[i]
+                    and {p.grpc_address for p in updates[i][-1]} == want,
+                    msg=f"pool {i} sees all three PeerInfos",
+                )
+            # Metadata fields survive the gossip round trip.
+            last = updates[0][-1]
+            dc = next(p for p in last if p.grpc_address == "127.0.0.1:9002")
+            assert dc.data_center == "dc-1"
+            assert dc.http_address == "127.0.0.1:9102"
+        finally:
+            for p in pools:
+                p.close()
+
+    def test_pool_close_removes_peer(self):
+        updates = {0: [], 1: []}
+        pools = []
+        try:
+            for i in range(2):
+                seeds = [pools[0].address] if pools else []
+                pools.append(
+                    GossipPool(
+                        advertise=PeerInfo(grpc_address=f"127.0.0.1:{9200 + i}"),
+                        member_list_address="127.0.0.1:0",
+                        on_update=lambda peers, i=i: updates[i].append(peers),
+                        known_nodes=seeds,
+                        node_name=f"q{i}",
+                        **FAST,
+                    )
+                )
+            wait_until(
+                lambda: updates[0] and len(updates[0][-1]) == 2, msg="both join"
+            )
+            pools[1].close()
+            wait_until(
+                lambda: updates[0]
+                and [p.grpc_address for p in updates[0][-1]] == ["127.0.0.1:9200"],
+                msg="peer removed after close",
+            )
+        finally:
+            for p in pools:
+                p.close()

@@ -187,6 +187,15 @@ def test_grpc_peer_transport_used(cluster):
     finally:
         client.close()
     assert _peer_rpc_count(owner) == before + 1
+    # Pin the transport itself, not just the service-layer counter
+    # (which the HTTP gateway peer route also increments): the entry
+    # daemon's client for the owner must have exercised the gRPC
+    # channel (lazily built on first gRPC use) and never opened the
+    # HTTP fallback connection.
+    peer = entry.service.get_peer("grpc_count_account:2")
+    assert peer.transport == "grpc"
+    assert peer._channel is not None
+    assert peer._conn is None
 
 
 def _peer_rpc_count(daemon) -> float:
