@@ -69,6 +69,18 @@ class DaemonConfig:
     member_list_address: str = ""  # bind host:port, default advertise_host:7946
     member_list_known_nodes: List[str] = field(default_factory=list)
     member_list_node_name: str = ""
+    # etcd discovery knobs (reference EtcdPoolConfig, etcd.go:54-72 /
+    # config.go:304-312).
+    etcd_endpoints: List[str] = field(default_factory=lambda: ["localhost:2379"])
+    etcd_key_prefix: str = "/gubernator/peers/"
+    etcd_advertise_address: str = ""  # defaults to the daemon advertise address
+    # k8s discovery knobs (reference K8sPoolConfig, kubernetes.go:63-72 /
+    # config.go:320-328).
+    k8s_namespace: str = "default"
+    k8s_pod_ip: str = ""
+    k8s_pod_port: str = "81"  # reference default (kubernetes.go peer port)
+    k8s_selector: str = ""
+    k8s_mechanism: str = "endpoints"  # endpoints | pods
     store: object = None
     loader: object = None
     debug: bool = False
@@ -181,6 +193,32 @@ def setup_daemon_config(
         if n.strip()
     ]
     conf.member_list_node_name = merged.get("GUBER_MEMBERLIST_NODE_NAME", "")
+    etcd_endpoints = merged.get("GUBER_ETCD_ENDPOINTS", "")
+    if etcd_endpoints:
+        conf.etcd_endpoints = [e.strip() for e in etcd_endpoints.split(",") if e.strip()]
+    conf.etcd_key_prefix = merged.get("GUBER_ETCD_KEY_PREFIX", conf.etcd_key_prefix)
+    conf.etcd_advertise_address = merged.get("GUBER_ETCD_ADVERTISE_ADDRESS", "")
+    conf.k8s_namespace = merged.get("GUBER_K8S_NAMESPACE", conf.k8s_namespace)
+    conf.k8s_pod_ip = merged.get("GUBER_K8S_POD_IP", "")
+    conf.k8s_pod_port = merged.get("GUBER_K8S_POD_PORT", "") or conf.k8s_pod_port
+    conf.k8s_selector = merged.get("GUBER_K8S_ENDPOINTS_SELECTOR", "")
+    from .k8s_pool import watch_mechanism_from_string
+
+    try:
+        conf.k8s_mechanism = watch_mechanism_from_string(
+            merged.get("GUBER_K8S_WATCH_MECHANISM", "")
+        )
+    except ValueError:
+        raise ValueError(
+            "`GUBER_K8S_WATCH_MECHANISM` needs to be either 'endpoints' or "
+            "'pods' (defaults to 'endpoints')"
+        ) from None
+    if conf.peer_discovery_type == "k8s" and not conf.k8s_selector:
+        raise ValueError(
+            "when using k8s for peer discovery, you MUST provide a "
+            "`GUBER_K8S_ENDPOINTS_SELECTOR` to select the gubernator peers "
+            "from the endpoints listing"
+        )  # config.go:356-360
     if conf.peer_discovery_type == "member-list" and not conf.member_list_known_nodes:
         raise ValueError(
             "when member-list is used for peer discovery, you MUST provide a "

@@ -403,8 +403,11 @@ class Gossip:
         changed = False
         with self._lock:
             if name == self.name:
-                # Refute rumors about ourselves (SWIM refutation).
-                if state in (SUSPECT, DEAD) and inc >= self._me.incarnation:
+                # Refute rumors about ourselves (SWIM refutation).  LEFT
+                # must be refuted too: a restarted node that reuses its
+                # name hears its own stale leave echoed back in push-pull
+                # state and must out-increment it to become visible again.
+                if state in (SUSPECT, DEAD, LEFT) and inc >= self._me.incarnation:
                     self._me.incarnation = inc + 1
                     self._queue_update(self._me.to_update())
                 return

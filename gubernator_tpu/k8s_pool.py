@@ -1,0 +1,305 @@
+"""Kubernetes discovery pool — Endpoints/Pods list+watch membership.
+
+Reference behavior (kubernetes.go): a SharedIndexInformer watches either
+the Endpoints of a Service or Pods by label selector
+(kubernetes.go:44-62, 155-181); every add/update/delete rebuilds the
+peer list from the informer store — endpoint subset addresses or
+running-and-ready pod IPs, each as `ip:pod_port`, with IsOwner matched
+by PodIP (kubernetes.go:183-237).
+
+The reference depends on client-go; this build implements the informer
+pattern directly over the Kubernetes HTTP API with the stdlib: an
+initial LIST captures state + resourceVersion, a chunked WATCH stream
+applies JSON events from that version, and any stream failure (timeout,
+410 Gone) falls back to relist-then-rewatch — the same list/watch
+contract client-go's Reflector implements.  In-cluster credentials come
+from the standard service-account mount, like client-go's
+rest.InClusterConfig (kubernetesconfig.go:1-11).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import os
+import socket
+import ssl
+import threading
+import urllib.parse
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .types import PeerInfo
+
+log = logging.getLogger("gubernator.k8s")
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+BACKOFF_S = 5.0
+
+WATCH_ENDPOINTS = "endpoints"
+WATCH_PODS = "pods"
+
+
+def watch_mechanism_from_string(mechanism: str) -> str:
+    """kubernetes.go:51-62: empty defaults to endpoints."""
+    if mechanism in ("", WATCH_ENDPOINTS):
+        return WATCH_ENDPOINTS
+    if mechanism == WATCH_PODS:
+        return WATCH_PODS
+    raise ValueError(f"unknown watch mechanism specified: {mechanism}")
+
+
+class K8sApiClient:
+    """Minimal Kubernetes API client (list + watch) over stdlib HTTP.
+
+    Defaults to in-cluster config: KUBERNETES_SERVICE_HOST/PORT env plus
+    the service-account token and CA from the standard mount.  Tests
+    and out-of-cluster use pass `api_url` (http:// or https://) and an
+    optional token/ca_file directly.
+    """
+
+    def __init__(
+        self,
+        api_url: str = "",
+        token: str = "",
+        ca_file: str = "",
+    ):
+        if not api_url:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise RuntimeError(
+                    "not running in-cluster (no KUBERNETES_SERVICE_HOST) and "
+                    "no api_url was provided"
+                )
+            api_url = f"https://{host}:{port}"
+        self.api_url = api_url.rstrip("/")
+        if not token:
+            token_path = os.path.join(SERVICE_ACCOUNT_DIR, "token")
+            if os.path.exists(token_path):
+                with open(token_path) as f:
+                    token = f.read().strip()
+        self.token = token
+        if not ca_file:
+            default_ca = os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt")
+            if os.path.exists(default_ca):
+                ca_file = default_ca
+        self._ssl_ctx: Optional[ssl.SSLContext] = None
+        if self.api_url.startswith("https://"):
+            self._ssl_ctx = ssl.create_default_context(
+                cafile=ca_file or None
+            )
+
+    def _connect(self, timeout: Optional[float]):
+        scheme, _, rest = self.api_url.partition("://")
+        hostname, _, port = rest.partition(":")
+        if scheme == "https":
+            return http.client.HTTPSConnection(
+                hostname, int(port or 443), timeout=timeout, context=self._ssl_ctx
+            )
+        return http.client.HTTPConnection(hostname, int(port or 80), timeout=timeout)
+
+    def _request(self, conn, path: str, params: Dict[str, str]):
+        if params:
+            path += "?" + urllib.parse.urlencode(params)
+        headers = {}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        conn.request("GET", path, headers=headers)
+        resp = conn.getresponse()
+        if resp.status != 200:
+            body = resp.read(200)
+            raise OSError(f"k8s API returned HTTP {resp.status}: {body!r}")
+        return resp
+
+    def list(
+        self, namespace: str, resource: str, selector: str = ""
+    ) -> Tuple[List[dict], str]:
+        """LIST a namespaced resource; returns (items, resourceVersion)."""
+        params = {}
+        if selector:
+            params["labelSelector"] = selector
+        conn = self._connect(timeout=10.0)
+        try:
+            body = json.load(
+                self._request(conn, f"/api/v1/namespaces/{namespace}/{resource}", params)
+            )
+        finally:
+            conn.close()
+        return body.get("items", []), body.get("metadata", {}).get(
+            "resourceVersion", ""
+        )
+
+    def watch(
+        self,
+        namespace: str,
+        resource: str,
+        resource_version: str,
+        selector: str = "",
+        stop: Optional[threading.Event] = None,
+    ):
+        """WATCH stream from resource_version: yields (type, object)
+        dicts until the server closes the stream, an error arrives, or
+        `stop` is set.  The connection is parked on the instance so
+        close_watch() can unblock the reader from another thread via a
+        socket shutdown — HTTPResponse.close() would deadlock on the
+        buffer lock the blocked readline holds."""
+        params = {"watch": "true", "resourceVersion": resource_version}
+        if selector:
+            params["labelSelector"] = selector
+        conn = self._connect(timeout=None)
+        self._watch_conn = conn
+        try:
+            resp = self._request(
+                conn, f"/api/v1/namespaces/{namespace}/{resource}", params
+            )
+            for line in resp:
+                if stop is not None and stop.is_set():
+                    return
+                line = line.strip()
+                if not line:
+                    continue
+                event = json.loads(line)
+                yield event.get("type", ""), event.get("object", {})
+        finally:
+            self._watch_conn = None
+            try:
+                if conn.sock is not None:
+                    conn.sock.close()
+            except OSError:
+                pass
+
+    def close_watch(self) -> None:
+        """Unblock a watch() reader stuck in readline: TCP-shutdown the
+        socket so the read returns EOF; the watch thread then tears the
+        connection down itself."""
+        conn = getattr(self, "_watch_conn", None)
+        if conn is not None and conn.sock is not None:
+            try:
+                conn.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+
+class K8sPool:
+    """Peer discovery over the Kubernetes API (reference K8sPool,
+    kubernetes.go:35-241)."""
+
+    def __init__(
+        self,
+        on_update: Callable[[List[PeerInfo]], None],
+        namespace: str = "default",
+        selector: str = "",
+        pod_ip: str = "",
+        pod_port: str = "81",
+        mechanism: str = WATCH_ENDPOINTS,
+        api_client: Optional[K8sApiClient] = None,
+        backoff_s: float = BACKOFF_S,
+    ):
+        self.on_update = on_update
+        self.namespace = namespace
+        self.selector = selector
+        self.pod_ip = pod_ip
+        self.pod_port = pod_port
+        self.mechanism = watch_mechanism_from_string(mechanism)
+        self.backoff_s = backoff_s
+        self.client = api_client or K8sApiClient()
+        self._store: Dict[str, dict] = {}  # namespace/name -> object
+        self._stop = threading.Event()
+        # The informer loop: list -> watch -> (on failure) relist.
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _key(obj: dict) -> str:
+        meta = obj.get("metadata", {})
+        return f"{meta.get('namespace', '')}/{meta.get('name', '')}"
+
+    def _run(self) -> None:
+        resource = self.mechanism  # "endpoints" | "pods"
+        while not self._stop.is_set():
+            try:
+                items, rv = self.client.list(self.namespace, resource, self.selector)
+                self._store = {self._key(o): o for o in items}
+                self._update_peers()
+                for etype, obj in self.client.watch(
+                    self.namespace, resource, rv, self.selector, self._stop
+                ):
+                    if self._stop.is_set():
+                        return
+                    if etype == "ERROR":
+                        break  # e.g. 410 Gone: relist from scratch
+                    if etype == "DELETED":
+                        self._store.pop(self._key(obj), None)
+                    elif etype in ("ADDED", "MODIFIED"):
+                        self._store[self._key(obj)] = obj
+                    else:
+                        continue  # BOOKMARK etc.
+                    self._update_peers()
+            except (OSError, ValueError, http.client.HTTPException) as e:
+                # HTTPException covers mid-stream truncation
+                # (IncompleteRead etc.), which is neither an OSError nor
+                # a ValueError — the informer must relist, not die.
+                if not self._stop.is_set():
+                    log.warning("k8s watch failed, will relist: %s", e)
+            if self._stop.is_set():
+                return
+            self._stop.wait(self.backoff_s)
+
+    # ------------------------------------------------------------------
+    def _update_peers(self) -> None:
+        if self.mechanism == WATCH_PODS:
+            peers = self._peers_from_pods()
+        else:
+            peers = self._peers_from_endpoints()
+        try:
+            self.on_update(peers)
+        except Exception:  # noqa: BLE001
+            log.exception("on_update callback failed")
+
+    def _peers_from_pods(self) -> List[PeerInfo]:
+        """kubernetes.go:187-210: skip pods with any container not ready
+        or not running; IsOwner by PodIP match."""
+        peers = []
+        for obj in self._store.values():
+            status = obj.get("status", {})
+            ip = status.get("podIP", "")
+            if not ip:
+                continue
+            statuses = status.get("containerStatuses", [])
+            if any(
+                not cs.get("ready") or "running" not in cs.get("state", {})
+                for cs in statuses
+            ):
+                continue
+            peers.append(
+                PeerInfo(
+                    grpc_address=f"{ip}:{self.pod_port}",
+                    is_owner=(ip == self.pod_ip),
+                )
+            )
+        return sorted(peers, key=lambda p: p.grpc_address)
+
+    def _peers_from_endpoints(self) -> List[PeerInfo]:
+        """kubernetes.go:212-237: every ready subset address."""
+        peers = []
+        for obj in self._store.values():
+            for subset in obj.get("subsets", []) or []:
+                for addr in subset.get("addresses", []) or []:
+                    ip = addr.get("ip", "")
+                    if not ip:
+                        continue
+                    peers.append(
+                        PeerInfo(
+                            grpc_address=f"{ip}:{self.pod_port}",
+                            is_owner=(ip == self.pod_ip),
+                        )
+                    )
+        return sorted(peers, key=lambda p: p.grpc_address)
+
+    # ------------------------------------------------------------------
+    def close(self) -> None:
+        self._stop.set()
+        self.client.close_watch()
+        self._thread.join(timeout=2.0)

@@ -564,9 +564,13 @@ class MeshBucketStore:
         client's 500ms deadline; run it here instead, behind the same
         readiness gate as WaitForConnect (daemon.go:242-248).  Uses a
         reserved key with a 1ms duration so the slot recycles on the
-        next eviction scan."""
+        next eviction scan.  The request carries Behavior.GLOBAL so the
+        sync pass has an active gslot and actually dispatches the
+        collective program — a plain request would early-return before
+        compiling it."""
         req = RateLimitRequest(
-            name="__warmup__", unique_key="__warmup__", hits=0, limit=1, duration=1
+            name="__warmup__", unique_key="__warmup__", hits=0, limit=1,
+            duration=1, behavior=Behavior.GLOBAL,
         )
         self.apply([req], now_ms)  # reentrant: the instance lock is an RLock
         self.sync_globals(now_ms)

@@ -13,8 +13,10 @@ implementations:
                    file is the membership event
   * member-list  — native SWIM gossip (gubernator_tpu.gossip), the
                    hashicorp/memberlist equivalent
-
-etcd and k8s still raise until their native client planes land.
+  * etcd         — lease+watch registration against an etcd v3 cluster
+                   over its public gRPC API (gubernator_tpu.etcd_pool)
+  * k8s          — Endpoints/Pods list+watch over the Kubernetes HTTP
+                   API with in-cluster credentials (gubernator_tpu.k8s_pool)
 """
 
 from __future__ import annotations
@@ -86,14 +88,22 @@ def make_pool(kind: str, conf, on_update: OnUpdate, advertise: Optional[PeerInfo
     if kind == "file":
         return FilePool(conf.peers_file, on_update)
     if kind == "etcd":
-        try:
-            import etcd3  # noqa: F401
-        except ImportError as e:
-            raise RuntimeError(
-                "etcd peer discovery requires the 'etcd3' package, which is "
-                "not installed in this environment; use 'static' or 'file'"
-            ) from e
-        raise NotImplementedError("etcd pool: install etcd3 and wire EtcdPool here")
+        from .etcd_pool import EtcdPool
+
+        if not advertise:
+            raise ValueError("etcd discovery requires an advertise PeerInfo")
+        if conf.etcd_advertise_address:
+            advertise = PeerInfo(
+                grpc_address=conf.etcd_advertise_address,
+                http_address=advertise.http_address,
+                data_center=advertise.data_center,
+            )
+        return EtcdPool(
+            advertise=advertise,
+            on_update=on_update,
+            endpoints=conf.etcd_endpoints,
+            key_prefix=conf.etcd_key_prefix,
+        )
     if kind == "member-list":
         from .gossip import GossipPool
 
@@ -110,12 +120,14 @@ def make_pool(kind: str, conf, on_update: OnUpdate, advertise: Optional[PeerInfo
             node_name=conf.member_list_node_name,
         )
     if kind == "k8s":
-        try:
-            import kubernetes  # noqa: F401
-        except ImportError as e:
-            raise RuntimeError(
-                "k8s peer discovery requires the 'kubernetes' package, which "
-                "is not installed in this environment; use 'static' or 'file'"
-            ) from e
-        raise NotImplementedError("k8s pool: install kubernetes and wire K8sPool here")
+        from .k8s_pool import K8sPool
+
+        return K8sPool(
+            on_update=on_update,
+            namespace=conf.k8s_namespace,
+            selector=conf.k8s_selector,
+            pod_ip=conf.k8s_pod_ip,
+            pod_port=conf.k8s_pod_port,
+            mechanism=conf.k8s_mechanism,
+        )
     raise ValueError(f"unknown peer discovery type '{kind}'")

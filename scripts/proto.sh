@@ -7,9 +7,10 @@
 set -euo pipefail
 cd "$(dirname "$0")/../gubernator_tpu/proto"
 
-protoc --python_out=. gubernator.proto peers.proto
+protoc --python_out=. gubernator.proto peers.proto etcd_kv.proto etcd_rpc.proto
 
 # protoc emits an absolute sibling import; rewrite it for package use.
 sed -i 's/^import gubernator_pb2 as gubernator__pb2$/from gubernator_tpu.proto import gubernator_pb2 as gubernator__pb2/' peers_pb2.py
+sed -i 's/^import etcd_kv_pb2 as etcd__kv__pb2$/from gubernator_tpu.proto import etcd_kv_pb2 as etcd__kv__pb2/' etcd_rpc_pb2.py
 
 echo "generated: $(ls *_pb2.py)"

@@ -1,0 +1,255 @@
+"""In-process fake etcd v3 server for discovery tests.
+
+Speaks the same wire subset as gubernator_tpu/proto/etcd_rpc.proto
+(KV Range/Put/DeleteRange, Lease Grant/Revoke/KeepAlive, Watch) with
+revisioned history, lease-scoped keys that vanish on TTL expiry, and
+watch replay from start_revision — the etcd behaviors EtcdPool relies
+on.  Plays the role the reference delegates to a real etcd container in
+its docker-compose-etcd.yaml setup.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import grpc
+
+from gubernator_tpu.proto import etcd_kv_pb2 as kvpb
+from gubernator_tpu.proto import etcd_rpc_pb2 as rpc
+
+
+@dataclass
+class _KV:
+    value: bytes
+    lease: int
+    create_revision: int
+    mod_revision: int
+    version: int
+
+
+class FakeEtcd:
+    def __init__(self, lease_scale: float = 1.0):
+        """lease_scale shrinks granted TTLs (a 30s lease with
+        lease_scale=0.01 expires in 0.3s) so expiry paths are testable."""
+        self.lease_scale = lease_scale
+        self._lock = threading.RLock()
+        self._kv: Dict[bytes, _KV] = {}
+        self._revision = 0
+        self._leases: Dict[int, float] = {}  # id -> expiry monotonic
+        self._lease_ttl: Dict[int, float] = {}
+        self._next_lease = 1000
+        self._watchers: List[Tuple[bytes, bytes, "queue.Queue"]] = []
+        self._history: List[Tuple[int, kvpb.Event]] = []  # (revision, event)
+        self._stop = threading.Event()
+        self._reaper = threading.Thread(target=self._reap_leases, daemon=True)
+        self._reaper.start()
+
+        self._server = grpc.server(ThreadPoolExecutor(max_workers=16))
+        self._server.add_generic_rpc_handlers((self._handlers(),))
+        self.port = self._server.add_insecure_port("127.0.0.1:0")
+        self.address = f"127.0.0.1:{self.port}"
+        self._server.start()
+
+    # ------------------------------------------------------------------
+    def _handlers(self):
+        def uu(fn, req_cls):
+            return grpc.unary_unary_rpc_method_handler(
+                fn,
+                request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+
+        def ss(fn, req_cls):
+            return grpc.stream_stream_rpc_method_handler(
+                fn,
+                request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+
+        method_map = {
+            "/etcdserverpb.KV/Range": uu(self._do_range, rpc.RangeRequest),
+            "/etcdserverpb.KV/Put": uu(self._do_put, rpc.PutRequest),
+            "/etcdserverpb.KV/DeleteRange": uu(self._do_delete, rpc.DeleteRangeRequest),
+            "/etcdserverpb.Lease/LeaseGrant": uu(self._do_grant, rpc.LeaseGrantRequest),
+            "/etcdserverpb.Lease/LeaseRevoke": uu(self._do_revoke, rpc.LeaseRevokeRequest),
+            "/etcdserverpb.Lease/LeaseKeepAlive": ss(self._do_keepalive, rpc.LeaseKeepAliveRequest),
+            "/etcdserverpb.Watch/Watch": ss(self._do_watch, rpc.WatchRequest),
+        }
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, details):
+                return method_map.get(details.method)
+
+        return Handler()
+
+    # ------------------------------------------------------------------
+    def _header(self) -> rpc.ResponseHeader:
+        return rpc.ResponseHeader(revision=self._revision)
+
+    def _in_range(self, key: bytes, start: bytes, end: bytes) -> bool:
+        if not end:
+            return key == start
+        return start <= key < end
+
+    def _emit(self, ev: kvpb.Event) -> None:
+        """Record history + fan out to live watchers (caller holds lock)."""
+        self._history.append((self._revision, ev))
+        for start, end, q in list(self._watchers):
+            if self._in_range(ev.kv.key, start, end):
+                q.put((self._revision, ev))
+
+    def _put_locked(self, key: bytes, value: bytes, lease: int) -> None:
+        self._revision += 1
+        old = self._kv.get(key)
+        self._kv[key] = _KV(
+            value=value,
+            lease=lease,
+            create_revision=old.create_revision if old else self._revision,
+            mod_revision=self._revision,
+            version=(old.version + 1) if old else 1,
+        )
+        self._emit(
+            kvpb.Event(
+                type=kvpb.Event.PUT,
+                kv=kvpb.KeyValue(
+                    key=key, value=value, lease=lease,
+                    mod_revision=self._revision,
+                    create_revision=self._kv[key].create_revision,
+                    version=self._kv[key].version,
+                ),
+            )
+        )
+
+    def _delete_locked(self, key: bytes) -> bool:
+        if key not in self._kv:
+            return False
+        self._revision += 1
+        del self._kv[key]
+        self._emit(
+            kvpb.Event(
+                type=kvpb.Event.DELETE,
+                kv=kvpb.KeyValue(key=key, mod_revision=self._revision),
+            )
+        )
+        return True
+
+    # ------------------------------------------------------------------
+    def _do_range(self, req: rpc.RangeRequest, ctx) -> rpc.RangeResponse:
+        with self._lock:
+            kvs = [
+                kvpb.KeyValue(
+                    key=k, value=v.value, lease=v.lease,
+                    create_revision=v.create_revision,
+                    mod_revision=v.mod_revision, version=v.version,
+                )
+                for k, v in sorted(self._kv.items())
+                if self._in_range(k, req.key, req.range_end)
+            ]
+            return rpc.RangeResponse(header=self._header(), kvs=kvs, count=len(kvs))
+
+    def _do_put(self, req: rpc.PutRequest, ctx) -> rpc.PutResponse:
+        with self._lock:
+            if req.lease and req.lease not in self._leases:
+                ctx.abort(grpc.StatusCode.NOT_FOUND, "etcdserver: requested lease not found")
+            self._put_locked(req.key, req.value, req.lease)
+            return rpc.PutResponse(header=self._header())
+
+    def _do_delete(self, req: rpc.DeleteRangeRequest, ctx) -> rpc.DeleteRangeResponse:
+        with self._lock:
+            keys = [
+                k for k in list(self._kv)
+                if self._in_range(k, req.key, req.range_end)
+            ]
+            deleted = sum(1 for k in keys if self._delete_locked(k))
+            return rpc.DeleteRangeResponse(header=self._header(), deleted=deleted)
+
+    def _do_grant(self, req: rpc.LeaseGrantRequest, ctx) -> rpc.LeaseGrantResponse:
+        with self._lock:
+            self._next_lease += 1
+            lid = req.ID or self._next_lease
+            ttl = req.TTL * self.lease_scale
+            self._leases[lid] = time.monotonic() + ttl
+            self._lease_ttl[lid] = ttl
+            return rpc.LeaseGrantResponse(header=self._header(), ID=lid, TTL=req.TTL)
+
+    def _do_revoke(self, req: rpc.LeaseRevokeRequest, ctx) -> rpc.LeaseRevokeResponse:
+        self.revoke_lease(req.ID)
+        with self._lock:
+            return rpc.LeaseRevokeResponse(header=self._header())
+
+    def _do_keepalive(self, request_iterator, ctx):
+        for req in request_iterator:
+            with self._lock:
+                if req.ID not in self._leases:
+                    # Real etcd keeps the stream open and answers an
+                    # unknown/expired lease with TTL=0.
+                    yield rpc.LeaseKeepAliveResponse(
+                        header=self._header(), ID=req.ID, TTL=0
+                    )
+                    continue
+                self._leases[req.ID] = time.monotonic() + self._lease_ttl[req.ID]
+                yield rpc.LeaseKeepAliveResponse(
+                    header=self._header(), ID=req.ID, TTL=int(self._lease_ttl[req.ID])
+                )
+
+    def _do_watch(self, request_iterator, ctx):
+        create = next(request_iterator).create_request
+        q: "queue.Queue" = queue.Queue()
+        start, end = create.key, create.range_end
+        with self._lock:
+            backlog = [
+                (rev, ev)
+                for rev, ev in self._history
+                if create.start_revision
+                and rev >= create.start_revision
+                and self._in_range(ev.kv.key, start, end)
+            ]
+            self._watchers.append((start, end, q))
+        try:
+            yield rpc.WatchResponse(header=rpc.ResponseHeader(), created=True, watch_id=1)
+            for rev, ev in backlog:
+                yield rpc.WatchResponse(
+                    header=rpc.ResponseHeader(revision=rev), watch_id=1, events=[ev]
+                )
+            while ctx.is_active():
+                try:
+                    rev, ev = q.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                yield rpc.WatchResponse(
+                    header=rpc.ResponseHeader(revision=rev), watch_id=1, events=[ev]
+                )
+        finally:
+            with self._lock:
+                self._watchers.remove((start, end, q))
+
+    # ------------------------------------------------------------------
+    def revoke_lease(self, lease_id: int) -> None:
+        """Drop a lease and delete all keys attached to it."""
+        with self._lock:
+            self._leases.pop(lease_id, None)
+            self._lease_ttl.pop(lease_id, None)
+            for k, v in list(self._kv.items()):
+                if v.lease == lease_id:
+                    self._delete_locked(k)
+
+    def _reap_leases(self) -> None:
+        while not self._stop.wait(0.05):
+            now = time.monotonic()
+            with self._lock:
+                expired = [lid for lid, exp in self._leases.items() if exp < now]
+            for lid in expired:
+                self.revoke_lease(lid)
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return sorted(k.decode() for k in self._kv)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.stop(grace=0.2)

@@ -1,0 +1,207 @@
+"""EtcdPool discovery tests against the in-process fake etcd server
+(reference etcd.go, which is exercised via docker-compose-etcd.yaml —
+here the etcd cluster runs inside the test process).
+"""
+
+import time
+
+import pytest
+
+from gubernator_tpu.config import setup_daemon_config
+from gubernator_tpu.etcd_pool import EtcdClient, EtcdPool, prefix_range_end
+from gubernator_tpu.types import PeerInfo
+
+from .fake_etcd import FakeEtcd
+
+
+def wait_until(fn, timeout_s=5.0, every_s=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(every_s)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture
+def server():
+    s = FakeEtcd()
+    yield s
+    s.stop()
+
+
+def make_pool(server, addr, updates, **kw):
+    return EtcdPool(
+        advertise=PeerInfo(grpc_address=addr),
+        on_update=updates.append,
+        endpoints=[server.address],
+        **kw,
+    )
+
+
+def test_prefix_range_end():
+    assert prefix_range_end(b"/gubernator/peers/") == b"/gubernator/peers0"
+    assert prefix_range_end(b"a\xff") == b"b"
+    assert prefix_range_end(b"\xff\xff") == b"\0"
+
+
+def test_register_and_discover(server):
+    u1, u2 = [], []
+    p1 = make_pool(server, "10.0.0.1:81", u1)
+    p2 = make_pool(server, "10.0.0.2:81", u2)
+    try:
+        assert server.keys() == [
+            "/gubernator/peers/10.0.0.1:81",
+            "/gubernator/peers/10.0.0.2:81",
+        ]
+        for u in (u1, u2):
+            wait_until(
+                lambda u=u: u and {p.grpc_address for p in u[-1]}
+                == {"10.0.0.1:81", "10.0.0.2:81"},
+                msg="both pools see both peers",
+            )
+    finally:
+        p1.close()
+        p2.close()
+
+
+def test_close_deregisters(server):
+    u1, u2 = [], []
+    p1 = make_pool(server, "10.0.0.1:81", u1)
+    p2 = make_pool(server, "10.0.0.2:81", u2)
+    try:
+        wait_until(lambda: u1 and len(u1[-1]) == 2, msg="join")
+        p2.close()
+        wait_until(
+            lambda: u1 and [p.grpc_address for p in u1[-1]] == ["10.0.0.1:81"],
+            msg="p2 deregistered on close",
+        )
+        assert server.keys() == ["/gubernator/peers/10.0.0.1:81"]
+    finally:
+        p1.close()
+        p2.close()
+
+
+def test_lease_expiry_removes_crashed_peer(server):
+    """A peer that stops keepaliving (crash) must disappear when its
+    lease TTL lapses (etcd.go:34 leaseTTL=30s; 1s here so the test
+    observes expiry)."""
+    u1, u2 = [], []
+    p1 = make_pool(server, "10.0.0.1:81", u1, lease_ttl_s=1)
+    p2 = make_pool(server, "10.0.0.2:81", u2, lease_ttl_s=1)
+    try:
+        wait_until(lambda: u1 and len(u1[-1]) == 2, msg="join")
+        # Crash p2: kill its threads without deregistering.
+        p2._stop.set()
+        wait_until(
+            lambda: u1 and [p.grpc_address for p in u1[-1]] == ["10.0.0.1:81"],
+            msg="lease expiry removes crashed peer",
+        )
+    finally:
+        p1.close()
+        p2.close()
+
+
+def test_keepalive_loss_triggers_reregistration(server):
+    """Server-side lease revocation ends the keepalive stream; the pool
+    must re-register (etcd.go:266-295)."""
+    u1, u2 = [], []
+    p1 = make_pool(server, "10.0.0.1:81", u1, backoff_s=0.05, lease_ttl_s=1)
+    p2 = make_pool(server, "10.0.0.2:81", u2)
+    try:
+        wait_until(lambda: u2 and len(u2[-1]) == 2, msg="join")
+        server.revoke_lease(p1._lease_id)
+        wait_until(
+            lambda: u2 and [p.grpc_address for p in u2[-1]] == ["10.0.0.2:81"],
+            msg="revocation removes p1",
+        )
+        wait_until(
+            lambda: u2 and len(u2[-1]) == 2,
+            msg="p1 re-registers after keepalive loss",
+        )
+    finally:
+        p1.close()
+        p2.close()
+
+
+def test_watch_survives_malformed_peer_value(server):
+    u1 = []
+    p1 = make_pool(server, "10.0.0.1:81", u1)
+    try:
+        client = EtcdClient([server.address])
+        client.put("/gubernator/peers/bogus", b"not json{{")
+        client.put(
+            "/gubernator/peers/10.0.0.3:81",
+            b'{"grpcAddress": "10.0.0.3:81"}',
+        )
+        wait_until(
+            lambda: u1
+            and {p.grpc_address for p in u1[-1]} == {"10.0.0.1:81", "10.0.0.3:81"},
+            msg="valid peer lands despite malformed sibling",
+        )
+        client.close()
+    finally:
+        p1.close()
+
+
+def test_custom_key_prefix(server):
+    u1 = []
+    p1 = make_pool(server, "10.0.0.1:81", u1, key_prefix="/custom-peers/")
+    try:
+        assert server.keys() == ["/custom-peers/10.0.0.1:81"]
+    finally:
+        p1.close()
+
+
+def test_endpoint_failover(server):
+    """A dead first endpoint must not prevent registration when a later
+    endpoint is healthy (the Go client balances across endpoints;
+    rotate() is the explicit equivalent)."""
+    u1 = []
+    p1 = EtcdPool(
+        advertise=PeerInfo(grpc_address="10.0.0.1:81"),
+        on_update=u1.append,
+        endpoints=["127.0.0.1:1", server.address],  # first is dead
+    )
+    try:
+        assert server.keys() == ["/gubernator/peers/10.0.0.1:81"]
+        wait_until(lambda: u1 and len(u1[-1]) == 1, msg="registered via failover")
+    finally:
+        p1.close()
+
+
+def test_keepalive_ttl_zero_triggers_reregistration(server):
+    """Real etcd answers an expired lease with TTL=0 on an open stream;
+    the pool must treat that as keepalive loss and re-register."""
+    u2 = []
+    p1 = make_pool(server, "10.0.0.1:81", [], backoff_s=0.05, lease_ttl_s=1)
+    p2 = make_pool(server, "10.0.0.2:81", u2)
+    try:
+        wait_until(lambda: u2 and len(u2[-1]) == 2, msg="join")
+        # Expire p1's lease server-side WITHOUT deleting via revoke_lease
+        # bookkeeping: drop the lease record only, so keepalives get
+        # TTL=0 while the key initially remains.
+        with server._lock:
+            server._leases.pop(p1._lease_id, None)
+        wait_until(
+            lambda: len(server.keys()) == 2 and p1._lease_id in server._leases,
+            timeout_s=5.0,
+            msg="p1 re-registered with a fresh lease",
+        )
+    finally:
+        p1.close()
+        p2.close()
+
+
+def test_etcd_env_parsing():
+    conf = setup_daemon_config(
+        env={
+            "GUBER_PEER_DISCOVERY_TYPE": "etcd",
+            "GUBER_ETCD_ENDPOINTS": "e1:2379, e2:2379",
+            "GUBER_ETCD_KEY_PREFIX": "/my-peers",
+            "GUBER_ETCD_ADVERTISE_ADDRESS": "10.1.1.1:81",
+        }
+    )
+    assert conf.etcd_endpoints == ["e1:2379", "e2:2379"]
+    assert conf.etcd_key_prefix == "/my-peers"
+    assert conf.etcd_advertise_address == "10.1.1.1:81"

@@ -103,6 +103,32 @@ class TestGossip:
             a.close()
             b.close()
 
+    def test_rejoin_after_graceful_leave(self):
+        """A restarted node reusing its name must out-increment its own
+        stale LEFT rumor and become visible again."""
+        a = make_node("r0")
+        b = make_node("r1")
+        try:
+            b.join([a.address])
+            wait_until(lambda: len(a.members()) == 2, msg="join")
+            b.leave()
+            b.close()
+            wait_until(
+                lambda: {m.name for m in a.members()} == {"r0"}, msg="left"
+            )
+            b2 = make_node("r1")  # fresh process, incarnation restarts at 1
+            try:
+                b2.join([a.address])
+                wait_until(
+                    lambda: {m.name for m in a.members()} == {"r0", "r1"},
+                    msg="rejoin visible despite stale LEFT tombstone",
+                )
+            finally:
+                b2.close()
+        finally:
+            a.close()
+            b.close()
+
     def test_join_unreachable_seed_times_out(self):
         a = make_node("t0")
         try:

@@ -1,0 +1,269 @@
+"""K8sPool discovery tests against an in-process fake Kubernetes API
+server speaking the list+watch protocol (reference kubernetes.go, which
+is exercised against a real cluster via k8s-deployment.yaml).
+"""
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from gubernator_tpu.config import setup_daemon_config
+from gubernator_tpu.k8s_pool import (
+    K8sApiClient,
+    K8sPool,
+    watch_mechanism_from_string,
+)
+
+
+def wait_until(fn, timeout_s=5.0, every_s=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(every_s)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class FakeK8sApi:
+    """Serves LIST and WATCH for a namespaced resource: list returns the
+    current items; watch streams queued events as JSON lines."""
+
+    def __init__(self):
+        self.items = {}  # (resource, name) -> object
+        self.rv = 10
+        self._watchers = []  # (resource, queue)
+        self._lock = threading.Lock()
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                params = parse_qs(parsed.query)
+                resource = parsed.path.rsplit("/", 1)[-1]
+                if params.get("watch", ["false"])[0] == "true":
+                    self._serve_watch(resource)
+                else:
+                    self._serve_list(resource)
+
+            def _serve_list(self, resource):
+                with fake._lock:
+                    items = [
+                        o for (r, _), o in sorted(fake.items.items()) if r == resource
+                    ]
+                    body = json.dumps(
+                        {
+                            "items": items,
+                            "metadata": {"resourceVersion": str(fake.rv)},
+                        }
+                    ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _serve_watch(self, resource):
+                q = queue.Queue()
+                with fake._lock:
+                    fake._watchers.append((resource, q))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    while True:
+                        try:
+                            event = q.get(timeout=0.1)
+                        except queue.Empty:
+                            continue
+                        if event is None:
+                            break
+                        line = (json.dumps(event) + "\n").encode()
+                        self.wfile.write(f"{len(line):x}\r\n".encode())
+                        self.wfile.write(line + b"\r\n")
+                        self.wfile.flush()
+                except OSError:
+                    pass
+                finally:
+                    with fake._lock:
+                        fake._watchers.remove((resource, q))
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._server.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self._server.server_port}"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, kwargs={"poll_interval": 0.05}
+        )
+        self._thread.start()
+
+    def emit(self, resource, etype, obj):
+        """Mutate state + push a watch event."""
+        with self._lock:
+            self.rv += 1
+            obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+            key = (resource, obj["metadata"].get("name", ""))
+            if etype == "DELETED":
+                self.items.pop(key, None)
+            else:
+                self.items[key] = obj
+            for r, q in self._watchers:
+                if r == resource:
+                    q.put({"type": etype, "object": obj})
+
+    def n_watchers(self):
+        with self._lock:
+            return len(self._watchers)
+
+    def stop(self):
+        with self._lock:
+            for _, q in self._watchers:
+                q.put(None)
+        self._server.shutdown()
+        self._server.server_close()
+
+
+@pytest.fixture
+def api():
+    s = FakeK8sApi()
+    yield s
+    s.stop()
+
+
+def endpoints_obj(name, ips):
+    return {
+        "metadata": {"name": name, "namespace": "default"},
+        "subsets": [{"addresses": [{"ip": ip} for ip in ips]}],
+    }
+
+
+def pod_obj(name, ip, ready=True, running=True):
+    state = {"running": {}} if running else {"waiting": {}}
+    return {
+        "metadata": {"name": name, "namespace": "default"},
+        "status": {
+            "podIP": ip,
+            "containerStatuses": [{"ready": ready, "state": state}],
+        },
+    }
+
+
+def make_pool(api, updates, **kw):
+    kw.setdefault("mechanism", "endpoints")
+    return K8sPool(
+        on_update=updates.append,
+        pod_port="81",
+        api_client=K8sApiClient(api_url=api.url),
+        backoff_s=0.05,
+        **kw,
+    )
+
+
+def test_mechanism_parse():
+    assert watch_mechanism_from_string("") == "endpoints"
+    assert watch_mechanism_from_string("pods") == "pods"
+    with pytest.raises(ValueError):
+        watch_mechanism_from_string("nodes")
+
+
+def test_endpoints_list_and_watch(api):
+    api.emit("endpoints", "ADDED", endpoints_obj("guber", ["10.0.0.1"]))
+    updates = []
+    pool = make_pool(api, updates, pod_ip="10.0.0.1")
+    try:
+        wait_until(
+            lambda: updates
+            and [p.grpc_address for p in updates[-1]] == ["10.0.0.1:81"],
+            msg="initial list lands",
+        )
+        assert updates[-1][0].is_owner
+        # A scale-up arrives via the watch stream.
+        api.emit("endpoints", "MODIFIED", endpoints_obj("guber", ["10.0.0.1", "10.0.0.2"]))
+        wait_until(
+            lambda: updates
+            and [p.grpc_address for p in updates[-1]]
+            == ["10.0.0.1:81", "10.0.0.2:81"],
+            msg="watch event adds the new address",
+        )
+        api.emit("endpoints", "DELETED", endpoints_obj("guber", []))
+        wait_until(
+            lambda: updates and updates[-1] == [], msg="deletion empties the peer list"
+        )
+    finally:
+        pool.close()
+
+
+def test_pods_watch_skips_not_ready(api):
+    api.emit("pods", "ADDED", pod_obj("a", "10.0.0.1"))
+    api.emit("pods", "ADDED", pod_obj("b", "10.0.0.2", ready=False))
+    api.emit("pods", "ADDED", pod_obj("c", "10.0.0.3", running=False))
+    updates = []
+    pool = make_pool(api, updates, mechanism="pods")
+    try:
+        wait_until(
+            lambda: updates
+            and [p.grpc_address for p in updates[-1]] == ["10.0.0.1:81"],
+            msg="only the ready+running pod is a peer",
+        )
+        api.emit("pods", "MODIFIED", pod_obj("b", "10.0.0.2"))
+        wait_until(
+            lambda: updates
+            and [p.grpc_address for p in updates[-1]]
+            == ["10.0.0.1:81", "10.0.0.2:81"],
+            msg="pod becoming ready joins",
+        )
+    finally:
+        pool.close()
+
+
+def test_watch_stream_failure_relists(api):
+    api.emit("endpoints", "ADDED", endpoints_obj("guber", ["10.0.0.1"]))
+    updates = []
+    pool = make_pool(api, updates)
+    try:
+        wait_until(lambda: api.n_watchers() == 1, msg="watch established")
+        # Kill the stream server-side; mutate state while no watch is
+        # active; the pool must relist and converge anyway.
+        api.emit("endpoints", "MODIFIED", endpoints_obj("guber", ["10.0.0.9"]))
+        with api._lock:
+            for _, q in api._watchers:
+                q.put(None)
+        wait_until(
+            lambda: updates
+            and [p.grpc_address for p in updates[-1]] == ["10.0.0.9:81"],
+            msg="relist after stream failure",
+        )
+    finally:
+        pool.close()
+
+
+def test_k8s_env_parsing():
+    conf = setup_daemon_config(
+        env={
+            "GUBER_PEER_DISCOVERY_TYPE": "k8s",
+            "GUBER_K8S_NAMESPACE": "rate-limits",
+            "GUBER_K8S_POD_IP": "10.9.9.9",
+            "GUBER_K8S_POD_PORT": "1051",
+            "GUBER_K8S_ENDPOINTS_SELECTOR": "app=gubernator",
+            "GUBER_K8S_WATCH_MECHANISM": "pods",
+        }
+    )
+    assert conf.k8s_namespace == "rate-limits"
+    assert conf.k8s_pod_ip == "10.9.9.9"
+    assert conf.k8s_pod_port == "1051"
+    assert conf.k8s_selector == "app=gubernator"
+    assert conf.k8s_mechanism == "pods"
+
+
+def test_k8s_selector_required():
+    with pytest.raises(ValueError, match="ENDPOINTS_SELECTOR"):
+        setup_daemon_config(env={"GUBER_PEER_DISCOVERY_TYPE": "k8s"})
