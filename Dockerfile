@@ -1,0 +1,31 @@
+# Build the gubernator-tpu daemon image (reference Dockerfile:1-32 uses
+# a Go builder + scratch image; a Python/JAX runtime needs a slim python
+# base instead).  The TPU runtime libraries come from the host/node
+# (e.g. the libtpu container toolkit on GKE TPU node pools); on CPU-only
+# nodes the same image serves with XLA's host platform.
+FROM python:3.12-slim AS builder
+
+WORKDIR /src
+COPY gubernator_tpu/ gubernator_tpu/
+COPY setup.py README.md ./
+RUN pip install --no-cache-dir build && python -m build --wheel
+
+FROM python:3.12-slim
+
+# jax/numpy are the only hard runtime deps; grpcio serves the data
+# plane.  Pin jax to the version the image is validated against.
+RUN pip install --no-cache-dir "jax>=0.4.30" "numpy>=1.26" "grpcio>=1.60"
+COPY --from=builder /src/dist/*.whl /tmp/
+RUN pip install --no-cache-dir /tmp/*.whl && rm /tmp/*.whl
+
+# HTTP/JSON gateway + /metrics
+EXPOSE 1050
+# gRPC data plane (V1 + PeersV1)
+EXPOSE 1051
+# member-list gossip plane
+EXPOSE 7946
+
+ENV GUBER_HTTP_ADDRESS=0.0.0.0:1050 \
+    GUBER_GRPC_ADDRESS=0.0.0.0:1051
+
+ENTRYPOINT ["python", "-m", "gubernator_tpu.cmd.server"]

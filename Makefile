@@ -1,0 +1,40 @@
+# Reference Makefile:1-35 equivalents for the TPU build.
+.PHONY: test bench proto certs docker release clean
+
+# The whole suite on the virtual 8-device CPU mesh (conftest.py forces
+# it); -p no:cacheprovider keeps runs hermetic like -count=1.
+test:
+	python -m pytest tests/ -q -p no:cacheprovider
+
+# One JSON line: {"metric", "value", "unit", "vs_baseline", ...}
+bench:
+	python bench.py
+
+proto:
+	bash scripts/proto.sh
+
+docker:
+	docker build -t gubernator-tpu:latest .
+
+release:
+	python -m build --wheel
+
+# Self-signed cluster certs for the TLS compose file / tests
+# (reference Makefile:21-34 openssl recipes).
+certs:
+	mkdir -p certs
+	openssl req -x509 -newkey ec -pkeyopt ec_paramgen_curve:P-256 \
+		-keyout certs/ca.key -out certs/ca.pem -days 3650 -nodes \
+		-subj "/CN=gubernator-tpu CA"
+	openssl req -newkey ec -pkeyopt ec_paramgen_curve:P-256 \
+		-keyout certs/gubernator.key -out certs/gubernator.csr -nodes \
+		-subj "/CN=gubernator"
+	openssl x509 -req -in certs/gubernator.csr -CA certs/ca.pem \
+		-CAkey certs/ca.key -CAcreateserial -out certs/gubernator.pem \
+		-days 3650 \
+		-extfile <(printf "subjectAltName=DNS:gubernator-1,DNS:gubernator-2,DNS:localhost,IP:127.0.0.1")
+	rm -f certs/gubernator.csr certs/ca.srl
+
+clean:
+	rm -rf build dist *.egg-info .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

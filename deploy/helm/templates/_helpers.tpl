@@ -1,0 +1,9 @@
+{{- define "gubernator-tpu.name" -}}
+{{- .Chart.Name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "gubernator-tpu.labels" -}}
+app: {{ include "gubernator-tpu.name" . }}
+chart: {{ .Chart.Name }}-{{ .Chart.Version }}
+release: {{ .Release.Name }}
+{{- end -}}

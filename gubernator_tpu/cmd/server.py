@@ -16,10 +16,12 @@ def main(argv=None) -> int:
 
     from ..config import setup_daemon_config
     from ..daemon import spawn_daemon
+    from ..utils.logging import setup_logging
 
     conf = setup_daemon_config(config_file=args.config)
     if args.debug:
         conf.debug = True
+    setup_logging(debug=conf.debug)
     daemon = spawn_daemon(conf)
     addr = daemon.gateway.address
     print(f"gubernator-tpu listening on http://{addr} (advertise {daemon.peer_info.grpc_address})")

@@ -99,3 +99,29 @@ def test_resolve_host_ip():
     host, _, port = resolve_host_ip("0.0.0.0:9090").rpartition(":")
     assert port == "9090"
     assert host not in ("", "0.0.0.0")
+
+
+def test_example_conf_documents_valid_knobs(tmp_path):
+    """Every commented GUBER_* line in example.conf, uncommented, must
+    parse (the reference documents its full env surface in example.conf;
+    drift between docs and parser is a bug)."""
+    import re
+
+    from gubernator_tpu.config import setup_daemon_config
+
+    lines = []
+    with open("example.conf") as f:
+        for line in f:
+            m = re.match(r"#\s*(GUBER_[A-Z0-9_]+=.*)$", line.strip())
+            if m:
+                lines.append(m.group(1))
+    assert len(lines) > 30, "example.conf should document the full GUBER_* surface"
+    p = tmp_path / "ex.conf"
+    p.write_text("\n".join(lines) + "\n")
+    conf = setup_daemon_config(config_file=str(p), env={})
+    assert conf.listen_address == "127.0.0.1:1050"
+    assert conf.member_list_known_nodes == ["node1:7946", "node2:7946"]
+    assert conf.etcd_endpoints == ["localhost:2379"]
+    assert conf.k8s_selector == "app=gubernator"
+    assert conf.behaviors.batch_wait_s == 0.0005
+    assert conf.tls is not None and conf.tls.client_auth == "require-and-verify"

@@ -1,0 +1,61 @@
+"""Logging helper tests (reference logging/logging.go)."""
+
+import io
+import json
+import logging
+
+import pytest
+
+from gubernator_tpu.utils.logging import (
+    LogLevelJSON,
+    LogWriter,
+    category_logger,
+    setup_logging,
+)
+
+
+def test_log_level_json_round_trip():
+    for name, level in (("debug", logging.DEBUG), ("info", logging.INFO),
+                        ("warning", logging.WARNING), ("error", logging.ERROR)):
+        l = LogLevelJSON(level)
+        assert json.loads(l.to_json()) == name
+        assert LogLevelJSON.from_json(l.to_json()) == l
+
+
+def test_log_level_json_numeric_and_invalid():
+    assert LogLevelJSON.from_json("10").level == logging.DEBUG
+    with pytest.raises(ValueError):
+        LogLevelJSON.from_json('"noisy"')
+
+
+def test_log_writer_forwards_lines():
+    logger = logging.getLogger("gubernator.test_writer")
+    logger.setLevel(logging.DEBUG)
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda r: records.append(r.getMessage())
+    logger.addHandler(handler)
+    try:
+        w = LogWriter(logger)
+        w.write("[DEBUG] partial")
+        assert records == []  # incomplete line buffered
+        w.write(" line\nsecond line\ntrailing")
+        assert records == ["[DEBUG] partial line", "second line"]
+        w.flush()
+        assert records[-1] == "trailing"
+    finally:
+        logger.removeHandler(handler)
+
+
+def test_setup_logging_category_format():
+    buf = io.StringIO()
+    logger = setup_logging(debug=True, stream=buf)
+    try:
+        category_logger("unit").debug("hello world")
+        out = buf.getvalue()
+        assert "category=gubernator" in out
+        assert "logger=gubernator.unit" in out
+        assert "msg=hello world" in out
+        assert logger.level == logging.DEBUG
+    finally:
+        logger.handlers.clear()
