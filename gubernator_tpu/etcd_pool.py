@@ -63,7 +63,13 @@ class EtcdClient:
         self.timeout_s = timeout_s
         self._credentials = credentials
         self._endpoint_idx = 0
+        self._rotate_lock = threading.Lock()
+        self._retired_channels: list = []
         self._connect()
+
+    @property
+    def endpoint_index(self) -> int:
+        return self._endpoint_idx
 
     def _connect(self) -> None:
         """(Re)build the channel + stubs against the current endpoint.
@@ -113,13 +119,22 @@ class EtcdClient:
             response_deserializer=rpc.WatchResponse.FromString,
         )
 
-    def rotate(self) -> None:
-        """Fail over to the next configured endpoint."""
+    def rotate(self, observed_index: Optional[int] = None) -> None:
+        """Fail over to the next configured endpoint.
+
+        `observed_index` is the endpoint the caller saw failing:
+        concurrent failures from the keepalive and watch threads then
+        advance the index ONCE, not past the fresh endpoint.  The old
+        channel is retired, not closed — the other thread's healthy
+        stream on it keeps running; retirees close at client close()."""
         if len(self.endpoints) <= 1:
             return
-        self._channel.close()
-        self._endpoint_idx = (self._endpoint_idx + 1) % len(self.endpoints)
-        self._connect()
+        with self._rotate_lock:
+            if observed_index is not None and observed_index != self._endpoint_idx:
+                return  # another thread already rotated away
+            self._retired_channels.append(self._channel)
+            self._endpoint_idx = (self._endpoint_idx + 1) % len(self.endpoints)
+            self._connect()
 
     # ------------------------------------------------------------------
     def range_prefix(self, prefix: str) -> Tuple[List[Tuple[str, bytes]], int]:
@@ -164,9 +179,14 @@ class EtcdClient:
         return self._keepalive(requests())
 
     def watch_prefix(self, prefix: str, start_revision: int, stop: threading.Event):
-        """Generator of WatchResponse for the prefix starting at
-        `start_revision`.  The stream stays open until `stop` or error."""
+        """Returns (response_iterator, done_event) for a prefix watch
+        from `start_revision`.  The caller MUST set `done` when it stops
+        consuming the stream: the request-side generator parks in a
+        bounded wait on (done | stop), so gRPC's request-consumer thread
+        exits promptly instead of leaking one blocked thread per watch
+        attempt."""
         p = prefix.encode()
+        done = threading.Event()
 
         def requests():
             yield rpc.WatchRequest(
@@ -176,12 +196,17 @@ class EtcdClient:
                     start_revision=start_revision,
                 )
             )
-            stop.wait()  # keep the send side open
+            while not stop.is_set() and not done.is_set():
+                done.wait(0.5)
 
-        return self._watch(requests())
+        return self._watch(requests()), done
 
     def close(self) -> None:
-        self._channel.close()
+        with self._rotate_lock:
+            for ch in self._retired_channels:
+                ch.close()
+            self._retired_channels.clear()
+            self._channel.close()
 
 
 class EtcdPool:
@@ -243,6 +268,7 @@ class EtcdPool:
         """Consume keepalives; on loss, re-register with backoff
         (etcd.go:266-295)."""
         while not self._stop.is_set():
+            ep = self.client.endpoint_index
             try:
                 stream = self.client.lease_keepalive(
                     self._lease_id, max(self.lease_ttl_s / 3.0, 0.05), self._stop
@@ -257,17 +283,18 @@ class EtcdPool:
                         # on TTL<=0, which etcd.go re-registers on).
                         break
             except grpc.RpcError:
-                pass
+                self.client.rotate(ep)
             if self._stop.is_set():
                 return
             log.warning("keep alive lost, attempting to re-register peer")
             while not self._stop.is_set():
+                ep = self.client.endpoint_index
                 try:
                     self._register_once()
                     break
                 except grpc.RpcError as e:
                     log.error("while attempting to re-register peer: %s", e)
-                    self.client.rotate()
+                    self.client.rotate(ep)
                     self._stop.wait(self.backoff_s)
 
     # ------------------------------------------------------------------
@@ -291,10 +318,14 @@ class EtcdPool:
         backoff (etcd.go:96-139, 174-220)."""
         revision = None
         while not self._stop.is_set():
+            done = None
+            ep = self.client.endpoint_index
             try:
                 if revision is None:
                     revision = self._collect_and_notify() + 1
-                stream = self.client.watch_prefix(self.key_prefix, revision, self._stop)
+                stream, done = self.client.watch_prefix(
+                    self.key_prefix, revision, self._stop
+                )
                 for resp in stream:
                     if self._stop.is_set():
                         return
@@ -314,7 +345,10 @@ class EtcdPool:
                     if changed:
                         self._call_on_update()
             except grpc.RpcError:
-                self.client.rotate()
+                self.client.rotate(ep)
+            finally:
+                if done is not None:
+                    done.set()  # release the request-side generator
             if self._stop.is_set():
                 return
             revision = None  # full re-collect after any stream failure

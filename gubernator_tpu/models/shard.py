@@ -224,10 +224,42 @@ class _Columns:
             setattr(self, f, getattr(self, f)[:m])
 
 
+_I32_MAX = (1 << 31) - 1
+
+
 def _pad(src: np.ndarray, padded: int, dtype) -> np.ndarray:
     out = np.zeros(padded, dtype=dtype)
     out[: len(src)] = src
     return out
+
+
+class ColumnsHandle:
+    """Deferred result of one pipelined columnar batch
+    (ShardStore.apply_columns_async).  Handles resolve strictly in
+    dispatch order — result() first drains every older in-flight batch
+    so table commits never reorder."""
+
+    def __init__(self, store: "ShardStore", resolve_fn, limit_col):
+        self._store = store
+        self._resolve_fn = resolve_fn
+        self._limit = limit_col
+        self._value = None
+        self.done = False
+
+    def _do_resolve(self) -> None:
+        status, remaining, reset = self._resolve_fn()
+        self._value = {
+            "status": status,
+            "limit": self._limit,
+            "remaining": remaining,
+            "reset_time": reset,
+        }
+        self.done = True
+
+    def result(self) -> dict:
+        if not self.done:
+            self._store._drain_until(self)
+        return self._value
 
 
 def build_round_arrays(chunk: Sequence[_Prepared], padded: int) -> Tuple[np.ndarray, ...]:
@@ -288,6 +320,8 @@ class ShardStore:
         self.state = state
         # host mirror of per-slot algorithm, for store-SPI removal detection
         self.algo_mirror = np.zeros(capacity, dtype=np.int32)
+        # FIFO of unresolved pipelined batches (apply_columns_async)
+        self._inflight: "deque[ColumnsHandle]" = deque()
 
     # ------------------------------------------------------------------
     def apply(
@@ -355,9 +389,40 @@ class ShardStore:
         (buckets.apply_rounds), and all outputs come back in ONE packed
         device->host transfer.  Returns (status, remaining, reset_time)
         arrays aligned to keys."""
+        handle = ColumnsHandle(
+            self, self._dispatch_columns(keys, cols, now_ms), cols.limit
+        )
+        self._inflight.append(handle)
+        r = handle.result()
+        return r["status"], r["remaining"], r["reset_time"]
+
+    @staticmethod
+    def _narrow_ok(cols: "_Columns", now_ms: int) -> bool:
+        """True when every value column fits the int32 wire
+        (buckets.apply_rounds32 preconditions)."""
+        hi = _I32_MAX
+        for a in (cols.hits, cols.limit, cols.duration):
+            if a.size and (int(a.min()) < 0 or int(a.max()) > hi):
+                return False
+        mask = cols.greg_duration != 0
+        if mask.any():
+            d = cols.greg_expire[mask] - now_ms
+            if int(d.min()) < 0 or int(d.max()) > hi or int(cols.greg_duration.max()) > hi:
+                return False
+        return True
+
+    def _dispatch_columns(self, keys: List[str], cols: "_Columns", now_ms: int):
+        """Plan + enqueue one columnar batch WITHOUT blocking on the
+        device, returning a resolve() closure that performs the one
+        blocking readback and the table commit.  The split is what
+        enables pipelining: the caller can plan/dispatch batch i+1 while
+        batch i's compute and transfer are still in flight.  Caller must
+        hold self._lock for the dispatch; resolve() re-acquires it."""
         n = len(keys)
         planner = native.NativeBatchPlanner(self.table, keys, now_ms)
-        round_id, slots, exists, n_rounds = planner.plan()
+        round_id, slots, exists, occ, write, n_rounds = planner.plan_grouped(
+            cols, int(Behavior.RESET_REMAINING)
+        )
         padded = pad_size(n)
         slot_col = np.full(padded, -1, dtype=np.int32)
         slot_col[:n] = slots
@@ -365,27 +430,65 @@ class ShardStore:
         rid_col[:n] = round_id
         ex_col = np.zeros(padded, dtype=bool)
         ex_col[:n] = exists
-        batch = buckets.make_batch(
-            slot_col,
-            ex_col,
-            _pad(cols.algo, padded, np.int32),
-            _pad(cols.behavior, padded, np.int32),
-            _pad(cols.hits, padded, np.int64),
-            _pad(cols.limit, padded, np.int64),
-            _pad(cols.duration, padded, np.int64),
-            _pad(cols.greg_expire, padded, np.int64),
-            _pad(cols.greg_duration, padded, np.int64),
-        )
-        self.state, packed = buckets.apply_rounds_jit(
-            self.state, batch, rid_col, n_rounds, now_ms
-        )
-        packed = np.asarray(packed)  # the one blocking transfer
-        status, removed, remaining, reset, new_exp = buckets.unpack_output(
-            packed[:, :n]
-        )
-        planner.commit_plan(new_exp, removed)
-        self.algo_mirror[slots] = cols.algo
-        return status, remaining, reset
+        occ_col = np.zeros(padded, dtype=np.int32)
+        occ_col[:n] = occ
+        wr_col = np.zeros(padded, dtype=bool)
+        wr_col[:n] = write
+        narrow = self._narrow_ok(cols, now_ms)
+        if narrow:
+            greg_delta = np.where(
+                cols.greg_duration != 0, cols.greg_expire - now_ms, 0
+            ).astype(np.int32)
+            batch = buckets.make_batch32(
+                slot_col,
+                ex_col,
+                _pad(cols.algo, padded, np.int32),
+                _pad(cols.behavior, padded, np.int32),
+                _pad(cols.hits, padded, np.int32),
+                _pad(cols.limit, padded, np.int32),
+                _pad(cols.duration, padded, np.int32),
+                _pad(greg_delta, padded, np.int32),
+                _pad(cols.greg_duration, padded, np.int32),
+                occ=occ_col,
+                write=wr_col,
+            )
+            self.state, packed = buckets.apply_rounds32_jit(
+                self.state, batch, rid_col, n_rounds, now_ms
+            )
+        else:
+            batch = buckets.make_batch(
+                slot_col,
+                ex_col,
+                _pad(cols.algo, padded, np.int32),
+                _pad(cols.behavior, padded, np.int32),
+                _pad(cols.hits, padded, np.int64),
+                _pad(cols.limit, padded, np.int64),
+                _pad(cols.duration, padded, np.int64),
+                _pad(cols.greg_expire, padded, np.int64),
+                _pad(cols.greg_duration, padded, np.int64),
+                occ=occ_col,
+                write=wr_col,
+            )
+            self.state, packed = buckets.apply_rounds_jit(
+                self.state, batch, rid_col, n_rounds, now_ms
+            )
+
+        def resolve():
+            with self._lock:
+                packed_np = np.asarray(packed)  # the one blocking transfer
+                if narrow:
+                    status, removed, remaining, reset, new_exp = buckets.unpack_output32(
+                        packed_np[:, :n], now_ms, self.table.get_expire_bulk(slots)
+                    )
+                else:
+                    status, removed, remaining, reset, new_exp = buckets.unpack_output(
+                        packed_np[:, :n]
+                    )
+                planner.commit_plan(new_exp, removed)
+                self.algo_mirror[slots] = cols.algo
+                return status, remaining, reset
+
+        return resolve
 
     def apply_columns(
         self,
@@ -408,11 +511,54 @@ class ShardStore:
         status/limit/remaining/reset_time.  Requires the native runtime
         and no Store SPI (use `apply` otherwise).
         """
+        cols = self._make_columns(algorithm, behavior, hits, limit, duration,
+                                  len(keys), greg_expire, greg_duration)
+        with self._lock:
+            handle = ColumnsHandle(
+                self, self._dispatch_columns(keys, cols, now_ms), cols.limit
+            )
+            self._inflight.append(handle)
+        return handle.result()
+
+    def apply_columns_async(
+        self,
+        keys: List[str],
+        algorithm,
+        behavior,
+        hits,
+        limit,
+        duration,
+        now_ms: int,
+        greg_expire=None,
+        greg_duration=None,
+    ) -> ColumnsHandle:
+        """Pipelined apply_columns: plans and enqueues the batch, then
+        returns immediately with a ColumnsHandle; `handle.result()`
+        blocks on the device readback.  Dispatching batch i+1 before
+        resolving batch i overlaps host planning and transfer with
+        device compute — the throughput shape of a batching ingress
+        pipeline (the reference's interval-drained queues,
+        peer_client.go:272-312, feeding a device instead of a socket).
+
+        Pipelined planning reads slot-table expiry that is stale by the
+        unresolved depth; the kernel revalidates expiry device-side, so
+        the only observable effect is eviction under pressure acting on
+        slightly old expire times."""
+        cols = self._make_columns(algorithm, behavior, hits, limit, duration,
+                                  len(keys), greg_expire, greg_duration)
+        with self._lock:
+            handle = ColumnsHandle(
+                self, self._dispatch_columns(keys, cols, now_ms), cols.limit
+            )
+            self._inflight.append(handle)
+        return handle
+
+    def _make_columns(self, algorithm, behavior, hits, limit, duration, n,
+                      greg_expire, greg_duration) -> "_Columns":
         if not (self._native and self.store is None):
             raise RuntimeError(
                 "apply_columns requires the native host runtime and no Store SPI"
             )
-        n = len(keys)
         cols = _Columns(0)
         cols.algo = np.ascontiguousarray(algorithm, dtype=np.int32)
         cols.behavior = np.ascontiguousarray(behavior, dtype=np.int32)
@@ -426,14 +572,17 @@ class ShardStore:
         cols.greg_duration = (
             z if greg_duration is None else np.ascontiguousarray(greg_duration, np.int64)
         )
+        return cols
+
+    def _drain_until(self, handle: "ColumnsHandle") -> None:
         with self._lock:
-            status, remaining, reset = self._run_columns(keys, cols, now_ms)
-        return {
-            "status": status,
-            "limit": cols.limit,
-            "remaining": remaining,
-            "reset_time": reset,
-        }
+            while self._inflight:
+                h = self._inflight.popleft()
+                h._do_resolve()
+                if h is handle:
+                    return
+            if not handle.done:  # not in the deque (already popped elsewhere)
+                handle._do_resolve()
 
     # ------------------------------------------------------------------
     # Store SPI integration

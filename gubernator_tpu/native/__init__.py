@@ -63,6 +63,7 @@ def _build() -> Optional[ctypes.CDLL]:
     ]
     lib.gt_table_remove.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
     lib.gt_table_set_expire.argtypes = [c.c_void_p, c.c_int32, c.c_int64]
+    lib.gt_table_get_expire.argtypes = [c.c_void_p, c.c_void_p, c.c_int64, c.c_void_p]
     lib.gt_table_commit.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64]
     lib.gt_table_commit_keys.argtypes = [
         c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64,
@@ -76,6 +77,16 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.gt_batch_commit_round.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
     lib.gt_batch_plan.restype = c.c_int64
     lib.gt_batch_plan.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p]
+    lib.gt_batch_plan_grouped.restype = c.c_int64
+    lib.gt_batch_plan_grouped.argtypes = [
+        c.c_void_p,  # batch
+        c.c_void_p, c.c_void_p,  # algo, behavior
+        c.c_void_p, c.c_void_p, c.c_void_p,  # hits, limit, duration
+        c.c_void_p, c.c_void_p,  # greg_expire, greg_duration
+        c.c_int32,  # RESET_REMAINING mask
+        c.c_void_p, c.c_void_p, c.c_void_p,  # round_id, slots, exists
+        c.c_void_p, c.c_void_p,  # occ, write
+    ]
     lib.gt_batch_commit_plan.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
     lib.gt_batch_free.argtypes = [c.c_void_p]
     lib.gt_fnv1_batch.argtypes = [c.c_void_p, c.c_void_p, c.c_int64, c.c_int32, c.c_void_p]
@@ -197,6 +208,16 @@ class NativeSlotTable:
     def set_expire(self, slot: int, expire_ms: int) -> None:
         self._lib.gt_table_set_expire(self._ptr, slot, expire_ms)
 
+    def get_expire_bulk(self, slots) -> np.ndarray:
+        """Expiry bookkeeping for many slots at once (narrow-wire
+        keep-sentinel decode, ops/buckets.py unpack_output32)."""
+        slots = np.ascontiguousarray(slots, dtype=np.int32)
+        out = np.empty(max(len(slots), 1), dtype=np.int64)
+        self._lib.gt_table_get_expire(
+            self._ptr, slots.ctypes.data, len(slots), out.ctypes.data
+        )
+        return out[: len(slots)]
+
     def commit(self, slots, new_expire_ms, removed, keys=None) -> None:
         slots = np.ascontiguousarray(slots, dtype=np.int32)
         expire = np.ascontiguousarray(new_expire_ms, dtype=np.int64)
@@ -283,6 +304,35 @@ class NativeBatchPlanner:
             slots[: self.n],
             exists[: self.n].astype(bool),
             int(n_rounds),
+        )
+
+    def plan_grouped(self, cols, reset_mask: int):
+        """Grouped full plan (gt_batch_plan_grouped): uniform duplicate
+        groups collapse into round 0 with per-lane occurrence indices;
+        the rest use rounds 1+.  `cols` carries contiguous algo(i32),
+        behavior(i32), hits/limit/duration/greg_expire/greg_duration
+        (i64) arrays aligned with the batch keys.  Returns (round_id,
+        slot, exists, occ, write, n_rounds)."""
+        n = max(self.n, 1)
+        round_id = np.zeros(n, dtype=np.int32)
+        slots = np.empty(n, dtype=np.int32)
+        exists = np.empty(n, dtype=np.uint8)
+        occ = np.zeros(n, dtype=np.int32)
+        write = np.empty(n, dtype=np.uint8)
+        n_rounds = self._lib.gt_batch_plan_grouped(
+            self._ptr,
+            cols.algo.ctypes.data, cols.behavior.ctypes.data,
+            cols.hits.ctypes.data, cols.limit.ctypes.data,
+            cols.duration.ctypes.data,
+            cols.greg_expire.ctypes.data, cols.greg_duration.ctypes.data,
+            reset_mask,
+            round_id.ctypes.data, slots.ctypes.data, exists.ctypes.data,
+            occ.ctypes.data, write.ctypes.data,
+        )
+        m = self.n
+        return (
+            round_id[:m], slots[:m], exists[:m].astype(bool),
+            occ[:m], write[:m].astype(bool), int(n_rounds),
         )
 
     def commit_plan(self, new_expire_ms, removed) -> None:

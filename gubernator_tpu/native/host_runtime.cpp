@@ -15,9 +15,11 @@
 // Thread-safety contract: one ShardStore lock guards each table, same
 // as the Python twin; no internal locking here.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <string>
+#include <string_view>
 #include <unordered_map>
 #include <vector>
 
@@ -29,6 +31,12 @@ struct Table {
   std::vector<std::string> slot_key;
   std::vector<uint8_t> slot_mapped;
   std::vector<int64_t> expire_ms;
+  // In-flight (planned, not yet committed) device writes per slot.
+  // While >0 the device row is fresher than expire_ms, so liveness is
+  // device-authoritative — the pipelined twin of the planner's chained
+  // lanes (see gt_batch_plan).  Nonzero only between a columnar batch's
+  // plan and its commit.
+  std::vector<int32_t> pending_write;
   // LRU intrusive list over slots; head = least recent. -1 = null.
   std::vector<int32_t> lru_prev, lru_next;
   int32_t lru_head = -1, lru_tail = -1;
@@ -41,6 +49,7 @@ struct Table {
         slot_key(cap),
         slot_mapped(cap, 0),
         expire_ms(cap, 0),
+        pending_write(cap, 0),
         lru_prev(cap, -1),
         lru_next(cap, -1) {
     free_slots.reserve(cap);
@@ -88,7 +97,10 @@ struct Table {
     if (it != key_to_slot.end()) {
       int32_t s = it->second;
       touch(s);
-      if (expire_ms[s] >= now_ms) {  // strict expiry (cache.go:151)
+      // Strict expiry (cache.go:151); an uncommitted in-flight write
+      // makes the device row authoritative regardless of the stale
+      // host expire (pipelined batches — the kernel revalidates).
+      if (expire_ms[s] >= now_ms || pending_write[s] > 0) {
         ++hits;
         return {s, true};
       }
@@ -129,6 +141,7 @@ struct Batch {
   // per-lane resolution cache (a deferred lane keeps its captured slot)
   std::vector<int32_t> slot;
   std::vector<uint8_t> exists, resolved;
+  bool committed = false;
   // last emitted round
   std::vector<int32_t> round_lane;
   // full-plan mode (gt_batch_plan): lanes in emission order across all
@@ -182,6 +195,16 @@ void gt_table_remove(void* tv, const char* key, int64_t len) {
 
 void gt_table_set_expire(void* tv, int32_t slot, int64_t expire) {
   ((Table*)tv)->expire_ms[slot] = expire;
+}
+
+// Bulk expiry read for the narrow-wire keep-sentinel decode: lanes
+// whose expire/reset passed through unchanged reconstruct the absolute
+// value from the host table instead of a (clippable) delta.
+void gt_table_get_expire(void* tv, const int32_t* slots, int64_t n,
+                         int64_t* out) {
+  Table* t = (Table*)tv;
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = (slots[i] >= 0 && slots[i] < t->capacity) ? t->expire_ms[slots[i]] : 0;
 }
 
 // Fold kernel outputs back (slot_table.py::commit): slots<0 skipped.
@@ -327,28 +350,31 @@ void gt_batch_commit_round(void* bv, const int64_t* new_expire,
 // expire_at=0.  This removes the need for host expire updates between
 // rounds, which is exactly what forces a blocking device->host readback
 // per round in the interleaved design.
-int64_t gt_batch_plan(void* bv, int32_t* round_id, int32_t* slots,
-                      uint8_t* exists) {
-  Batch* b = (Batch*)bv;
+// Shared round scheduler for both full-plan entry points: walks
+// b->pending (in request order) emitting rounds from `round` upward,
+// deferring later same-key occurrences and eviction collisions.
+// `occ`/`write` may be null (gt_batch_plan); when present each emitted
+// lane gets occ=0, write=1 — every round-scheme lane scatters.
+//
+// key -> slot at first emission: a later lane is chained (device-
+// authoritative) only while it still resolves to that same slot; a
+// mid-batch eviction reassigning the key to a fresh slot falls back
+// to the host's exists (the state was lost, as in the reference's
+// LRU eviction of a live item).
+static int64_t plan_rounds(Batch* b, int64_t round, int32_t* round_id,
+                           int32_t* slots, uint8_t* exists, int32_t* occ,
+                           uint8_t* write) {
   Table* t = b->table;
-  b->plan_order.clear();
-  b->plan_order.reserve((size_t)b->n);
-  // key -> slot at first emission: a later lane is chained (device-
-  // authoritative) only while it still resolves to that same slot; a
-  // mid-batch eviction reassigning the key to a fresh slot falls back
-  // to the host's exists (the state was lost, as in the reference's
-  // LRU eviction of a live item).
-  std::unordered_map<std::string, int32_t> emitted;
-  emitted.reserve((size_t)b->n * 2);
-  int64_t round = 0;
+  std::unordered_map<std::string_view, int32_t> emitted;
+  emitted.reserve(b->pending.size() * 2);
   while (!b->pending.empty()) {
-    std::unordered_map<std::string, int> seen_keys;
+    std::unordered_map<std::string_view, int> seen_keys;
     std::unordered_map<int32_t, int> used_slots;
     seen_keys.reserve(b->pending.size() * 2);
     used_slots.reserve(b->pending.size() * 2);
     std::vector<int32_t> deferred;
     for (int32_t i : b->pending) {
-      std::string k(b->key_ptr(i), b->key_len(i));
+      std::string_view k(b->key_ptr(i), b->key_len(i));
       if (seen_keys.count(k)) {
         deferred.push_back(i);
         continue;
@@ -361,24 +387,35 @@ int64_t gt_batch_plan(void* bv, int32_t* round_id, int32_t* slots,
       }
       if (used_slots.count(b->slot[i])) {  // eviction collision: defer as-is
         deferred.push_back(i);
-        seen_keys.emplace(std::move(k), 1);
+        seen_keys.emplace(k, 1);
         continue;
       }
       round_id[i] = (int32_t)round;
       slots[i] = b->slot[i];
+      if (occ != nullptr) occ[i] = 0;
+      if (write != nullptr) write[i] = 1;
       auto em = emitted.find(k);
       exists[i] = (em != emitted.end() && em->second == b->slot[i])
                       ? 1
                       : b->exists[i];
       b->plan_order.push_back(i);
+      ++t->pending_write[b->slot[i]];
       seen_keys.emplace(k, 1);
-      emitted.emplace(std::move(k), b->slot[i]);
+      emitted.emplace(k, b->slot[i]);
       used_slots.emplace(b->slot[i], 1);
     }
     b->pending.swap(deferred);
     ++round;
   }
   return round;
+}
+
+int64_t gt_batch_plan(void* bv, int32_t* round_id, int32_t* slots,
+                      uint8_t* exists) {
+  Batch* b = (Batch*)bv;
+  b->plan_order.clear();
+  b->plan_order.reserve((size_t)b->n);
+  return plan_rounds(b, 0, round_id, slots, exists, nullptr, nullptr);
 }
 
 // Fold the planned batch's kernel outputs (indexed by ORIGINAL lane)
@@ -392,9 +429,11 @@ void gt_batch_commit_plan(void* bv, const int64_t* new_expire,
                           const uint8_t* removed) {
   Batch* b = (Batch*)bv;
   Table* t = b->table;
+  b->committed = true;
   for (int32_t i : b->plan_order) {
     int32_t s = b->slot[i];
     if (s < 0) continue;
+    if (t->pending_write[s] > 0) --t->pending_write[s];
     bool mine = t->slot_mapped[s] &&
                 t->slot_key[s].compare(0, std::string::npos, b->key_ptr(i),
                                        b->key_len(i)) == 0;
@@ -403,7 +442,10 @@ void gt_batch_commit_plan(void* bv, const int64_t* new_expire,
       continue;
     }
     if (mine) {
-      t->expire_ms[s] = new_expire[i];
+      // Negative expire is the narrow-wire "unchanged" sentinel
+      // (ops/buckets.py unpack_output32): the kernel passed the slot's
+      // pre-batch expiry through, so the host value is already right.
+      if (new_expire[i] >= 0) t->expire_ms[s] = new_expire[i];
     } else if (!t->slot_mapped[s]) {
       std::string k(b->key_ptr(i), b->key_len(i));
       // Guard: if the key meanwhile maps elsewhere (mid-batch eviction
@@ -411,7 +453,7 @@ void gt_batch_commit_plan(void* bv, const int64_t* new_expire,
       if (!t->key_to_slot.emplace(k, s).second) continue;
       t->slot_key[s] = std::move(k);
       t->slot_mapped[s] = 1;
-      t->expire_ms[s] = new_expire[i];
+      t->expire_ms[s] = new_expire[i] >= 0 ? new_expire[i] : 0;
       // slot was unmapped (free-listed); pull it back into LRU order
       for (size_t j = 0; j < t->free_slots.size(); ++j) {
         if (t->free_slots[j] == s) {
@@ -425,7 +467,112 @@ void gt_batch_commit_plan(void* bv, const int64_t* new_expire,
   }
 }
 
-void gt_batch_free(void* bv) { delete (Batch*)bv; }
+// Grouped full plan: uniform duplicate groups collapse into round 0.
+//
+// A "uniform group" is every lane of one key whose request config
+// (algorithm, behavior, hits, limit, duration, greg columns) is
+// identical and carries no RESET_REMAINING (whose remove-recreate chain
+// is inherently sequential).  Such a group needs no rounds at all: the
+// kernel computes each occurrence's response in closed form from the
+// occurrence index (ops/buckets.py analytic-duplicate math) and only
+// the LAST occurrence scatters.  Lanes that do not qualify fall back to
+// the round scheme starting at round 1.  This turns hot-key skew — the
+// reference's thundering-herd case (its BATCHING exists for exactly
+// this, architecture.md:19-25) — from O(max multiplicity) sequential
+// kernel rounds into O(1).
+//
+// Outputs per lane: round_id, slot, exists, occ (occurrence index
+// within a uniform group; 0 otherwise), write (1 when this lane's lane
+// scatters state: the last occurrence of a uniform group, or every
+// round-scheme lane).  Returns the round count.
+int64_t gt_batch_plan_grouped(void* bv, const int32_t* algo,
+                              const int32_t* behavior, const int64_t* hits,
+                              const int64_t* limit, const int64_t* duration,
+                              const int64_t* greg_e, const int64_t* greg_d,
+                              int32_t reset_mask, int32_t* round_id,
+                              int32_t* slots, uint8_t* exists, int32_t* occ,
+                              uint8_t* write) {
+  Batch* b = (Batch*)bv;
+  Table* t = b->table;
+  b->plan_order.clear();
+  b->plan_order.reserve((size_t)b->n);
+
+  // Group lanes by key, preserving first-appearance order.  Keys view
+  // the borrowed packed buffer — no per-lane allocation.
+  std::unordered_map<std::string_view, int32_t> group_of;
+  group_of.reserve((size_t)b->n * 2);
+  std::vector<std::vector<int32_t>> groups;
+  groups.reserve((size_t)b->n);
+  for (int64_t i = 0; i < b->n; ++i) {
+    std::string_view k(b->key_ptr(i), b->key_len(i));
+    auto [it, fresh] = group_of.emplace(k, (int32_t)groups.size());
+    if (fresh) groups.emplace_back();
+    groups[it->second].push_back((int32_t)i);
+  }
+
+  std::unordered_map<int32_t, int> used0;  // slots written in round 0
+  used0.reserve(groups.size() * 2);
+  std::vector<int32_t> slow;  // lanes for the round scheme
+  for (auto& g : groups) {
+    int32_t first = g[0];
+    bool uniform = (behavior[first] & reset_mask) == 0;
+    for (size_t j = 1; uniform && j < g.size(); ++j) {
+      int32_t i = g[j];
+      uniform = algo[i] == algo[first] && behavior[i] == behavior[first] &&
+                hits[i] == hits[first] && limit[i] == limit[first] &&
+                duration[i] == duration[first] &&
+                greg_e[i] == greg_e[first] && greg_d[i] == greg_d[first];
+    }
+    int64_t ev_before = t->evictions;
+    auto [s, e] =
+        t->lookup_or_assign(b->key_ptr(first), b->key_len(first), b->now_ms);
+    b->slot[first] = s;
+    b->exists[first] = e ? 1 : 0;
+    b->resolved[first] = 1;
+    // An eviction may have stolen the slot from a key with EARLIER
+    // lanes in this batch; scheduling this group in round 0 would run
+    // the create before the victim's lanes.  Demote to the slow path,
+    // whose per-round slot-collision deferral orders it correctly.
+    bool evicted = t->evictions != ev_before;
+    if (uniform && !evicted && !used0.count(s)) {
+      used0.emplace(s, 1);
+      ++t->pending_write[s];
+      for (size_t j = 0; j < g.size(); ++j) {
+        int32_t i = g[j];
+        round_id[i] = 0;
+        slots[i] = s;
+        exists[i] = e ? 1 : 0;
+        occ[i] = (int32_t)j;
+        write[i] = (j + 1 == g.size()) ? 1 : 0;
+        b->slot[i] = s;
+        if (write[i]) b->plan_order.push_back(i);
+      }
+    } else {
+      for (int32_t i : g) slow.push_back(i);
+    }
+  }
+  if (slow.empty()) return 1;
+
+  // Round scheme for the leftovers, starting at round 1 (round 0 is the
+  // grouped dispatch).  Same chaining/deferral rules as gt_batch_plan.
+  std::sort(slow.begin(), slow.end());
+  b->pending.assign(slow.begin(), slow.end());
+  return plan_rounds(b, 1, round_id, slots, exists, occ, write);
+}
+
+void gt_batch_free(void* bv) {
+  Batch* b = (Batch*)bv;
+  // A planned-but-never-committed batch (error path) must release its
+  // pending-write claims or the slots stay device-authoritative forever.
+  if (!b->committed) {
+    Table* t = b->table;
+    for (int32_t i : b->plan_order) {
+      int32_t s = b->slot[i];
+      if (s >= 0 && t->pending_write[s] > 0) --t->pending_write[s];
+    }
+  }
+  delete b;
+}
 
 // ---------------------------------------------------------------------
 // FNV-1 / FNV-1a 64 over a packed key batch (replicated_hash.go:31 uses

@@ -189,6 +189,13 @@ class RequestBatch(NamedTuple):
     duration: jax.Array  # i64[B]
     greg_expire: jax.Array  # i64[B] (0 unless DURATION_IS_GREGORIAN)
     greg_duration: jax.Array  # i64[B] (0 unless DURATION_IS_GREGORIAN)
+    # Analytic-duplicate extension (grouped planner,
+    # gt_batch_plan_grouped): occurrence index within a uniform
+    # duplicate group, and whether this lane scatters state (the last
+    # occurrence).  None => every lane is its own group (occ=0,
+    # write=valid), which is byte-identical to the pre-extension kernel.
+    occ: "jax.Array | None" = None  # i32[B]
+    write: "jax.Array | None" = None  # bool[B]
 
 
 class BatchOutput(NamedTuple):
@@ -225,6 +232,8 @@ def make_batch(
     duration,
     greg_expire=None,
     greg_duration=None,
+    occ=None,
+    write=None,
 ) -> RequestBatch:
     """Convenience constructor coercing host arrays to kernel dtypes."""
     slot = jnp.asarray(slot, _I32)
@@ -239,6 +248,8 @@ def make_batch(
         duration=jnp.asarray(duration, _I64),
         greg_expire=z if greg_expire is None else jnp.asarray(greg_expire, _I64),
         greg_duration=z if greg_duration is None else jnp.asarray(greg_duration, _I64),
+        occ=None if occ is None else jnp.asarray(occ, _I32),
+        write=None if write is None else jnp.asarray(write, bool),
     )
 
 
@@ -276,6 +287,23 @@ def apply_batch(state: BucketState, req: RequestBatch, now_ms) -> "tuple[BucketS
     OVER = jnp.asarray(int(Status.OVER_LIMIT), _I32)
     UNDER = jnp.asarray(int(Status.UNDER_LIMIT), _I32)
 
+    # Analytic-duplicate support: a uniform duplicate group (same key,
+    # identical config/hits, no RESET_REMAINING — enforced by the
+    # grouped planner) runs entirely in one round.  Every lane reads the
+    # SAME pre-group slot row; occurrence j's pre-hit remaining is
+    # derived in closed form (the first j duplicates accepted
+    # min(j, base // hits) hits), and only the last occurrence scatters.
+    # occ=None degenerates to occ=0 everywhere: byte-identical to the
+    # ungrouped kernel.
+    occ64 = None if req.occ is None else req.occ.astype(_I64)
+    hs = jnp.maximum(hits, 1)
+
+    def occ_rem(base):
+        if occ64 is None:
+            return base
+        taken = jnp.minimum(occ64, base // hs)
+        return jnp.where(hits > 0, base - hits * taken, base)
+
     # ---------------- token bucket, existing item ----------------
     # RESET_REMAINING is checked before the algorithm-switch cast in the
     # reference (algorithms.go:36 precedes :54), so it applies to any live
@@ -293,6 +321,7 @@ def apply_batch(state: BucketState, req: RequestBatch, now_ms) -> "tuple[BucketS
 
     tok_exist = exist & is_tok & ~reset_b & ~dur_expired
     do_hit = hits > 0
+    t_rem0 = occ_rem(t_rem0)  # this occurrence's pre-hit remaining
     can_take = do_hit & (hits <= t_rem0)  # covers == and < ; mutates
     t_rem1 = jnp.where(can_take, t_rem0 - hits, t_rem0)
     t_resp_status = jnp.where(
@@ -304,9 +333,22 @@ def apply_batch(state: BucketState, req: RequestBatch, now_ms) -> "tuple[BucketS
     # ---------------- token bucket, fresh create ----------------
     # (selected in sel() as the fallback for token lanes that are neither
     # tok_reset nor tok_exist: plain miss, algo switch, or dur_expired)
+    # Occurrence j applies to the remaining the first lane's create left
+    # behind; hits > pre-hit remaining covers the hits > limit case of
+    # lane 0 (algorithms.go:161-166) and every later over/at-zero lane.
     c_exp_tok = jnp.where(greg, req.greg_expire, now + req.duration)
-    c_over = hits > req.limit  # algorithms.go:161-166
-    c_rem_tok = jnp.where(c_over, req.limit, req.limit - hits)
+    remc = occ_rem(req.limit)
+    c_over = hits > remc
+    c_rem_tok = jnp.where(c_over, remc, remc - hits)
+    # Sticky for grouped creates: a later occurrence that found the
+    # fresh bucket already drained sets OVER exactly as the exist path
+    # would have in its sequential round (do_hit & pre-rem == 0).
+    if occ64 is None:
+        c_status_store = UNDER * jnp.ones_like(g_status)
+    else:
+        c_status_store = jnp.where(
+            (occ64 > 0) & do_hit & (remc == 0), OVER, UNDER
+        )
 
     # ---------------- leaky bucket, existing item ----------------
     lky_exist = exist & ~is_tok
@@ -328,24 +370,40 @@ def apply_batch(state: BucketState, req: RequestBatch, now_ms) -> "tuple[BucketS
     l_stamp = jnp.where(do_leak, now, g_stamp)
     l_rem = jnp.where(l_rem // LEAKY_SCALE > req.limit, req.limit * LEAKY_SCALE, l_rem)
 
-    rem_int = l_rem // LEAKY_SCALE
+    rem_int0 = l_rem // LEAKY_SCALE
     l_reset = now + rate_num // lim_safe  # now + int64(rate) (algorithms.go:251)
+
+    # Occurrence offset: earlier duplicates consumed whole tokens only
+    # (the fractional part never changes within one `now`).
+    rem_int = occ_rem(rem_int0)
+    l_rem_base = l_rem - (rem_int0 - rem_int) * LEAKY_SCALE
 
     at_zero = rem_int == 0  # algorithms.go:260-264 (OVER even for hits==0)
     exact = ~at_zero & (rem_int == hits)  # algorithms.go:266-271
     overflow = ~at_zero & ~exact & (hits > rem_int)  # algorithms.go:273-278
     take = exact | (~at_zero & ~overflow & (hits > 0))
-    l_rem_f = jnp.where(take, l_rem - hits * LEAKY_SCALE, l_rem)
+    l_rem_f = jnp.where(take, l_rem_base - hits * LEAKY_SCALE, l_rem_base)
     l_resp_rem = jnp.where(exact, 0, jnp.where(take, l_rem_f // LEAKY_SCALE, rem_int))
     l_resp_status = jnp.where(at_zero | overflow, OVER, UNDER)
-    # Expiry refresh only on the plain-subtract path (algorithms.go:287).
-    plain = take & ~exact
-    l_exp = jnp.where(plain, now + dur_eff, g_exp)
+    # Expiry refresh only on the plain-subtract path (algorithms.go:287):
+    # for a group, "any accepted occurrence so far was a plain subtract".
+    taken_cnt = jnp.where(hits > 0, (rem_int0 - rem_int) // hs, 0) + take.astype(_I64)
+    drained_exactly = (hits > 0) & (taken_cnt > 0) & (rem_int - hits * take.astype(_I64) == 0)
+    any_plain = (taken_cnt - drained_exactly.astype(_I64)) >= 1
+    l_exp = jnp.where(any_plain, now + dur_eff, g_exp)
 
     # ---------------- leaky bucket, fresh create ----------------
+    # Over-create clamps stored remaining to 0 (algorithms.go:318-323),
+    # so later occurrences of an over-create group see 0, not limit.
     lky_create = ~is_tok & ~exist
-    lc_over = hits > req.limit  # algorithms.go:318-323
-    lc_rem = jnp.where(lc_over, 0, (req.limit - hits) * LEAKY_SCALE)
+    lc_over_all = hits > req.limit
+    remlc = occ_rem(req.limit)
+    if occ64 is not None:
+        remlc = jnp.where(lc_over_all & (occ64 > 0), 0, remlc)
+    lc_take = (hits > 0) & (hits <= remlc)
+    lc_over = hits > remlc  # covers lane 0's hits > limit and drained lanes
+    lc_rem = jnp.where(lc_over_all, 0, (remlc - hits * lc_take) * LEAKY_SCALE)
+    lc_resp_rem = jnp.where(lc_take, remlc - hits, jnp.where(lc_over_all, 0, remlc))
     lc_exp = now + dur_eff
     lc_reset = now + dur_eff // lim_safe  # algorithms.go:315 (integer div)
 
@@ -375,7 +433,7 @@ def apply_batch(state: BucketState, req: RequestBatch, now_ms) -> "tuple[BucketS
         jnp.where(can_take, t_rem1, t_rem0),
         c_rem_tok,
         l_resp_rem,
-        jnp.where(lc_over, z64, req.limit - hits),
+        lc_resp_rem,
     )
     resp_reset = sel(z64, t_exp, c_exp_tok, l_reset, lc_reset)
 
@@ -388,14 +446,18 @@ def apply_batch(state: BucketState, req: RequestBatch, now_ms) -> "tuple[BucketS
     n_dur = sel(g_dur, g_dur, req.duration, req.duration, dur_eff)
     n_stamp = sel(g_stamp, g_stamp, now, l_stamp, now)
     n_exp = sel(z64, t_exp, c_exp_tok, l_exp, lc_exp)
-    n_status = sel(UNDER * jnp.ones_like(g_status), t_new_status, UNDER, UNDER, UNDER)
+    n_status = sel(
+        UNDER * jnp.ones_like(g_status), t_new_status, c_status_store, UNDER, UNDER
+    )
 
     removed = tok_reset & valid
 
     # Scatter rows back.  Padding lanes (slot=-1) must NOT write: jax
     # `.at[-1]` wraps like NumPy negative indexing, so map them to C
-    # (out of bounds) where mode='drop' actually drops them.
-    scat = jnp.where(valid, req.slot, C)
+    # (out of bounds) where mode='drop' actually drops them.  In grouped
+    # mode only the LAST occurrence of each duplicate group writes.
+    writes = valid if req.write is None else (valid & req.write)
+    scat = jnp.where(writes, req.slot, C)
     drop = dict(mode="drop")
     new_state = BucketState(
         algo=state.algo.at[scat].set(n_algo, **drop),
@@ -482,6 +544,142 @@ def apply_rounds(
 
 
 apply_rounds_jit = jax.jit(apply_rounds, donate_argnums=0)
+
+
+class RequestBatch32(NamedTuple):
+    """Narrow-wire twin of RequestBatch: i32 value columns, Gregorian
+    expiry as a delta from `now_ms`.  Halves host->device bytes and is
+    usable whenever the batch's values fit (the common case: hits,
+    limit, duration < 2**31 and no monthly/yearly Gregorian resets).
+    The kernel computes in int64 regardless — only the WIRE narrows,
+    which is what matters when the device sits across a thin link."""
+
+    slot: jax.Array  # i32[B]
+    exists: jax.Array  # bool[B]
+    algorithm: jax.Array  # i32[B]
+    behavior: jax.Array  # i32[B]
+    hits: jax.Array  # i32[B]
+    limit: jax.Array  # i32[B]
+    duration: jax.Array  # i32[B]
+    greg_expire_delta: jax.Array  # i32[B] (greg_expire - now; 0 if unused)
+    greg_duration: jax.Array  # i32[B]
+    occ: "jax.Array | None" = None  # i32[B]
+    write: "jax.Array | None" = None  # bool[B]
+
+
+def make_batch32(
+    slot, exists, algorithm, behavior, hits, limit, duration,
+    greg_expire_delta=None, greg_duration=None, occ=None, write=None,
+) -> RequestBatch32:
+    z = jnp.zeros_like(jnp.asarray(hits, _I32))
+    return RequestBatch32(
+        slot=jnp.asarray(slot, _I32),
+        exists=jnp.asarray(exists, bool),
+        algorithm=jnp.asarray(algorithm, _I32),
+        behavior=jnp.asarray(behavior, _I32),
+        hits=jnp.asarray(hits, _I32),
+        limit=jnp.asarray(limit, _I32),
+        duration=jnp.asarray(duration, _I32),
+        greg_expire_delta=z if greg_expire_delta is None else jnp.asarray(greg_expire_delta, _I32),
+        greg_duration=z if greg_duration is None else jnp.asarray(greg_duration, _I32),
+        occ=None if occ is None else jnp.asarray(occ, _I32),
+        write=None if write is None else jnp.asarray(write, bool),
+    )
+
+
+def apply_rounds32(
+    state: BucketState, req32: RequestBatch32, round_id, n_rounds, now_ms
+) -> "tuple[BucketState, jax.Array]":
+    """apply_rounds with an int32 wire on BOTH directions.
+
+    Input columns upcast on device; the packed result narrows to
+    i32[4, B] (row 0 bit-packs status/removed; rows 1-3 are remaining,
+    reset_time - now, new_expire - now).  Callers must guarantee the
+    narrow preconditions (ShardStore checks them host-side):
+    limit/hits/duration in [0, 2**31) and Gregorian deltas in range.
+    Those bound every value the kernel COMPUTES this batch; a time the
+    kernel merely passes through unchanged (a live bucket's stored
+    expiry, which may lie arbitrarily far in the future from a wide
+    batch) is encoded as the sentinel -2 ("unchanged") and reconstructed
+    host-side from the slot table (unpack_output32), never clipped.
+    """
+    now = jnp.asarray(now_ms, _I64)
+    req = RequestBatch(
+        slot=req32.slot,
+        exists=req32.exists,
+        algorithm=req32.algorithm,
+        behavior=req32.behavior,
+        hits=req32.hits.astype(_I64),
+        limit=req32.limit.astype(_I64),
+        duration=req32.duration.astype(_I64),
+        greg_expire=now + req32.greg_expire_delta.astype(_I64),
+        greg_duration=req32.greg_duration.astype(_I64),
+        occ=req32.occ,
+        write=req32.write,
+    )
+    # Pre-batch expiry per lane, read BEFORE the rounds mutate state:
+    # the pass-through detector for the -2 sentinel.
+    C = state.expire_at.shape[0]
+    pre_exp = state.expire_at[jnp.clip(req32.slot, 0, C - 1)]
+
+    state, packed64 = apply_rounds(state, req, round_id, n_rounds, now_ms)
+    hi = jnp.asarray((1 << 31) - 1, _I64)
+
+    def delta(v):
+        # -1: absolute 0 (removed slot / no reset) — restore exact 0.
+        # -2: UNREPRESENTABLE pass-through (a live bucket's far-future
+        #     stored time, only reachable unchanged from pre-batch
+        #     state) — host reconstructs the absolute value.  The
+        #     sentinel must fire ONLY when the delta would clip: a
+        #     representable value always rides the wire verbatim, so a
+        #     coincidental v == pre_exp (e.g. an eviction-recycled slot
+        #     recreated at the same expiry) still commits correctly.
+        d = v - now
+        fits = (d >= 0) & (d <= hi)
+        return jnp.where(
+            v == 0, -1, jnp.where(fits, d, jnp.where(v == pre_exp, -2, jnp.clip(d, 0, hi)))
+        )
+
+    packed32 = jnp.stack(
+        (
+            packed64[0],
+            jnp.clip(packed64[1], 0, hi),
+            delta(packed64[2]),
+            delta(packed64[3]),
+        )
+    ).astype(_I32)
+    return state, packed32
+
+
+apply_rounds32_jit = jax.jit(apply_rounds32, donate_argnums=0)
+
+
+def unpack_output32(packed, now_ms: int, table_expire):
+    """Host-side twin of apply_rounds32's packing: (status, removed,
+    remaining, reset_time, new_expire) with absolute int64 times.
+
+    Sentinels: -1 decodes to absolute 0 (removed/no-reset); -2 means
+    "unchanged pass-through" — reset_time reconstructs from
+    `table_expire` (the slot table's pre-commit value, identical to the
+    device's pre-batch expire), and new_expire stays -1 so commit_plan
+    skips the (already correct) host bookkeeping.
+    """
+    import numpy as np
+
+    row0 = packed[0]
+    te = np.asarray(table_expire, dtype="int64")
+
+    def undelta(row, keep):
+        d = row.astype("int64")
+        return np.where(d == -2, keep, np.where(d == -1, 0, d + now_ms))
+
+    return (
+        (row0 & 1).astype("int32"),
+        ((row0 >> 1) & 1).astype(bool),
+        packed[1].astype("int64"),
+        undelta(packed[2], te),
+        undelta(packed[3], np.int64(-1)),
+    )
 
 
 @jax.jit
