@@ -10,6 +10,10 @@ test:
 bench:
 	python bench.py
 
+# The five BASELINE.json configs (one JSON line each); --smoke for CI
+bench-full:
+	python bench_full.py
+
 proto:
 	bash scripts/proto.sh
 

@@ -1,0 +1,264 @@
+"""Benchmark suite: the five BASELINE.json configs.
+
+Each config prints one JSON line (same shape as bench.py).  Run all
+with `python bench_full.py`, or one with `--config N`.  On a single
+real TPU chip configs 4-5 shrink their cluster/mesh dimensions to what
+the host offers; on the virtual CPU mesh (JAX_PLATFORMS=cpu +
+--xla_force_host_platform_device_count=8) config 4 exercises the full
+8-shard collective path.
+
+Reference harness equivalents: benchmark_test.go:28-138 (configs 1),
+its in-process cluster (config 5), and the Zipf/Gregorian/GLOBAL
+configs enumerated in BASELINE.json.
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+BASELINE_RPS = 2000.0  # reference single-node req/s (README.md:96-100)
+NOW = 1_700_000_000_000
+
+
+def _emit(name, checks, seconds, **extra):
+    cps = checks / seconds
+    print(
+        json.dumps(
+            {
+                "metric": f"cfg{name}_checks_per_sec",
+                "value": round(cps, 1),
+                "unit": "checks/s",
+                "vs_baseline": round(cps / BASELINE_RPS, 2),
+                **extra,
+            }
+        ),
+        flush=True,
+    )
+
+
+SCALE = 1.0  # --smoke shrinks every config for CI-speed correctness runs
+
+
+def _sz(n, lo=64):
+    return max(int(n * SCALE), lo)
+
+
+def _zipf_ids(rng, n_keys, batch, hot_frac=0.1, hot_traffic=0.8):
+    hot = rng.randint(0, max(int(n_keys * hot_frac), 1), size=batch)
+    cold = rng.randint(0, n_keys, size=batch)
+    return np.where(rng.random(batch) < hot_traffic, hot, cold)
+
+
+def _pump(store, keys, cols, iters, warm=2):
+    """Pipelined steady-state pump over one prepared batch."""
+    def dispatch(i):
+        return store.apply_columns_async(keys, now_ms=NOW + i, **cols)
+
+    for i in range(warm):
+        dispatch(i).result()
+    t0 = time.perf_counter()
+    pending = None
+    for i in range(iters):
+        h = dispatch(warm + i)
+        if pending is not None:
+            pending.result()
+        pending = h
+    pending.result()
+    return time.perf_counter() - t0
+
+
+def config1():
+    """Token bucket, single node, NO_BATCHING, 1k unique keys."""
+    from gubernator_tpu.models.shard import ShardStore
+    from gubernator_tpu.types import Behavior
+
+    rng = np.random.RandomState(1)
+    batch, iters = _sz(65_536), 10
+    key_ids = rng.randint(0, 1000, size=batch)
+    keys = [f"c1:{k}" for k in key_ids]
+    cols = dict(
+        algorithm=np.zeros(batch, np.int32),
+        behavior=np.full(batch, int(Behavior.NO_BATCHING), np.int32),
+        hits=np.ones(batch, np.int64),
+        limit=np.full(batch, 100_000, np.int64),
+        duration=np.full(batch, 60_000, np.int64),
+    )
+    store = ShardStore(capacity=4096)
+    dt = _pump(store, keys, cols, iters)
+    _emit(1, batch * iters, dt, keys_unique=1000)
+
+
+def config2():
+    """Leaky bucket, BATCHING, 1M unique keys, Zipf-distributed."""
+    from gubernator_tpu.models.shard import ShardStore
+
+    rng = np.random.RandomState(2)
+    batch, iters = _sz(131_072), 8
+    n_keys = _sz(1_000_000)
+    key_ids = _zipf_ids(rng, n_keys, batch)
+    keys = [f"c2:{k}" for k in key_ids]
+    cols = dict(
+        algorithm=np.ones(batch, np.int32),  # LEAKY
+        behavior=np.zeros(batch, np.int32),  # BATCHING is the zero value
+        hits=np.ones(batch, np.int64),
+        limit=np.full(batch, 1_000_000, np.int64),
+        duration=np.full(batch, 3_600_000, np.int64),
+    )
+    store = ShardStore(capacity=_sz(1_200_000))
+    dt = _pump(store, keys, cols, iters)
+    _emit(2, batch * iters, dt, keys_unique=n_keys)
+
+
+def config3():
+    """Mixed token+leaky with Gregorian daily/monthly resets, 10M keyspace.
+
+    Gregorian lanes carry precomputed calendar expiries (the host side
+    of DURATION_IS_GREGORIAN), which exceed the int32 delta and drive
+    the wide kernel path; the table is smaller than the keyspace so LRU
+    eviction churn is part of the measurement."""
+    from gubernator_tpu.models.shard import GregResolver, ShardStore
+    from gubernator_tpu.types import Behavior
+    from gubernator_tpu.utils import gregorian
+
+    rng = np.random.RandomState(3)
+    batch, iters = _sz(131_072), 6
+    n_keys = _sz(10_000_000)
+    key_ids = _zipf_ids(rng, n_keys, batch)
+    keys = [f"c3:{k}" for k in key_ids]
+    greg = GregResolver(NOW)
+    ge_d, gd_d = greg.resolve(gregorian.GREGORIAN_DAYS)
+    ge_m, gd_m = greg.resolve(gregorian.GREGORIAN_MONTHS)
+    monthly = (key_ids % 2).astype(bool)
+    cols = dict(
+        algorithm=(key_ids % 2).astype(np.int32),
+        behavior=np.full(batch, int(Behavior.DURATION_IS_GREGORIAN), np.int32),
+        hits=np.ones(batch, np.int64),
+        limit=np.full(batch, 1_000_000, np.int64),
+        duration=np.where(monthly, gregorian.GREGORIAN_MONTHS, gregorian.GREGORIAN_DAYS).astype(np.int64),
+        greg_expire=np.where(monthly, ge_m, ge_d).astype(np.int64),
+        greg_duration=np.where(monthly, gd_m, gd_d).astype(np.int64),
+    )
+    cap = _sz(2_000_000)
+    store = ShardStore(capacity=cap)
+    dt = _pump(store, keys, cols, iters)
+    _emit(3, batch * iters, dt, keyspace=n_keys, table_capacity=cap)
+
+
+def config4():
+    """GLOBAL behavior on the device mesh: hot-key skew answered from
+    replica caches, periodic sync collectives converging the counters
+    across shards."""
+    import jax
+
+    from gubernator_tpu.parallel.mesh import MeshBucketStore
+    from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest
+
+    n_dev = len(jax.devices())
+    store = MeshBucketStore(capacity_per_shard=8192, g_capacity=512)
+    rng = np.random.RandomState(4)
+    batch, iters = _sz(2048), 6
+    reqs_proto = [
+        RateLimitRequest(
+            name="c4",
+            unique_key=f"hot{k}",
+            hits=1,
+            limit=10_000_000,
+            duration=3_600_000,
+            algorithm=Algorithm.TOKEN_BUCKET,
+            behavior=Behavior.GLOBAL,
+        )
+        for k in range(64)  # 64 hot GLOBAL keys
+    ]
+    ids = rng.randint(0, 64, size=batch)
+    batch_reqs = [reqs_proto[i] for i in ids]
+    store.apply(batch_reqs, NOW)
+    store.sync_globals(NOW)
+    t0 = time.perf_counter()
+    syncs = 0
+    for i in range(iters):
+        store.apply(batch_reqs, NOW + 1 + i, home_shard=i % n_dev)
+        res = store.sync_globals(NOW + 1 + i)
+        syncs += res.broadcast_count
+    dt = time.perf_counter() - t0
+    _emit(4, batch * iters, dt, shards=n_dev, broadcasts=syncs)
+
+
+def config5():
+    """Service-tier storm across 2 regions: an in-process cluster of
+    real daemons (2 DCs), MULTI_REGION OVER_LIMIT traffic through the
+    HTTP edge — the reference's loopback-cluster benchmark topology
+    (benchmark_test.go ThunderingHeard + cluster/cluster.go)."""
+    from gubernator_tpu.client import V1Client
+    from gubernator_tpu.cluster import Cluster
+    from gubernator_tpu.types import (
+        Algorithm,
+        Behavior,
+        GetRateLimitsRequest,
+        RateLimitRequest,
+    )
+
+    cl = Cluster().start_with(["", "", "dc-east", "dc-east"])
+    try:
+        # Generous timeout: the first batch shape pays its jit compile.
+        clients = [V1Client(d.gateway.address, timeout_s=120.0) for d in cl.daemons]
+        batches = []
+        rng = np.random.RandomState(5)
+        for _ in range(8):
+            batches.append(
+                GetRateLimitsRequest(
+                    requests=[
+                        RateLimitRequest(
+                            name="c5",
+                            unique_key=f"storm{rng.randint(16)}",
+                            hits=5,
+                            limit=10,  # most responses OVER_LIMIT: the storm
+                            duration=60_000,
+                            algorithm=Algorithm.TOKEN_BUCKET,
+                            behavior=Behavior.MULTI_REGION,
+                        )
+                        for _ in range(_sz(512))
+                    ]
+                )
+            )
+        # warm every daemon's path
+        for c in clients:
+            c.get_rate_limits(batches[0])
+        t0 = time.perf_counter()
+        total = over = 0
+        for i, b in enumerate(batches):
+            resp = clients[i % len(clients)].get_rate_limits(b)
+            total += len(resp.responses)
+            over += sum(r.status == 1 for r in resp.responses)
+        dt = time.perf_counter() - t0
+        _emit(5, total, dt, regions=2, daemons=len(cl.daemons), over_limit=over)
+    finally:
+        cl.stop()
+
+
+CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--config", type=int, choices=sorted(CONFIGS), default=0,
+                        help="run one config (default: all)")
+    parser.add_argument("--smoke", action="store_true",
+                        help="shrink every config ~1000x (correctness/CI)")
+    args = parser.parse_args()
+    if args.smoke:
+        global SCALE
+        SCALE = 0.001
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    for n in sorted(CONFIGS) if args.config == 0 else [args.config]:
+        CONFIGS[n]()
+
+
+if __name__ == "__main__":
+    main()

@@ -133,6 +133,12 @@ class EtcdClient:
             if observed_index is not None and observed_index != self._endpoint_idx:
                 return  # another thread already rotated away
             self._retired_channels.append(self._channel)
+            # Bound the retirement list: only the most recent retirees
+            # can still carry another thread's live stream; older ones
+            # closed their streams rotations ago — close them now or a
+            # long outage leaks a channel per backoff cycle.
+            while len(self._retired_channels) > 2:
+                self._retired_channels.pop(0).close()
             self._endpoint_idx = (self._endpoint_idx + 1) % len(self.endpoints)
             self._connect()
 

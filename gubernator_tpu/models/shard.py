@@ -254,6 +254,9 @@ class ColumnsHandle:
             "remaining": remaining,
             "reset_time": reset,
         }
+        # Drop the closure: it pins the planner (C++ batch + key
+        # buffer), the device output array, and the padded columns.
+        self._resolve_fn = None
         self.done = True
 
     def result(self) -> dict:
@@ -576,6 +579,8 @@ class ShardStore:
 
     def _drain_until(self, handle: "ColumnsHandle") -> None:
         with self._lock:
+            if handle.done:
+                return  # a concurrent drain already resolved it
             while self._inflight:
                 h = self._inflight.popleft()
                 h._do_resolve()

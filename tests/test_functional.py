@@ -341,3 +341,57 @@ def test_health_check_unhealthy_on_peer_failure(cluster, clock):
         GetRateLimitsRequest(requests=[mk("test_health", key, limit=5)])
     )
     assert resp.responses[0].error == ""
+
+
+def test_change_limit_over_http(cluster):
+    """Dynamic config change on a live limit (TestChangeLimit,
+    functional_test.go:548-641): raising/lowering the limit adjusts
+    remaining by the delta; the algorithm can be switched mid-stream
+    (which resets the bucket)."""
+    client = client_for(cluster)
+
+    def hit(limit, algo=Algorithm.TOKEN_BUCKET):
+        resp = client.get_rate_limits(
+            GetRateLimitsRequest(
+                requests=[mk("test_change_limit", "acct:9", limit=limit, algo=algo)]
+            )
+        )
+        rl = resp.responses[0]
+        assert rl.error == ""
+        return rl
+
+    r = hit(10)
+    assert (r.status, r.remaining, r.limit) == (Status.UNDER_LIMIT, 9, 10)
+    # Lower the limit: remaining += (5 - 10) -> 4 - 1 hit = wait,
+    # delta applies pre-hit: 9 + (5-10) = 4, then this hit -> 3.
+    r = hit(5)
+    assert (r.status, r.remaining, r.limit) == (Status.UNDER_LIMIT, 3, 5)
+    # Raise the limit: 3 + (50-5) = 48, hit -> 47.
+    r = hit(50)
+    assert (r.status, r.remaining, r.limit) == (Status.UNDER_LIMIT, 47, 50)
+    # Switch the algorithm: bucket resets (algorithms.go:54-62).
+    r = hit(3, algo=Algorithm.LEAKY_BUCKET)
+    assert (r.status, r.remaining, r.limit) == (Status.UNDER_LIMIT, 2, 3)
+
+
+def test_reset_remaining_over_http(cluster):
+    """RESET_REMAINING refills a drained bucket (functional_test.go:643-713)."""
+    client = client_for(cluster)
+
+    def hit(hits=1, behavior=0):
+        resp = client.get_rate_limits(
+            GetRateLimitsRequest(
+                requests=[
+                    mk("test_reset_remaining", "acct:77", hits=hits, limit=3,
+                       behavior=behavior)
+                ]
+            )
+        )
+        return resp.responses[0]
+
+    assert hit(hits=3).remaining == 0
+    assert hit().status == Status.OVER_LIMIT
+    r = hit(hits=0, behavior=Behavior.RESET_REMAINING)
+    assert r.error == ""
+    r = hit()
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 2)
