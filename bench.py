@@ -71,6 +71,17 @@ def main():
     dt = time.perf_counter() - t0
     columnar_cps = batch_size * iters / dt
 
+    # Sequential (non-pipelined) dispatch -> own-result round trips:
+    # the latency one batch actually experiences.  Median of a few
+    # samples — too few for a meaningful p99.
+    lat = []
+    for i in range(5):
+        t_b = time.perf_counter()
+        dispatch(100 + i).result()
+        lat.append(time.perf_counter() - t_b)
+    lat.sort()
+    batch_latency_ms = lat[len(lat) // 2] * 1000.0
+
     # ---- secondary: request-object path ------------------------------
     def make_batch(salt):
         return [
@@ -105,6 +116,7 @@ def main():
                 "vs_baseline": round(value / baseline, 2),
                 "object_path_checks_per_sec": round(object_cps, 1),
                 "batch_size": batch_size,
+                "batch_latency_ms_median": round(batch_latency_ms, 2),
             }
         )
     )

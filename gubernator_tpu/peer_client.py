@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import http.client
 import json
-import queue
 import ssl
 import threading
 import time
@@ -28,6 +27,7 @@ import grpc
 
 from . import wire
 from .config import BehaviorConfig
+from .utils.batch_window import BatchWindow
 from .proto import PEERS_V1_SERVICE
 from .proto import peers_pb2 as peers_pb
 from .types import (
@@ -91,12 +91,16 @@ class PeerClient:
         self._channel: Optional[grpc.Channel] = None
         self._rpc_get_peer_rate_limits = None
         self._rpc_update_peer_globals = None
-        self._queue: "queue.Queue[Tuple[RateLimitRequest, Future]]" = queue.Queue()
         self._shutdown = threading.Event()
         self._err_lock = threading.Lock()
         self._last_err: Dict[str, float] = {}  # message -> expiry timestamp
-        self._worker: Optional[threading.Thread] = None
-        self._worker_lock = threading.Lock()
+        # Lazy worker: idle peers (never forwarded to) spawn no thread.
+        self._window = BatchWindow(
+            self._send_batch,
+            self.behaviors.batch_wait_s,
+            self.behaviors.batch_limit,
+            lazy=True,
+        )
 
     # ------------------------------------------------------------------
     def get_peer_rate_limit(
@@ -111,16 +115,19 @@ class PeerClient:
             return resp.responses[0]
         if self._shutdown.is_set():
             raise PeerError(ERR_CLOSING, not_ready=True)
-        self._ensure_worker()
         fut: Future = Future()
-        self._queue.put((req, fut))
+        self._window.submit((req, fut))
         timeout = timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
         return fut.result(timeout=timeout + 1.0)
 
     def get_peer_rate_limits(
-        self, req: GetRateLimitsRequest, timeout_s: Optional[float] = None
+        self, req: GetRateLimitsRequest, timeout_s: Optional[float] = None,
+        _draining: bool = False,
     ) -> GetRateLimitsResponse:
-        """Owner-authoritative batch (PeersV1.GetPeerRateLimits)."""
+        """Owner-authoritative batch (PeersV1.GetPeerRateLimits).
+        `_draining` lets the shutdown drain flush already-queued
+        requests through the still-open connection
+        (peer_client.go:351-385) after new requests are refused."""
         if self.transport == "http":
             body = self._post("/v1/peer.GetPeerRateLimits", req.to_json(), timeout_s)
             resp = GetRateLimitsResponse.from_json(
@@ -131,6 +138,7 @@ class PeerClient:
                 "GetPeerRateLimits",
                 wire.peer_rate_limits_req_to_pb(req),
                 timeout_s,
+                allow_closing=_draining,
             )
             resp = wire.peer_rate_limits_resp_from_pb(m)
         if len(resp.responses) != len(req.requests):
@@ -150,48 +158,13 @@ class PeerClient:
             )
 
     # ------------------------------------------------------------------
-    def _ensure_worker(self) -> None:
-        with self._worker_lock:
-            if self._worker is None or not self._worker.is_alive():
-                self._worker = threading.Thread(target=self._run, daemon=True)
-                self._worker.start()
-
-    def _run(self) -> None:
-        """Batch loop (peer_client.go:272-312): first enqueue opens a
-        BatchWait window; flush on BatchLimit or window close."""
-        b = self.behaviors
-        while not self._shutdown.is_set():
-            try:
-                first = self._queue.get(timeout=0.05)
-            except queue.Empty:
-                continue
-            batch = [first]
-            deadline = time.monotonic() + b.batch_wait_s
-            while len(batch) < b.batch_limit:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                try:
-                    batch.append(self._queue.get(timeout=remaining))
-                except queue.Empty:
-                    break
-            self._send_batch(batch)
-        # Drain anything left after shutdown was requested.
-        leftovers = []
-        while True:
-            try:
-                leftovers.append(self._queue.get_nowait())
-            except queue.Empty:
-                break
-        if leftovers:
-            self._send_batch(leftovers)
-
     def _send_batch(self, batch: List[Tuple[RateLimitRequest, Future]]) -> None:
         """peer_client.go:316-348 sendQueue."""
         try:
             resp = self.get_peer_rate_limits(
                 GetRateLimitsRequest(requests=[r for r, _ in batch]),
                 timeout_s=self.behaviors.batch_timeout_s,
+                _draining=True,
             )
         except Exception as e:  # noqa: BLE001
             for _, fut in batch:
@@ -233,8 +206,9 @@ class PeerClient:
                 )
             return self._rpc_get_peer_rate_limits, self._rpc_update_peer_globals
 
-    def _grpc_call(self, method: str, request, timeout_s: Optional[float]):
-        if self._shutdown.is_set():
+    def _grpc_call(self, method: str, request, timeout_s: Optional[float],
+                   allow_closing: bool = False):
+        if self._shutdown.is_set() and not allow_closing:
             raise PeerError(ERR_CLOSING, not_ready=True)
         get_rl, update_g = self._ensure_channel()
         rpc = get_rl if method == "GetPeerRateLimits" else update_g
@@ -326,9 +300,7 @@ class PeerClient:
     def shutdown(self, timeout_s: float = 5.0) -> None:
         """Drain in-flight batches, then close (peer_client.go:351-385)."""
         self._shutdown.set()
-        worker = self._worker
-        if worker is not None and worker.is_alive():
-            worker.join(timeout=timeout_s)
+        self._window.stop(timeout_s=timeout_s)
         with self._conn_lock:
             self._reset_conn()
             if self._channel is not None:

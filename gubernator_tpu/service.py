@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Sequence
 
@@ -35,11 +35,13 @@ from .types import (
     has_behavior,
     set_behavior,
 )
+from .utils.batch_window import BatchWindow
 from .utils.clock import DEFAULT_CLOCK, Clock
 from .utils.interval import Interval
 
 HEALTHY = "healthy"
 UNHEALTHY = "unhealthy"
+ERR_BATCHER_CLOSED = "local batcher is closed"
 
 
 class ApiError(Exception):
@@ -78,6 +80,53 @@ class ServiceConfig:
     peer_channel_credentials: object = None
 
 
+class LocalBatcher:
+    """Ingress batching window for owner-local evaluation.
+
+    The reference's BATCHING coalesces only peer-FORWARDED requests
+    (peer_client.go:272-312); locally-owned keys take the mutex+map
+    path, which is cheap there.  Here every local evaluation is a
+    device dispatch, so concurrent client requests inside one BatchWait
+    window coalesce into ONE `store.apply` call — same knobs
+    (batch_wait/batch_limit, config.go:107-109), same defeat-the-
+    thundering-herd purpose, applied at the ingress edge.  Requests
+    flagged NO_BATCHING bypass the window (proto/gubernator.proto:74-78
+    semantics)."""
+
+    def __init__(self, store, behaviors: BehaviorConfig, clock: Clock):
+        self.store = store
+        self.clock = clock
+        self._window = BatchWindow(
+            self._flush, behaviors.batch_wait_s, behaviors.batch_limit
+        )
+
+    def submit(self, req: RateLimitRequest) -> "Future":
+        fut: Future = Future()
+        if self._window.stopped:
+            fut.set_exception(PeerError(ERR_BATCHER_CLOSED))
+            return fut
+        # A submit racing past the stopped check is still safe: stop()
+        # drains and flushes the queue after joining the worker.
+        self._window.submit((req, fut))
+        return fut
+
+    def _flush(self, batch) -> None:
+        try:
+            resps = self.store.apply(
+                [r for r, _ in batch], self.clock.now_ms()
+            )
+            for (_, fut), resp in zip(batch, resps):
+                if not fut.done():
+                    fut.set_result(resp)
+        except Exception as e:  # noqa: BLE001
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+
+    def stop(self) -> None:
+        self._window.stop()
+
+
 class V1Service:
     def __init__(self, conf: ServiceConfig):
         self.conf = conf
@@ -100,6 +149,7 @@ class V1Service:
             for item in conf.loader.load():
                 self.store.load_item(item)
 
+        self.local_batcher = LocalBatcher(self.store, conf.behaviors, self.clock)
         self.global_mgr = GlobalManager(self)
         self.multi_region_mgr = MultiRegionManager(self)
 
@@ -182,9 +232,31 @@ class V1Service:
         now = self.clock.now_ms()
 
         if local:
-            resps = self.store.apply([requests[i] for i in local], now)
-            for i, resp in zip(local, resps):
-                out[i] = resp
+            # Whole-batch requests evaluate directly (they ARE the
+            # batch); single-item requests with BATCHING ride the
+            # ingress window so concurrent clients share one dispatch.
+            local_reqs = [requests[i] for i in local]
+            if len(local_reqs) > 1 or any(
+                has_behavior(r.behavior, Behavior.NO_BATCHING) for r in local_reqs
+            ):
+                resps = self.store.apply(local_reqs, now)
+                for i, resp in zip(local, resps):
+                    out[i] = resp
+            else:
+                futs = [(i, self.local_batcher.submit(r)) for i, r in zip(local, local_reqs)]
+                for i, fut in futs:
+                    # Per-item error conversion, like the forward path
+                    # (_forward_one): a batcher failure must not 500 the
+                    # whole GetRateLimits call.
+                    try:
+                        out[i] = fut.result(
+                            timeout=self.conf.behaviors.batch_timeout_s + 1.0
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        key = requests[i].hash_key()
+                        out[i] = RateLimitResponse(
+                            error=f"while applying rate limit '{key}' - '{e}'"
+                        )
         if global_remote:
             resps = self.store.apply(
                 [requests[i] for i in global_remote], now, remote_global=True
@@ -348,6 +420,7 @@ class V1Service:
         if self._closed:
             return
         self._closed = True
+        self.local_batcher.stop()
         self.global_mgr.stop()
         self.multi_region_mgr.stop()
         self._forward_pool.shutdown(wait=False)

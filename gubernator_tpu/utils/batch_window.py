@@ -1,0 +1,88 @@
+"""Shared batching-window worker (the reference's Interval-drained
+queue shape, peer_client.go:272-312): the first enqueued item opens a
+`wait_s` window; the batch flushes when `limit` items collect or the
+window closes.  Used by the peer-forward client (PeerClient) and the
+ingress-local coalescer (service.LocalBatcher) so the drain semantics
+live in exactly one place.
+
+`stop()` joins the worker FIRST and then drains + flushes anything
+still queued — including items that raced past a closing check into
+the queue — so no submitted item is ever silently dropped.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from queue import Empty, Queue
+from typing import Callable, List
+
+
+class BatchWindow:
+    def __init__(
+        self,
+        flush: Callable[[List], None],
+        wait_s: float,
+        limit: int,
+        lazy: bool = False,
+    ):
+        self._flush = flush
+        self.wait_s = wait_s
+        self.limit = limit
+        self._queue: "Queue" = Queue()
+        self._stopped = threading.Event()
+        self._worker: "threading.Thread | None" = None
+        self._worker_lock = threading.Lock()
+        if not lazy:
+            self._ensure_worker()
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped.is_set()
+
+    def submit(self, item) -> None:
+        """Enqueue one item.  Items enqueued before (or racing with)
+        stop() are still flushed by the stop-side drain."""
+        self._ensure_worker()
+        self._queue.put(item)
+
+    def _ensure_worker(self) -> None:
+        if self._stopped.is_set():
+            return
+        with self._worker_lock:
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(target=self._run, daemon=True)
+                self._worker.start()
+
+    def _run(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                first = self._queue.get(timeout=0.05)
+            except Empty:
+                continue
+            batch = [first]
+            deadline = time.monotonic() + self.wait_s
+            while len(batch) < self.limit:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._queue.get(timeout=remaining))
+                except Empty:
+                    break
+            self._flush(batch)
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        """Stop the worker, then drain-and-flush every leftover item."""
+        self._stopped.set()
+        worker = self._worker
+        if worker is not None and worker.is_alive():
+            worker.join(timeout=timeout_s)
+        leftovers = []
+        while True:
+            try:
+                leftovers.append(self._queue.get_nowait())
+            except Empty:
+                break
+        if leftovers:
+            self._flush(leftovers)

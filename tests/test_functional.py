@@ -395,3 +395,59 @@ def test_reset_remaining_over_http(cluster):
     assert r.error == ""
     r = hit()
     assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 2)
+
+
+def test_ingress_batching_coalesces_concurrent_requests():
+    """Concurrent single-item client requests on one daemon must
+    coalesce into fewer device dispatches (the ingress BatchWait
+    window) while preserving sequential per-key semantics."""
+    import threading
+
+    from gubernator_tpu.config import BehaviorConfig, DaemonConfig
+    from gubernator_tpu.daemon import spawn_daemon
+
+    d = spawn_daemon(
+        DaemonConfig(
+            listen_address="127.0.0.1:0",
+            cache_size=1024,
+            behaviors=BehaviorConfig(batch_wait_s=0.02),  # wide window
+        )
+    )
+    try:
+        store = d.service.store
+        calls = []
+        orig_apply = store.apply
+
+        def counting_apply(reqs, now, **kw):
+            calls.append(len(reqs))
+            return orig_apply(reqs, now, **kw)
+
+        store.apply = counting_apply
+        client = V1Client(d.gateway.address)
+        results = []
+        lock = threading.Lock()
+
+        def one():
+            r = client.get_rate_limits(
+                GetRateLimitsRequest(
+                    requests=[mk("ingress_batch", "same_key", limit=100)]
+                )
+            ).responses[0]
+            with lock:
+                results.append(r)
+
+        threads = [threading.Thread(target=one) for _ in range(20)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(results) == 20
+        assert all(r.error == "" for r in results)
+        # Sequential semantics: 20 hits on one key -> 20 distinct
+        # remaining values 99..80, regardless of coalescing.
+        assert sorted(r.remaining for r in results) == list(range(80, 100))
+        # Coalescing happened: fewer dispatches than requests.
+        batched = [c for c in calls if c > 1]
+        assert batched, f"no coalesced dispatch observed: {calls}"
+    finally:
+        d.close()

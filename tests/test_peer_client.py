@@ -1,0 +1,109 @@
+"""PeerClient concurrency/shutdown tests (reference
+peer_client_test.go:15-85): many threads issue requests through one
+client with each behavior while it is shut down mid-flight; every
+request must either succeed or fail with the closing error — never
+hang, never crash.
+"""
+
+import threading
+import time
+
+import pytest
+
+from gubernator_tpu.config import BehaviorConfig, DaemonConfig
+from gubernator_tpu.daemon import spawn_daemon
+from gubernator_tpu.peer_client import ERR_CLOSING, PeerClient, PeerError
+from gubernator_tpu.types import Behavior, PeerInfo, RateLimitRequest
+
+
+@pytest.fixture(scope="module")
+def daemon():
+    d = spawn_daemon(
+        DaemonConfig(
+            listen_address="127.0.0.1:0",
+            grpc_listen_address="127.0.0.1:0",
+            cache_size=4096,
+            behaviors=BehaviorConfig(batch_wait_s=0.001),
+        )
+    )
+    yield d
+    d.close()
+
+
+@pytest.mark.parametrize(
+    "behavior", [Behavior.BATCHING, Behavior.NO_BATCHING, Behavior.GLOBAL]
+)
+def test_concurrent_requests_during_shutdown(daemon, behavior):
+    client = PeerClient(
+        PeerInfo(grpc_address=daemon.grpc.address), BehaviorConfig(batch_wait_s=0.001)
+    )
+    errors = []
+    ok = []
+    lock = threading.Lock()
+
+    def worker(n):
+        for i in range(10):
+            req = RateLimitRequest(
+                name="pc_test", unique_key=f"k{n}", hits=1, limit=1_000_000,
+                duration=60_000, behavior=behavior,
+            )
+            try:
+                r = client.get_peer_rate_limit(req)
+                with lock:
+                    ok.append(r)
+            except PeerError as e:
+                with lock:
+                    errors.append(str(e))
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(f"UNEXPECTED {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(n,)) for n in range(10)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)  # past the lazy connect, into the request stream
+    client.shutdown()  # mid-flight
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), "worker hung after shutdown"
+    # Every outcome is either a success or the closing error; in-flight
+    # batches were drained, not dropped (peer_client.go:351-385).
+    assert ok, "no request completed before shutdown"
+    for e in errors:
+        assert ERR_CLOSING in e or "failed" in e, e
+
+
+def test_shutdown_drains_queued_batch(daemon):
+    """Requests already queued when shutdown starts still get answers
+    (the drain leg of peer_client.go:351-385)."""
+    client = PeerClient(
+        PeerInfo(grpc_address=daemon.grpc.address),
+        BehaviorConfig(batch_wait_s=0.05),  # wide window: requests queue up
+    )
+    results = []
+
+    def one(i):
+        try:
+            results.append(
+                client.get_peer_rate_limit(
+                    RateLimitRequest(
+                        name="pc_drain", unique_key=f"d{i}", hits=1,
+                        limit=10, duration=60_000,
+                    )
+                )
+            )
+        except PeerError:
+            results.append(None)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(5)]
+    for t in threads:
+        t.start()
+    time.sleep(0.005)  # let them enqueue inside the batch window
+    client.shutdown()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    answered = [r for r in results if r is not None]
+    assert answered, "queued batch was dropped instead of drained"
+    for r in answered:
+        assert r.remaining == 9
