@@ -130,6 +130,112 @@ def prepare_requests(
     return prepared
 
 
+def plan_grouped_python(table, prepared: Sequence[_Prepared], now_ms: int):
+    """Full-plan twin of the C++ gt_batch_plan_grouped over a Python
+    SlotTable: uniform duplicate groups (same key, identical config, no
+    RESET_REMAINING) collapse into round 0 with per-lane occurrence
+    indices and a single scattering (write) lane; everything else takes
+    the round scheme from round 1 with the same chaining/deferral rules
+    as RoundPlanner.  Mutates each _Prepared's slot/exists; returns
+    (round_id, occ, write, n_rounds) arrays aligned to `prepared`.
+
+    Used by the mesh store's fused dispatch: ALL rounds of ALL shards
+    run inside one jitted program instead of one dispatch per round.
+    """
+    n = len(prepared)
+    round_id = np.zeros(n, dtype=np.int32)
+    occ = np.zeros(n, dtype=np.int32)
+    write = np.zeros(n, dtype=bool)
+
+    groups: "Dict[str, List[int]]" = {}
+    for j, p in enumerate(prepared):
+        if p.cached_hint:
+            # Replica-cache lane: no local state touched; hits
+            # accumulate by scatter-add, so no round/uniqueness rules.
+            p.slot, p.exists, p.resolved = -1, False, True
+            continue
+        groups.setdefault(p.key, []).append(j)
+
+    used0: set = set()
+    slow: List[int] = []
+    # Last key to write each slot in scheduled device order: round-0
+    # groups seed it; slow lanes consult it for BOTH exists-chaining
+    # and slot-takeover detection.
+    slot_owner: Dict[int, str] = {}
+    for key, lanes in groups.items():
+        f = prepared[lanes[0]]
+        uniform = not has_behavior(f.req.behavior, Behavior.RESET_REMAINING)
+        for j in lanes[1:]:
+            if not uniform:
+                break
+            q = prepared[j]
+            uniform = (
+                q.req.algorithm == f.req.algorithm
+                and q.req.behavior == f.req.behavior
+                and q.req.hits == f.req.hits
+                and q.req.limit == f.req.limit
+                and q.req.duration == f.req.duration
+                and q.greg_expire == f.greg_expire
+                and q.greg_duration == f.greg_duration
+            )
+        ev_before = table.evictions
+        slot, exists = table.lookup_or_assign(key, now_ms)
+        evicted = table.evictions != ev_before
+        for j in lanes:
+            prepared[j].slot = slot
+            prepared[j].exists = exists
+            prepared[j].resolved = True
+        # An eviction may have stolen a slot from a key with earlier
+        # lanes in this batch; the slow path's deferral orders it.
+        if uniform and not evicted and slot not in used0:
+            used0.add(slot)
+            slot_owner[slot] = key
+            for o, j in enumerate(lanes):
+                occ[j] = o
+                write[j] = o + 1 == len(lanes)
+        else:
+            slow.extend(lanes)
+
+    if not slow:
+        return round_id, occ, write, 1
+
+    slow.sort()
+    rnd = 1
+    pending = slow
+    while pending:
+        seen: set = set()
+        used: set = set()
+        deferred: List[int] = []
+        for j in pending:
+            p = prepared[j]
+            if p.key in seen:
+                deferred.append(j)
+                continue
+            owner = slot_owner.get(p.slot)
+            if owner is not None and owner != p.key:
+                # The captured slot was taken over by ANOTHER key's
+                # create (mid-batch eviction) scheduled before this
+                # lane.  Running here — with either exists value —
+                # would corrupt the new owner's device state.
+                # Re-resolve: the table no longer maps this key, so it
+                # gets a fresh slot (or evicts a different one).
+                p.slot, p.exists = table.lookup_or_assign(p.key, now_ms)
+            if p.slot in used:  # eviction collision: defer as-is
+                deferred.append(j)
+                seen.add(p.key)
+                continue
+            round_id[j] = rnd
+            write[j] = True
+            if slot_owner.get(p.slot) == p.key:
+                p.exists = True  # chained: device state authoritative
+            slot_owner[p.slot] = p.key
+            seen.add(p.key)
+            used.add(p.slot)
+        pending = deferred
+        rnd += 1
+    return round_id, occ, write, rnd
+
+
 class RoundPlanner:
     """Splits a prepared request stream into kernel rounds.
 

@@ -94,7 +94,29 @@ class SlotTable:
             if slot < 0:
                 continue
             if keys is not None and self._slot_to_key[slot] != keys[i]:
-                continue  # slot remapped mid-batch; this lane is stale
+                if self._slot_to_key[slot] is None and not rm:
+                    # Remove-then-recreate chain: an earlier lane's
+                    # RESET_REMAINING freed the slot and a later round
+                    # recreated this key on device — re-map it (the C++
+                    # twin does the same, gt_batch_commit_plan).
+                    if keys[i] in self._key_to_slot:
+                        continue  # key meanwhile mapped elsewhere
+                    self._key_to_slot[keys[i]] = slot
+                    self._slot_to_key[slot] = keys[i]
+                    self.expire_ms[slot] = exp
+                    # The slot was appended to _free by this very
+                    # commit loop's remove leg — O(1) pop from the end
+                    # in the common case, cold linear scan otherwise.
+                    if self._free and self._free[-1] == slot:
+                        self._free.pop()
+                    else:
+                        try:
+                            self._free.remove(slot)
+                        except ValueError:
+                            pass
+                    self._lru[slot] = None
+                    self._lru.move_to_end(slot)
+                continue  # otherwise: slot remapped mid-batch; lane is stale
             if rm:
                 self.remove_slot(slot)
             else:

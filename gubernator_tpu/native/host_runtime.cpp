@@ -363,10 +363,9 @@ void gt_batch_commit_round(void* bv, const int64_t* new_expire,
 // LRU eviction of a live item).
 static int64_t plan_rounds(Batch* b, int64_t round, int32_t* round_id,
                            int32_t* slots, uint8_t* exists, int32_t* occ,
-                           uint8_t* write) {
+                           uint8_t* write,
+                           std::unordered_map<int32_t, std::string_view>& slot_owner) {
   Table* t = b->table;
-  std::unordered_map<std::string_view, int32_t> emitted;
-  emitted.reserve(b->pending.size() * 2);
   while (!b->pending.empty()) {
     std::unordered_map<std::string_view, int> seen_keys;
     std::unordered_map<int32_t, int> used_slots;
@@ -385,6 +384,16 @@ static int64_t plan_rounds(Batch* b, int64_t round, int32_t* round_id,
         b->exists[i] = e ? 1 : 0;
         b->resolved[i] = 1;
       }
+      // Slot takeover: a DIFFERENT key's create (mid-batch eviction)
+      // is already scheduled on this lane's captured slot — running
+      // here would corrupt the new owner's device state.  Re-resolve:
+      // this key is no longer mapped, so it gets a fresh slot.
+      auto so = slot_owner.find(b->slot[i]);
+      if (so != slot_owner.end() && so->second != k) {
+        auto [s, e] = t->lookup_or_assign(b->key_ptr(i), b->key_len(i), b->now_ms);
+        b->slot[i] = s;
+        b->exists[i] = e ? 1 : 0;
+      }
       if (used_slots.count(b->slot[i])) {  // eviction collision: defer as-is
         deferred.push_back(i);
         seen_keys.emplace(k, 1);
@@ -394,14 +403,14 @@ static int64_t plan_rounds(Batch* b, int64_t round, int32_t* round_id,
       slots[i] = b->slot[i];
       if (occ != nullptr) occ[i] = 0;
       if (write != nullptr) write[i] = 1;
-      auto em = emitted.find(k);
-      exists[i] = (em != emitted.end() && em->second == b->slot[i])
-                      ? 1
+      so = slot_owner.find(b->slot[i]);
+      exists[i] = (so != slot_owner.end() && so->second == k)
+                      ? 1  // chained: device state authoritative
                       : b->exists[i];
       b->plan_order.push_back(i);
       ++t->pending_write[b->slot[i]];
       seen_keys.emplace(k, 1);
-      emitted.emplace(k, b->slot[i]);
+      slot_owner[b->slot[i]] = k;
       used_slots.emplace(b->slot[i], 1);
     }
     b->pending.swap(deferred);
@@ -415,7 +424,10 @@ int64_t gt_batch_plan(void* bv, int32_t* round_id, int32_t* slots,
   Batch* b = (Batch*)bv;
   b->plan_order.clear();
   b->plan_order.reserve((size_t)b->n);
-  return plan_rounds(b, 0, round_id, slots, exists, nullptr, nullptr);
+  std::unordered_map<int32_t, std::string_view> slot_owner;
+  slot_owner.reserve((size_t)b->n * 2);
+  return plan_rounds(b, 0, round_id, slots, exists, nullptr, nullptr,
+                     slot_owner);
 }
 
 // Fold the planned batch's kernel outputs (indexed by ORIGINAL lane)
@@ -512,6 +524,10 @@ int64_t gt_batch_plan_grouped(void* bv, const int32_t* algo,
 
   std::unordered_map<int32_t, int> used0;  // slots written in round 0
   used0.reserve(groups.size() * 2);
+  // Seed the slot-owner map with round-0 groups so slow lanes detect
+  // takeovers of (and chain onto) grouped slots.
+  std::unordered_map<int32_t, std::string_view> slot_owner;
+  slot_owner.reserve((size_t)b->n * 2);
   std::vector<int32_t> slow;  // lanes for the round scheme
   for (auto& g : groups) {
     int32_t first = g[0];
@@ -536,6 +552,7 @@ int64_t gt_batch_plan_grouped(void* bv, const int32_t* algo,
     bool evicted = t->evictions != ev_before;
     if (uniform && !evicted && !used0.count(s)) {
       used0.emplace(s, 1);
+      slot_owner[s] = std::string_view(b->key_ptr(first), b->key_len(first));
       ++t->pending_write[s];
       for (size_t j = 0; j < g.size(); ++j) {
         int32_t i = g[j];
@@ -557,7 +574,7 @@ int64_t gt_batch_plan_grouped(void* bv, const int32_t* algo,
   // grouped dispatch).  Same chaining/deferral rules as gt_batch_plan.
   std::sort(slow.begin(), slow.end());
   b->pending.assign(slow.begin(), slow.end());
-  return plan_rounds(b, 1, round_id, slots, exists, occ, write);
+  return plan_rounds(b, 1, round_id, slots, exists, occ, write, slot_owner);
 }
 
 void gt_batch_free(void* bv) {

@@ -42,6 +42,7 @@ from ..models.shard import (
     item_to_rows,
     make_store_resolver,
     pad_size,
+    plan_grouped_python,
     prepare_requests,
 )
 from ..models.slot_table import SlotTable
@@ -90,6 +91,48 @@ def _answer_jit(state, gcols, batch, extra, now):
         return ns, ng, packed
 
     return jax.vmap(one)(state, gcols, batch, extra)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _answer_rounds_jit(state, gcols, batch, extra, round_id, n_rounds, now):
+    """Fused multi-round answer: ALL duplicate rounds of ALL shards run
+    inside one dispatch (`lax.while_loop` over rounds, like
+    buckets.apply_rounds), with the same packed i64[S, 5, B] output as
+    _answer_jit.  One device round-trip per batch regardless of key
+    multiplicity — the thundering-herd case costs the same dispatch as
+    a uniform batch.  `n_rounds` is a traced scalar: one compilation
+    serves every round count at a given batch width."""
+
+    def one(state_s, gcols_s, batch_s, extra_s, rid_s):
+        B = batch_s.slot.shape[0]
+        packed0 = jnp.zeros((5, B), jnp.int64)
+
+        def cond(c):
+            return c[0] < n_rounds
+
+        def body(c):
+            r, st, gc, packed = c
+            active = rid_s == r
+            b_r = batch_s._replace(slot=jnp.where(active, batch_s.slot, -1))
+            e_r = extra_s._replace(gslot=jnp.where(active, extra_s.gslot, -1))
+            st, gc, out, cached = global_ops.answer_batch(st, gc, b_r, e_r, now)
+            row0 = (
+                out.status.astype(jnp.int64)
+                | (out.removed.astype(jnp.int64) << 1)
+                | (cached.astype(jnp.int64) << 2)
+            )
+            newp = jnp.stack(
+                (row0, out.limit, out.remaining, out.reset_time, out.new_expire)
+            )
+            packed = jnp.where(active[None, :], newp, packed)
+            return r + 1, st, gc, packed
+
+        _, st, gc, packed = jax.lax.while_loop(
+            cond, body, (jnp.asarray(0, jnp.int32), state_s, gcols_s, packed0)
+        )
+        return st, gc, packed
+
+    return jax.vmap(one)(state, gcols, batch, extra, round_id)
 
 
 @partial(jax.jit, donate_argnums=0)
@@ -240,6 +283,7 @@ class MeshBucketStore:
         # store/daemon in a process shares one XLA compilation cache —
         # per-instance closures would recompile everything per daemon.
         self._answer_fn = _answer_jit
+        self._answer_rounds_fn = _answer_rounds_jit
         self._sync_fn = _get_sync_fn(self.mesh, self.axis)
         self._set_replica_fn = _set_replica_jit
         self._clear_fn = _clear_jit
@@ -295,22 +339,109 @@ class MeshBucketStore:
                     self.dirty[owner, g] = True
             by_shard[target].append(p)
 
-        planners = [
-            RoundPlanner(
-                self.tables[s],
-                by_shard[s],
-                now_ms,
-                resolver=self._store_resolver(s, now_ms) if self.store is not None else None,
-            )
-            for s in range(self.n_shards)
-        ]
-        while True:
-            chunks = [pl.next_chunk() for pl in planners]
-            if not any(chunks):
-                break
-            self._run_round(chunks, now_ms, responses)
+        if self.store is None:
+            self._apply_fused(by_shard, now_ms, responses)
+        else:
+            # Store SPI needs per-round host callbacks (get/on_change
+            # between rounds), so it keeps the interleaved loop.
+            planners = [
+                RoundPlanner(
+                    self.tables[s],
+                    by_shard[s],
+                    now_ms,
+                    resolver=self._store_resolver(s, now_ms),
+                )
+                for s in range(self.n_shards)
+            ]
+            while True:
+                chunks = [pl.next_chunk() for pl in planners]
+                if not any(chunks):
+                    break
+                self._run_round(chunks, now_ms, responses)
 
         return [r if r is not None else RateLimitResponse() for r in responses]
+
+    # ------------------------------------------------------------------
+    def _apply_fused(self, by_shard, now_ms: int, responses) -> None:
+        """One dispatch for the whole batch: every shard's rounds run
+        inside _answer_rounds_jit; one packed readback; one commit."""
+        if not any(by_shard):
+            return  # every request failed validation: nothing to dispatch
+        S = self.n_shards
+        plans = []
+        n_rounds = 1
+        maxb = 1
+        for s in range(S):
+            rid, occ, wr, nr = plan_grouped_python(
+                self.tables[s], by_shard[s], now_ms
+            )
+            plans.append((rid, occ, wr))
+            n_rounds = max(n_rounds, nr)
+            maxb = max(maxb, len(by_shard[s]))
+        padded = pad_size(maxb)
+        cols = [build_round_arrays(by_shard[s], padded) for s in range(S)]
+        stacked = [np.stack([c[f] for c in cols]) for f in range(9)]
+        rid_a = np.zeros((S, padded), np.int32)
+        occ_a = np.zeros((S, padded), np.int32)
+        wr_a = np.zeros((S, padded), dtype=bool)
+        gslot = np.full((S, padded), -1, dtype=np.int32)
+        for s in range(S):
+            m = len(by_shard[s])
+            if not m:
+                continue
+            rid, occ, wr = plans[s]
+            rid_a[s, :m] = rid
+            occ_a[s, :m] = occ
+            wr_a[s, :m] = wr
+            for i, p in enumerate(by_shard[s]):
+                gslot[s, i] = p.gslot
+
+        batch = buckets.RequestBatch(
+            *[jnp.asarray(a) for a in stacked],
+            occ=jnp.asarray(occ_a),
+            write=jnp.asarray(wr_a),
+        )
+        batch = jax.tree.map(lambda c: jax.device_put(c, self._sharding), batch)
+        extra = global_ops.GlobalBatchExtra(
+            gslot=jax.device_put(jnp.asarray(gslot), self._sharding)
+        )
+        rid_dev = jax.device_put(jnp.asarray(rid_a), self._sharding)
+
+        self.state, self.gcols, packed = self._answer_rounds_fn(
+            self.state, self.gcols, batch, extra, rid_dev, n_rounds, now_ms
+        )
+
+        packed_np = np.asarray(packed)  # [S, 5, B] — the one blocking transfer
+        row0 = packed_np[:, 0]
+        out_status = (row0 & 1).astype(np.int32)
+        out_removed = ((row0 >> 1) & 1).astype(bool)
+        cached_np = ((row0 >> 2) & 1).astype(bool)
+        out_limit = packed_np[:, 1]
+        out_rem = packed_np[:, 2]
+        out_reset = packed_np[:, 3]
+        out_exp = packed_np[:, 4]
+
+        for s in range(S):
+            chunk = by_shard[s]
+            if not chunk:
+                continue
+            commit_slots, commit_exp, commit_rm, commit_keys = [], [], [], []
+            for i, p in enumerate(chunk):
+                # Only scattering lanes commit bookkeeping (grouped
+                # intermediates' new_expire is not the final state).
+                if wr_a[s, i] and not cached_np[s, i] and p.slot >= 0:
+                    commit_slots.append(p.slot)
+                    commit_exp.append(out_exp[s, i])
+                    commit_rm.append(out_removed[s, i])
+                    commit_keys.append(p.key)
+                    self.algo_mirror[s][p.slot] = int(p.req.algorithm)
+                responses[p.pos] = RateLimitResponse(
+                    status=int(out_status[s, i]),
+                    limit=int(out_limit[s, i]) if cached_np[s, i] else int(p.req.limit),
+                    remaining=int(out_rem[s, i]),
+                    reset_time=int(out_reset[s, i]),
+                )
+            self.tables[s].commit(commit_slots, commit_exp, commit_rm, keys=commit_keys)
 
     # ------------------------------------------------------------------
     def _run_round(self, chunks, now_ms: int, responses) -> None:

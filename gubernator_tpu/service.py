@@ -249,9 +249,11 @@ class V1Service:
                     # (_forward_one): a batcher failure must not 500 the
                     # whole GetRateLimits call.
                     try:
-                        out[i] = fut.result(
-                            timeout=self.conf.behaviors.batch_timeout_s + 1.0
-                        )
+                        # No timeout: the flush ALWAYS resolves every
+                        # future (result or exception), and a timeout
+                        # here would report an error for hits that the
+                        # late flush still applies device-side.
+                        out[i] = fut.result()
                     except Exception as e:  # noqa: BLE001
                         key = requests[i].hash_key()
                         out[i] = RateLimitResponse(

@@ -41,10 +41,13 @@ class BatchWindow:
         return self._stopped.is_set()
 
     def submit(self, item) -> None:
-        """Enqueue one item.  Items enqueued before (or racing with)
-        stop() are still flushed by the stop-side drain."""
+        """Enqueue one item.  Items enqueued before (or racing with, or
+        even after) stop() are still flushed: a post-stop submit drains
+        the queue itself, since no worker remains to do it."""
         self._ensure_worker()
         self._queue.put(item)
+        if self._stopped.is_set():
+            self._drain_flush()
 
     def _ensure_worker(self) -> None:
         if self._stopped.is_set():
@@ -78,6 +81,9 @@ class BatchWindow:
         worker = self._worker
         if worker is not None and worker.is_alive():
             worker.join(timeout=timeout_s)
+        self._drain_flush()
+
+    def _drain_flush(self) -> None:
         leftovers = []
         while True:
             try:

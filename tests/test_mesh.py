@@ -95,3 +95,46 @@ def test_mesh_scales_keyspace():
     assert store.size() == 1000
     per_shard = [len(t) for t in store.tables]
     assert min(per_shard) > 0
+
+
+def test_fused_duplicates_match_sequential():
+    """Hot-key duplicate batches through the fused mesh dispatch
+    (grouped round 0 + slow rounds in one program) must match applying
+    the same requests one at a time."""
+    import numpy as np
+
+    from gubernator_tpu.parallel.mesh import MeshBucketStore
+    from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest
+
+    rng = np.random.RandomState(9)
+    fused = MeshBucketStore(capacity_per_shard=128, g_capacity=32)
+    serial = MeshBucketStore(capacity_per_shard=128, g_capacity=32)
+    now = 1_700_000_000_000
+    for step in range(25):
+        reqs = []
+        # uniform hot group
+        for _ in range(rng.randint(1, 12)):
+            reqs.append(RateLimitRequest(
+                name="mf", unique_key="hot", hits=1, limit=9, duration=4_000,
+                algorithm=Algorithm.TOKEN_BUCKET,
+            ))
+        # non-uniform duplicates (slow path)
+        for _ in range(rng.randint(0, 6)):
+            reqs.append(RateLimitRequest(
+                name="mf", unique_key="mix", hits=int(rng.choice([1, 2])),
+                limit=7, duration=4_000, algorithm=Algorithm.LEAKY_BUCKET,
+            ))
+        # occasional RESET_REMAINING (excluded from grouping)
+        if rng.random() < 0.3:
+            reqs.append(RateLimitRequest(
+                name="mf", unique_key="hot", hits=1, limit=9, duration=4_000,
+                behavior=Behavior.RESET_REMAINING,
+            ))
+        rng.shuffle(reqs)
+        now += rng.randint(0, 900)
+        got = fused.apply(reqs, now)
+        want = [serial.apply([r], now)[0] for r in reqs]
+        for i, (g, w) in enumerate(zip(got, want)):
+            assert (g.status, g.remaining, g.reset_time) == (
+                w.status, w.remaining, w.reset_time,
+            ), (step, i, reqs[i], g, w)
