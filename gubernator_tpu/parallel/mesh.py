@@ -411,7 +411,17 @@ class MeshBucketStore:
             self.state, self.gcols, batch, extra, rid_dev, n_rounds, now_ms
         )
 
-        packed_np = np.asarray(packed)  # [S, 5, B] — the one blocking transfer
+        # Only scattering lanes commit bookkeeping (grouped
+        # intermediates' new_expire is not the final state).
+        self._decode_commit_respond(packed, by_shard, responses, write=wr_a)
+
+    def _decode_commit_respond(self, packed, chunks, responses, write=None) -> np.ndarray:
+        """Shared tail of both dispatch paths: decode the packed
+        [S, 5, B] device result, fill responses, and fold bookkeeping
+        back into the slot tables.  `write` masks which lanes commit
+        (None = every non-cached lane, the single-round case).  Returns
+        the cached mask for the Store-SPI caller."""
+        packed_np = np.asarray(packed)  # the one blocking transfer
         row0 = packed_np[:, 0]
         out_status = (row0 & 1).astype(np.int32)
         out_removed = ((row0 >> 1) & 1).astype(bool)
@@ -421,15 +431,13 @@ class MeshBucketStore:
         out_reset = packed_np[:, 3]
         out_exp = packed_np[:, 4]
 
-        for s in range(S):
-            chunk = by_shard[s]
+        for s, chunk in enumerate(chunks):
             if not chunk:
                 continue
             commit_slots, commit_exp, commit_rm, commit_keys = [], [], [], []
             for i, p in enumerate(chunk):
-                # Only scattering lanes commit bookkeeping (grouped
-                # intermediates' new_expire is not the final state).
-                if wr_a[s, i] and not cached_np[s, i] and p.slot >= 0:
+                commits = write[s, i] if write is not None else True
+                if commits and not cached_np[s, i] and p.slot >= 0:
                     commit_slots.append(p.slot)
                     commit_exp.append(out_exp[s, i])
                     commit_rm.append(out_removed[s, i])
@@ -442,6 +450,7 @@ class MeshBucketStore:
                     reset_time=int(out_reset[s, i]),
                 )
             self.tables[s].commit(commit_slots, commit_exp, commit_rm, keys=commit_keys)
+        return cached_np
 
     # ------------------------------------------------------------------
     def _run_round(self, chunks, now_ms: int, responses) -> None:
@@ -463,36 +472,12 @@ class MeshBucketStore:
             self.state, self.gcols, batch, extra, now_ms
         )
 
-        packed_np = np.asarray(packed)  # [S, 5, B] — the one blocking transfer
-        row0 = packed_np[:, 0]
-        out_status = (row0 & 1).astype(np.int32)
-        out_removed = ((row0 >> 1) & 1).astype(bool)
-        cached_np = ((row0 >> 2) & 1).astype(bool)
-        out_limit = packed_np[:, 1]
-        out_rem = packed_np[:, 2]
-        out_reset = packed_np[:, 3]
-        out_exp = packed_np[:, 4]
-
-        for s, chunk in enumerate(chunks):
-            if not chunk:
-                continue
-            commit_slots, commit_exp, commit_rm, commit_keys = [], [], [], []
-            for i, p in enumerate(chunk):
-                if not cached_np[s, i] and p.slot >= 0:
-                    commit_slots.append(p.slot)
-                    commit_exp.append(out_exp[s, i])
-                    commit_rm.append(out_removed[s, i])
-                    commit_keys.append(p.key)
-                    self.algo_mirror[s][p.slot] = int(p.req.algorithm)
-                responses[p.pos] = RateLimitResponse(
-                    status=int(out_status[s, i]),
-                    limit=int(out_limit[s, i]) if cached_np[s, i] else int(p.req.limit),
-                    remaining=int(out_rem[s, i]),
-                    reset_time=int(out_reset[s, i]),
-                )
-            self.tables[s].commit(commit_slots, commit_exp, commit_rm, keys=commit_keys)
-            if self.store is not None:
-                self._fire_store_callbacks(s, chunk, cached_np[s], out_removed[s])
+        cached_np = self._decode_commit_respond(packed, chunks, responses)
+        if self.store is not None:
+            removed_np = (np.asarray(packed)[:, 0] >> 1 & 1).astype(bool)
+            for s, chunk in enumerate(chunks):
+                if chunk:
+                    self._fire_store_callbacks(s, chunk, cached_np[s], removed_np[s])
 
     # ------------------------------------------------------------------
     # Store SPI (persistence) — same call pattern as ShardStore.

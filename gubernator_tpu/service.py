@@ -356,7 +356,19 @@ class V1Service:
 
     # ------------------------------------------------------------------
     def health_check(self) -> HealthCheckResponse:
-        """gubernator.go:295-333."""
+        """gubernator.go:295-333.  Counted + timed like every RPC (the
+        reference's stats handler tags all methods, grpc_stats.go:95-118)."""
+        method = "/pb.gubernator.V1/HealthCheck"
+        start = time.perf_counter()
+        try:
+            return self._health_check()
+        finally:
+            self.metrics.request_counts.labels(status="0", method=method).inc()
+            self.metrics.request_duration.labels(method=method).observe(
+                time.perf_counter() - start
+            )
+
+    def _health_check(self) -> HealthCheckResponse:
         errs: List[str] = []
         with self._peer_mutex:
             for peer in self.local_picker.peers():
