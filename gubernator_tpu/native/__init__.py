@@ -55,6 +55,8 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.gt_table_len.restype = c.c_int64
     lib.gt_table_len.argtypes = [c.c_void_p]
     lib.gt_table_stats.argtypes = [c.c_void_p, c.POINTER(c.c_int64)]
+    lib.gt_table_evictions.restype = c.c_int64
+    lib.gt_table_evictions.argtypes = [c.c_void_p]
     lib.gt_table_get_slot.restype = c.c_int32
     lib.gt_table_get_slot.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
     lib.gt_table_lookup_or_assign.argtypes = [
@@ -184,7 +186,9 @@ class NativeSlotTable:
 
     @property
     def evictions(self) -> int:
-        return self._stats[2]
+        # Hot: plan_grouped_python reads this around every lookup, so
+        # it takes the single-counter FFI call, not the stats marshal.
+        return int(self._lib.gt_table_evictions(self._ptr))
 
     # ------------------------------------------------------------------
     def get_slot(self, key: str) -> Optional[int]:

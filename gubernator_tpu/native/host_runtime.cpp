@@ -88,6 +88,28 @@ struct Table {
     free_slots.push_back(s);
   }
 
+  // Re-map an unmapped slot to `key` (the remove-then-recreate chain:
+  // an earlier lane freed the slot, a later round recreated the key on
+  // device).  Returns false when the key is meanwhile mapped elsewhere.
+  // Negative expire is the narrow-wire keep-sentinel; an unmapped slot
+  // has no prior value to keep, so it clamps to 0 (already expired).
+  bool remap(int32_t s, const char* key, size_t len, int64_t expire) {
+    std::string k(key, len);
+    if (!key_to_slot.emplace(k, s).second) return false;
+    slot_key[s] = std::move(k);
+    slot_mapped[s] = 1;
+    expire_ms[s] = expire >= 0 ? expire : 0;
+    for (size_t j = free_slots.size(); j > 0; --j) {
+      if (free_slots[j - 1] == s) {
+        free_slots[j - 1] = free_slots.back();
+        free_slots.pop_back();
+        break;
+      }
+    }
+    lru_push_back(s);
+    return true;
+  }
+
   // (slot, exists): exists=false means kernel treats as fresh create.
   // Mirrors slot_table.py::lookup_or_assign exactly.
   std::pair<int32_t, bool> lookup_or_assign(const char* key, size_t len,
@@ -172,6 +194,11 @@ void gt_table_stats(void* tv, int64_t* out) {  // hits, misses, evictions
   out[0] = t->hits; out[1] = t->misses; out[2] = t->evictions;
 }
 
+// Single-counter read: plan_grouped_python polls this around every
+// lookup to detect evictions, so it must not marshal the whole stats
+// array per call.
+int64_t gt_table_evictions(void* tv) { return ((Table*)tv)->evictions; }
+
 int32_t gt_table_get_slot(void* tv, const char* key, int64_t len) {
   Table* t = (Table*)tv;
   auto it = t->key_to_slot.find(std::string(key, (size_t)len));
@@ -233,8 +260,11 @@ void gt_table_commit_keys(void* tv, const int32_t* slots,
     int32_t s = slots[i];
     if (s < 0) continue;
     size_t len = (size_t)(offsets[i + 1] - offsets[i]);
-    if (!t->slot_mapped[s] ||
-        t->slot_key[s].compare(0, std::string::npos, keys + offsets[i], len) != 0)
+    if (!t->slot_mapped[s]) {
+      if (!removed[i]) t->remap(s, keys + offsets[i], len, expire[i]);
+      continue;
+    }
+    if (t->slot_key[s].compare(0, std::string::npos, keys + offsets[i], len) != 0)
       continue;  // slot remapped mid-batch; this lane is stale
     if (removed[i]) t->unmap_slot(s);
     else t->expire_ms[s] = expire[i];

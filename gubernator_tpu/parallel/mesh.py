@@ -255,6 +255,7 @@ class MeshBucketStore:
         mesh: Optional[Mesh] = None,
         devices: Optional[Sequence[jax.Device]] = None,
         store=None,
+        use_native: bool = True,
     ):
         self.store = store
         # One mutation lock: apply/sync/inject swap donated device
@@ -268,7 +269,17 @@ class MeshBucketStore:
         self.n_shards = self.mesh.devices.size
         self.capacity_per_shard = capacity_per_shard
         self.g_capacity = g_capacity
-        self.tables = [SlotTable(capacity_per_shard) for _ in range(self.n_shards)]
+        # C++ slot tables when the native runtime is available: the
+        # Python scheduling loop stays (plan_grouped_python), but every
+        # lookup/commit runs at C++ hash-map speed.
+        from .. import native as _native
+
+        _table = (
+            _native.NativeSlotTable
+            if use_native and _native.available()
+            else SlotTable
+        )
+        self.tables = [_table(capacity_per_shard) for _ in range(self.n_shards)]
         self.algo_mirror = [
             np.zeros(capacity_per_shard, dtype=np.int32) for _ in range(self.n_shards)
         ]
