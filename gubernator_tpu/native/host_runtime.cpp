@@ -489,22 +489,7 @@ void gt_batch_commit_plan(void* bv, const int64_t* new_expire,
       // pre-batch expiry through, so the host value is already right.
       if (new_expire[i] >= 0) t->expire_ms[s] = new_expire[i];
     } else if (!t->slot_mapped[s]) {
-      std::string k(b->key_ptr(i), b->key_len(i));
-      // Guard: if the key meanwhile maps elsewhere (mid-batch eviction
-      // reassigned it), that newer mapping owns the key — skip.
-      if (!t->key_to_slot.emplace(k, s).second) continue;
-      t->slot_key[s] = std::move(k);
-      t->slot_mapped[s] = 1;
-      t->expire_ms[s] = new_expire[i] >= 0 ? new_expire[i] : 0;
-      // slot was unmapped (free-listed); pull it back into LRU order
-      for (size_t j = 0; j < t->free_slots.size(); ++j) {
-        if (t->free_slots[j] == s) {
-          t->free_slots[j] = t->free_slots.back();
-          t->free_slots.pop_back();
-          break;
-        }
-      }
-      t->lru_push_back(s);
+      t->remap(s, b->key_ptr(i), b->key_len(i), new_expire[i]);
     }
   }
 }

@@ -97,18 +97,26 @@ def test_mesh_scales_keyspace():
     assert min(per_shard) > 0
 
 
-def test_fused_duplicates_match_sequential():
+import pytest
+
+
+@pytest.mark.parametrize("fused_native", [True, False])
+def test_fused_duplicates_match_sequential(fused_native):
     """Hot-key duplicate batches through the fused mesh dispatch
     (grouped round 0 + slow rounds in one program) must match applying
-    the same requests one at a time."""
+    the same requests one at a time — with the fused store on BOTH slot
+    table backends, pinning C++/Python table parity through the mesh
+    path (the serial store always runs the Python tables)."""
     import numpy as np
 
     from gubernator_tpu.parallel.mesh import MeshBucketStore
     from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest
 
     rng = np.random.RandomState(9)
-    fused = MeshBucketStore(capacity_per_shard=128, g_capacity=32)
-    serial = MeshBucketStore(capacity_per_shard=128, g_capacity=32)
+    fused = MeshBucketStore(capacity_per_shard=128, g_capacity=32,
+                            use_native=fused_native)
+    serial = MeshBucketStore(capacity_per_shard=128, g_capacity=32,
+                             use_native=False)
     now = 1_700_000_000_000
     for step in range(25):
         reqs = []

