@@ -9,6 +9,7 @@ surface; `sleep_until_reset` is the Python client's convenience
 
 from __future__ import annotations
 
+import datetime
 import http.client
 import json
 import random
@@ -138,6 +139,24 @@ def sleep_until_reset(rate_limit: RateLimitResponse) -> None:
     delta = rate_limit.reset_time / 1000.0 - now
     if delta > 0:
         time.sleep(delta)
+
+
+def to_timestamp(duration: datetime.timedelta) -> int:
+    """Duration -> unix-millisecond count for request duration fields
+    (client.go:62-64)."""
+    return int(duration.total_seconds() * 1000)
+
+
+def from_unix_milliseconds(ts: int) -> datetime.datetime:
+    """Unix-ms timestamp -> aware datetime (client.go:76-78)."""
+    return datetime.datetime.fromtimestamp(ts / 1000.0, tz=datetime.timezone.utc)
+
+
+def from_timestamp(ts: int) -> datetime.timedelta:
+    """Unix-ms timestamp -> elapsed time since it (now - ts, matching
+    client.go:69-72): positive for past timestamps, NEGATIVE for future
+    ones.  To wait out a reset_time, use sleep_until_reset, not this."""
+    return datetime.datetime.now(tz=datetime.timezone.utc) - from_unix_milliseconds(ts)
 
 
 def random_peer(peers: List[PeerInfo]) -> PeerInfo:

@@ -19,7 +19,7 @@ def main(argv=None) -> int:
 
     cl = Cluster().start(args.nodes)
     for p in cl.peers:
-        print(f"peer: http://{p.grpc_address}")
+        print(f"peer: http://{p.http_address} grpc://{p.grpc_address}")
     print("Ready")
     sys.stdout.flush()
 

@@ -1,0 +1,120 @@
+"""Client-library test against a subprocess cluster (reference
+python/tests/test_client.py:24-56: launch the cluster binary, wait for
+"Ready" on stdout, then exercise the client helpers against it).
+"""
+
+import datetime
+import os
+import re
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from gubernator_tpu.client import (
+    V1Client,
+    from_timestamp,
+    from_unix_milliseconds,
+    sleep_until_reset,
+    to_timestamp,
+)
+from gubernator_tpu.types import GetRateLimitsRequest, RateLimitRequest, Status
+
+
+@pytest.fixture(scope="module")
+def cluster_proc():
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_PLATFORMS"] = "cpu"
+    # Fresh interpreter: share the persistent compile cache or the
+    # daemons' warmup pays full cold compiles.
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gubernator_tpu.cmd.cluster_main", "--nodes", "2"],
+        stdout=subprocess.PIPE,
+        text=True,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    # Watchdog: a daemon that hangs before "Ready" must fail the test,
+    # not block the session forever on the stdout read.
+    import threading
+
+    ready = threading.Event()
+    killer = threading.Timer(240.0, lambda: None if ready.is_set() else proc.kill())
+    killer.start()
+    peers = []
+    try:
+        for line in proc.stdout:  # wait for Ready like the reference fixture
+            m = re.match(r"peer: http://(\S+) grpc://(\S+)", line)
+            if m:
+                peers.append(m.group(1))
+            if line.strip() == "Ready":
+                ready.set()
+                break
+        if not ready.is_set():
+            raise RuntimeError("cluster exited (or was killed) before Ready")
+        yield peers
+    finally:
+        killer.cancel()
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def test_client_against_subprocess_cluster(cluster_proc):
+    client = V1Client(cluster_proc[0], timeout_s=60.0)
+    resp = client.get_rate_limits(
+        GetRateLimitsRequest(
+            requests=[
+                RateLimitRequest(
+                    name="subproc", unique_key="k1", hits=1, limit=2,
+                    duration=2_000,
+                )
+            ]
+        )
+    )
+    rl = resp.responses[0]
+    assert rl.error == ""
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 1)
+
+    hc = client.health_check()
+    assert hc.status == "healthy" and hc.peer_count == 2
+
+    # Drain, then sleep_until_reset unblocks the limit (the Python
+    # client's convenience helper, python/gubernator/__init__.py:12-17).
+    client.get_rate_limits(
+        GetRateLimitsRequest(
+            requests=[RateLimitRequest(name="subproc", unique_key="k1",
+                                       hits=1, limit=2, duration=2_000)]
+        )
+    )
+    over = client.get_rate_limits(
+        GetRateLimitsRequest(
+            requests=[RateLimitRequest(name="subproc", unique_key="k1",
+                                       hits=1, limit=2, duration=2_000)]
+        )
+    ).responses[0]
+    assert over.status == Status.OVER_LIMIT
+    sleep_until_reset(over)
+    after = client.get_rate_limits(
+        GetRateLimitsRequest(
+            requests=[RateLimitRequest(name="subproc", unique_key="k1",
+                                       hits=1, limit=2, duration=2_000)]
+        )
+    ).responses[0]
+    assert after.status == Status.UNDER_LIMIT
+
+
+def test_time_helpers():
+    assert to_timestamp(datetime.timedelta(seconds=2)) == 2000
+    dt = from_unix_milliseconds(1_700_000_000_000)
+    assert dt.year == 2023 and dt.tzinfo is not None
+    # A timestamp in the past yields a positive delta from now.
+    assert from_timestamp(1_700_000_000_000) > datetime.timedelta(0)

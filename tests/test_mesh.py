@@ -11,6 +11,7 @@ import jax
 import numpy as np
 import pytest
 
+from gubernator_tpu import native
 from gubernator_tpu.models.shard import ShardStore
 from gubernator_tpu.parallel.mesh import MeshBucketStore, make_mesh, shard_of_key
 from gubernator_tpu.types import Algorithm, RateLimitRequest, Status
@@ -97,10 +98,19 @@ def test_mesh_scales_keyspace():
     assert min(per_shard) > 0
 
 
-import pytest
-
-
-@pytest.mark.parametrize("fused_native", [True, False])
+@pytest.mark.parametrize(
+    "fused_native",
+    [
+        pytest.param(
+            True,
+            marks=pytest.mark.skipif(
+                not native.available(),
+                reason="native runtime unavailable: True case would be Python-vs-Python",
+            ),
+        ),
+        False,
+    ],
+)
 def test_fused_duplicates_match_sequential(fused_native):
     """Hot-key duplicate batches through the fused mesh dispatch
     (grouped round 0 + slow rounds in one program) must match applying
