@@ -62,9 +62,12 @@ class FilePool:
             return
         if mtime == self._mtime:
             return
-        self._mtime = mtime
         with open(self.path) as f:
             data = json.load(f)
+        # Record the mtime only AFTER a successful parse: a poll landing
+        # on a half-written file must retry on the next tick, not mark
+        # the (torn) content as seen and drop the update forever.
+        self._mtime = mtime
         self.on_update([PeerInfo.from_json(p) for p in data])
 
     def _run(self) -> None:

@@ -109,3 +109,35 @@ def test_member_list_env_parsing():
     assert conf.member_list_address == "127.0.0.1:7946"
     assert conf.member_list_known_nodes == ["a:7946", "b:7946"]
     assert conf.member_list_node_name == "node-a"
+
+
+def test_file_pool_watches_membership(tmp_path):
+    """The watched-JSON-file backend (peers.FilePool): editing the file
+    IS the membership event."""
+    import json
+    import os
+
+    from gubernator_tpu.peers import FilePool
+
+    path = tmp_path / "peers.json"
+    path.write_text(json.dumps([{"grpcAddress": "10.0.0.1:81"}]))
+    updates = []
+    pool = FilePool(str(path), on_update=updates.append, poll_s=0.05)
+    try:
+        assert [p.grpc_address for p in updates[-1]] == ["10.0.0.1:81"]
+        path.write_text(json.dumps(
+            [{"grpcAddress": "10.0.0.1:81"}, {"grpcAddress": "10.0.0.2:81"}]
+        ))
+        # Explicitly bump mtime by a full second: on a coarse-granularity
+        # filesystem the rewrite alone can land in the same mtime tick
+        # and the poll would (correctly) skip it.
+        m = os.path.getmtime(path)
+        os.utime(path, (m + 1, m + 1))
+        wait_until(
+            lambda: updates
+            and [p.grpc_address for p in updates[-1]]
+            == ["10.0.0.1:81", "10.0.0.2:81"],
+            msg="file edit delivers new peer list",
+        )
+    finally:
+        pool.close()
