@@ -175,6 +175,8 @@ def config4():
     batch_reqs = [reqs_proto[i] for i in ids]
     store.apply(batch_reqs, NOW)
     store.sync_globals(NOW)
+    # Stress cadence: one sync collective after EVERY batch (two device
+    # round trips per batch — the convergence-latency worst case).
     t0 = time.perf_counter()
     syncs = 0
     for i in range(iters):
@@ -182,7 +184,18 @@ def config4():
         res = store.sync_globals(NOW + 1 + i)
         syncs += res.broadcast_count
     dt = time.perf_counter() - t0
-    _emit(4, batch * iters, dt, shards=n_dev, broadcasts=syncs)
+    _emit(4, batch * iters, dt, shards=n_dev, broadcasts=syncs, sync_every=1)
+    # Deployment cadence: syncs amortize over the GlobalSyncWait window
+    # (several batches per sync), the configuration GLOBAL is meant for.
+    t0 = time.perf_counter()
+    syncs = 0
+    for i in range(iters * 4):
+        store.apply(batch_reqs, NOW + 100 + i, home_shard=i % n_dev)
+        if i % 4 == 3:
+            syncs += store.sync_globals(NOW + 100 + i).broadcast_count
+    dt = time.perf_counter() - t0
+    _emit("4_amortized", batch * iters * 4, dt, shards=n_dev,
+          broadcasts=syncs, sync_every=4)
 
 
 def config5():

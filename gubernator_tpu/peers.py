@@ -22,11 +22,14 @@ implementations:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 from typing import Callable, List, Optional
 
 from .types import PeerInfo
+
+log = logging.getLogger("gubernator.peers")
 
 OnUpdate = Callable[[List[PeerInfo]], None]
 
@@ -51,7 +54,13 @@ class FilePool:
         self.poll_s = poll_s
         self._stop = threading.Event()
         self._mtime = 0.0
-        self._load()
+        try:
+            # A torn/invalid file at construction is transient the same
+            # way it is mid-poll: log and let the first tick retry
+            # rather than failing daemon startup.
+            self._load()
+        except (OSError, ValueError) as e:
+            log.warning("initial peers-file load failed, will retry: %s", e)
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -64,17 +73,28 @@ class FilePool:
             return
         with open(self.path) as f:
             data = json.load(f)
-        # Record the mtime only AFTER a successful parse: a poll landing
-        # on a half-written file must retry on the next tick, not mark
-        # the (torn) content as seen and drop the update forever.
+        if not isinstance(data, list):
+            raise ValueError("peers file must be a JSON array of objects")
+        peers = []
+        for p in data:
+            if not isinstance(p, dict):
+                raise ValueError(f"peer entry must be a JSON object, got {p!r}")
+            peers.append(PeerInfo.from_json(p))
+        # Record the mtime only AFTER the content fully validated: a
+        # poll landing on a half-written (or JSON-valid-but-wrong-shape)
+        # file must retry on the next tick, not mark the content as
+        # seen and drop the update forever.
         self._mtime = mtime
-        self.on_update([PeerInfo.from_json(p) for p in data])
+        self.on_update(peers)
 
     def _run(self) -> None:
         while not self._stop.wait(timeout=self.poll_s):
             try:
                 self._load()
-            except (OSError, json.JSONDecodeError):
+            except (OSError, ValueError) as e:
+                # JSONDecodeError is a ValueError; shape errors raise
+                # ValueError explicitly above.
+                log.debug("peers-file poll failed, retrying: %s", e)
                 continue
 
     def close(self) -> None:

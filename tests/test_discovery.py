@@ -141,3 +141,36 @@ def test_file_pool_watches_membership(tmp_path):
         )
     finally:
         pool.close()
+
+
+def test_file_pool_tolerates_torn_and_malformed_content(tmp_path):
+    """A half-written or schema-invalid peers file must be retried on a
+    later tick (never marked seen, never killing the watcher), and a
+    torn file at construction must not fail pool startup."""
+    import json
+    import os
+
+    from gubernator_tpu.peers import FilePool
+
+    path = tmp_path / "peers.json"
+    path.write_text('[{"grpcAddress": "10.0.0.1:81"')  # torn at construction
+    updates = []
+    pool = FilePool(str(path), on_update=updates.append, poll_s=0.05)
+    try:
+        assert updates == []  # survived, nothing delivered yet
+        # JSON-valid but wrong shape: still not marked seen.
+        path.write_text(json.dumps(["10.0.0.2:81"]))
+        m = os.path.getmtime(path)
+        os.utime(path, (m + 1, m + 1))
+        time.sleep(0.2)
+        assert updates == []
+        # Now a good file with the SAME content length: must deliver.
+        path.write_text(json.dumps([{"grpcAddress": "10.0.0.3:81"}]))
+        os.utime(path, (m + 2, m + 2))
+        wait_until(
+            lambda: updates
+            and [p.grpc_address for p in updates[-1]] == ["10.0.0.3:81"],
+            msg="recovered after torn/malformed content",
+        )
+    finally:
+        pool.close()
