@@ -1,0 +1,46 @@
+"""The driver's entry points must stay green.
+
+`dryrun_multichip` must self-provision a virtual CPU mesh when the host
+has fewer devices than requested (round-1 verdict: the bench host has one
+chip, and the official multi-chip artifact was red because the old code
+asserted on device count instead of provisioning).
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dryrun_multichip_in_process():
+    # The suite itself runs on a forced 8-device CPU mesh (conftest), so
+    # the in-process fast path applies.
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__ as g
+
+        g.dryrun_multichip(4)
+    finally:
+        sys.path.remove(REPO)
+
+
+def test_dryrun_multichip_self_provisions_subprocess():
+    # A bare child process defaults to 1 device; dryrun_multichip(4) must
+    # succeed anyway by re-exec'ing itself with a forced device count.
+    # Deliberately do NOT export JAX_PLATFORMS=cpu: the real harness child
+    # boots with whatever platform sitecustomize registers and relies on
+    # the config.update('jax_platforms', 'cpu') inside the re-exec'd
+    # grandchild, so this test must reproduce that condition.
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    child = "import __graft_entry__ as g; g.dryrun_multichip(4)"
+    proc = subprocess.run(
+        [sys.executable, "-c", child],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "sharded apply + GLOBAL sync collectives OK" in proc.stdout
