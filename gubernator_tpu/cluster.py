@@ -20,7 +20,7 @@ DATA_CENTER_NONE = ""
 DATA_CENTER_ONE = "datacenter-1"
 
 
-def test_behaviors() -> BehaviorConfig:
+def fast_test_behaviors() -> BehaviorConfig:
     """Shortened windows (cluster/cluster.go:104-110)."""
     return BehaviorConfig(
         global_sync_wait_s=0.05,
@@ -55,7 +55,7 @@ class Cluster:
                 cache_size=cache_size,
                 global_cache_size=g_capacity,
                 data_center=dc,
-                behaviors=test_behaviors(),
+                behaviors=fast_test_behaviors(),
                 peer_discovery_type="static",
             )
             d = Daemon(conf, clock=clock).start()

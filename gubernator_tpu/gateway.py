@@ -24,6 +24,14 @@ from .types import GetRateLimitsRequest, UpdatePeerGlobal
 _GRPC_CODES = {"InvalidArgument": 3, "OutOfRange": 11, "Internal": 13}
 
 
+class _GatewayHTTPServer(ThreadingHTTPServer):
+    # socketserver's default listen backlog of 5 resets connections under
+    # a concurrent client burst; the reference edge accepts thousands of
+    # in-flight requests and bounds load at the request level instead
+    # (1000-item cap, gubernator.go:118-121).
+    request_queue_size = 128
+
+
 class GatewayServer:
     def __init__(
         self,
@@ -34,7 +42,7 @@ class GatewayServer:
         self.service = service
         host, _, port = listen_address.partition(":")
         handler = _make_handler(service)
-        self.httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port or 0)), handler)
+        self.httpd = _GatewayHTTPServer((host or "127.0.0.1", int(port or 0)), handler)
         self.httpd.daemon_threads = True
         if tls_context is not None:
             self.httpd.socket = tls_context.wrap_socket(self.httpd.socket, server_side=True)

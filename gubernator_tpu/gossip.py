@@ -213,10 +213,16 @@ class Gossip:
             self._queue_update(update)
             targets = [m for m in self._members.values()
                        if m.state == ALIVE and m.name != self.name]
-        # Push the leave directly — piggybacking alone may never flush
-        # because we stop probing right after.
+        # Push the leave explicitly in every datagram — the piggyback
+        # queue carries only RETRANSMIT credits, so in clusters larger
+        # than that the later targets would receive an empty packet and
+        # only learn of the departure via the probe/suspect/dead cycle.
+        payload = json.dumps({"t": "gossip", "g": [update]}).encode()
         for m in targets:
-            self._send(m.addr, {"t": "gossip"})
+            try:
+                self._udp.sendto(payload, m.addr)
+            except OSError:
+                pass
 
     def close(self) -> None:
         if self._stop.is_set():

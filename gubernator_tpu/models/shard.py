@@ -544,6 +544,14 @@ class ShardStore:
         wr_col = np.zeros(padded, dtype=bool)
         wr_col[:n] = write
         narrow = self._narrow_ok(cols, now_ms)
+        # Snapshot the pass-through expiry NOW: the -2 keep-sentinel means
+        # "the kernel left this slot's pre-batch expiry unchanged", and
+        # pre-batch is defined at plan time.  A later pipelined batch's
+        # planning can evict/reassign these slots (zeroing expire_ms)
+        # before resolve() runs, so reading the table at resolve time
+        # would reconstruct a wrong reset_time for far-future
+        # pass-through lanes.
+        passthrough_exp = self.table.get_expire_bulk(slots) if narrow else None
         if narrow:
             greg_delta = np.where(
                 cols.greg_duration != 0, cols.greg_expire - now_ms, 0
@@ -586,8 +594,32 @@ class ShardStore:
             with self._lock:
                 packed_np = np.asarray(packed)  # the one blocking transfer
                 if narrow:
+                    pn = packed_np[:, :n]
+                    te = passthrough_exp
+                    # -2 keep-sentinel lanes reconstruct the device's
+                    # pre-THIS-batch expiry.  A sentinel value is
+                    # unrepresentable (>i32 delta), which requires a
+                    # stored duration the narrow wire also can't carry —
+                    # so no in-flight NARROW batch can have written it,
+                    # and any narrow request on such a key triggers
+                    # duration-change re-expiry instead of a pass-through.
+                    # Hence the value always predates every in-flight
+                    # batch and the dispatch-time snapshot is correct even
+                    # if a later batch's all-pending eviction fallback
+                    # steals the slot and zeroes the mirror before this
+                    # resolve.  Defense in depth: when the slot still maps
+                    # this batch's key, prefer the resolve-time table
+                    # value (older in-flight commits have folded in by
+                    # now via the FIFO drain).
+                    sent = np.nonzero(pn[2] == -2)[0]
+                    if sent.size:
+                        te = passthrough_exp.copy()
+                        cur = self.table.get_expire_bulk(slots)
+                        for j in sent:
+                            if self.table.get_slot(keys[j]) == slots[j]:
+                                te[j] = cur[j]
                     status, removed, remaining, reset, new_exp = buckets.unpack_output32(
-                        packed_np[:, :n], now_ms, self.table.get_expire_bulk(slots)
+                        pn, now_ms, te
                     )
                 else:
                     status, removed, remaining, reset, new_exp = buckets.unpack_output(

@@ -10,6 +10,13 @@ Semantics parity:
   * expired item == miss, slot recycled in place     (cache.go:138-163)
   * LRU eviction when at capacity                    (cache.go:115-130)
   * hit/miss/size accounting for metrics             (cache.go:88-92,205-218)
+
+The C++ twin (native/host_runtime.cpp) additionally tracks in-flight
+pipelined device writes (pending_write) and skips those slots when
+evicting.  This table has no such state because the pipelined columnar
+path requires the native runtime — on every state reachable through
+this class the two implementations behave identically (verified by the
+parity tests in tests/test_native.py).
 """
 
 from __future__ import annotations

@@ -111,7 +111,12 @@ struct Table {
   }
 
   // (slot, exists): exists=false means kernel treats as fresh create.
-  // Mirrors slot_table.py::lookup_or_assign exactly.
+  // Mirrors slot_table.py::lookup_or_assign, except for pipelining
+  // state the Python twin does not model: pending_write liveness and
+  // pending-aware eviction only matter between a columnar batch's plan
+  // and commit, and the pipelined path requires the native runtime —
+  // the Python twin never observes in-flight writes, so the twins agree
+  // on every state the Python table can reach.
   std::pair<int32_t, bool> lookup_or_assign(const char* key, size_t len,
                                             int64_t now_ms) {
     std::string k(key, len);
@@ -135,7 +140,17 @@ struct Table {
       s = free_slots.back();
       free_slots.pop_back();
     } else {
-      s = lru_head;  // evict LRU (cache.go:115-130)
+      // Evict LRU (cache.go:115-130), skipping slots whose device write
+      // from an earlier pipelined batch is still in flight — stealing
+      // one drops that batch's device state mid-air and invalidates its
+      // plan-time chaining assumptions.  Walk from the cold end; under
+      // pipelining the pending slots are the recently-touched ones, so
+      // the head is normally clean.  Fall back to the raw head only
+      // when every slot is pending (capacity fully in flight).
+      s = lru_head;
+      for (int32_t cand = lru_head; cand >= 0; cand = lru_next[cand]) {
+        if (pending_write[cand] == 0) { s = cand; break; }
+      }
       lru_unlink(s);
       key_to_slot.erase(slot_key[s]);
       slot_mapped[s] = 0;

@@ -360,10 +360,18 @@ class V1Service:
         reference's stats handler tags all methods, grpc_stats.go:95-118)."""
         method = "/pb.gubernator.V1/HealthCheck"
         start = time.perf_counter()
+        # Status label = WIRE outcome, like every other method here (and
+        # the reference's stats handler, grpc_stats.go:95-118): an RPC
+        # that successfully reports an unhealthy payload is still a
+        # successful RPC; only a raise counts as an error.
+        status = "0"
         try:
             return self._health_check()
+        except Exception:
+            status = "1"
+            raise
         finally:
-            self.metrics.request_counts.labels(status="0", method=method).inc()
+            self.metrics.request_counts.labels(status=status, method=method).inc()
             self.metrics.request_duration.labels(method=method).observe(
                 time.perf_counter() - start
             )

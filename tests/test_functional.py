@@ -335,12 +335,39 @@ def test_health_check_unhealthy_on_peer_failure(cluster, clock):
 
     assert until_pass(unhealthy)
 
+    # An unhealthy payload is still a successful RPC: the wire-outcome
+    # status label stays "0" (reference tags per-RPC outcomes, not
+    # payload health, grpc_stats.go:95-118).
+    counts = entry.service.metrics.request_counts
+    assert (
+        counts.labels(
+            status="0", method="/pb.gubernator.V1/HealthCheck"
+        )._value.get()
+        > 0
+    )
+
     # Restart the victim (cluster.Restart, cluster/cluster.go:87-93).
     cluster.restart(victim_idx, clock=clock)
     resp = client.get_rate_limits(
         GetRateLimitsRequest(requests=[mk("test_health", key, limit=5)])
     )
     assert resp.responses[0].error == ""
+
+
+def test_health_check_error_label_on_raise(cluster, monkeypatch):
+    """A HealthCheck RPC that RAISES is counted with status="1" (wire
+    outcome), matching the reference's per-RPC error tagging
+    (grpc_stats.go:95-118)."""
+    svc = cluster.daemons[0].service
+    counts = svc.metrics.request_counts
+    label = counts.labels(status="1", method="/pb.gubernator.V1/HealthCheck")
+    before = label._value.get()
+    monkeypatch.setattr(
+        svc, "_health_check", lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+    )
+    with pytest.raises(RuntimeError):
+        svc.health_check()
+    assert label._value.get() == before + 1
 
 
 def test_change_limit_over_http(cluster):
@@ -410,7 +437,7 @@ def test_ingress_batching_coalesces_concurrent_requests():
         DaemonConfig(
             listen_address="127.0.0.1:0",
             cache_size=1024,
-            behaviors=BehaviorConfig(batch_wait_s=0.02),  # wide window
+            behaviors=BehaviorConfig(batch_wait_s=0.05),  # wide window
         )
     )
     try:
@@ -426,8 +453,12 @@ def test_ingress_batching_coalesces_concurrent_requests():
         client = V1Client(d.gateway.address)
         results = []
         lock = threading.Lock()
+        # Fire all requests as simultaneously as the host allows; under
+        # load, staggered arrivals can otherwise each miss the window.
+        barrier = threading.Barrier(20)
 
         def one():
+            barrier.wait(timeout=10)
             r = client.get_rate_limits(
                 GetRateLimitsRequest(
                     requests=[mk("ingress_batch", "same_key", limit=100)]

@@ -203,3 +203,54 @@ class TestGossipPool:
         finally:
             for p in pools:
                 p.close()
+
+
+def test_leave_reaches_every_member_in_large_cluster():
+    """leave() must carry the LEFT update in EVERY outgoing datagram, not
+    rely on the piggyback queue's RETRANSMIT credits — in clusters larger
+    than the credit count the later targets would otherwise get an empty
+    packet and only learn of the departure via the suspicion cycle
+    (advisor finding, gossip.py leave)."""
+    import json
+    import socket
+
+    from gubernator_tpu.gossip import ALIVE, LEFT, RETRANSMIT, Member
+
+    # Probe/sync loops effectively disabled: a probe ping racing the
+    # leave would otherwise occupy a listener's first datagram.
+    node = make_node("leaver", probe_interval_s=3600, sync_interval_s=3600)
+    # Twice as many listeners as the piggyback credit budget, each a bare
+    # UDP socket standing in for a remote member.
+    n_targets = RETRANSMIT * 2
+    socks = []
+    try:
+        with node._lock:
+            for i in range(n_targets):
+                s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                s.bind(("127.0.0.1", 0))
+                s.settimeout(2.0)
+                socks.append(s)
+                port = s.getsockname()[1]
+                node._members[f"m{i}"] = Member(
+                    name=f"m{i}", host="127.0.0.1", port=port, state=ALIVE
+                )
+        node.leave()
+
+        def got_left(s):
+            # Drain until a gossip datagram carries the LEFT update
+            # (robust against any stray probe traffic).
+            while True:
+                data, _ = s.recvfrom(65536)  # raises timeout on starvation
+                msg = json.loads(data.decode())
+                if any(
+                    u["s"] == LEFT and u["name"] == "leaver"
+                    for u in msg.get("g", [])
+                ):
+                    return True
+
+        for i, s in enumerate(socks):
+            assert got_left(s), f"target {i} did not receive the LEFT update"
+    finally:
+        node.close()
+        for s in socks:
+            s.close()

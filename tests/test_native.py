@@ -249,3 +249,117 @@ def test_plan_path_random_stress_vs_python():
         ra, rb = a.apply(reqs, step), b.apply(reqs, step)
         assert ra == rb, t
     assert sorted(a.table.keys()) == sorted(b.table.keys())
+
+
+def test_eviction_skips_pending_write_slots():
+    """Under capacity pressure, LRU eviction must not steal a slot whose
+    device write from an earlier un-resolved (pipelined) batch is still
+    in flight — doing so silently drops that batch's device state
+    (advisor finding, host_runtime.cpp lookup_or_assign)."""
+    from gubernator_tpu.models.shard import _Columns
+
+    nat = native.NativeSlotTable(4)
+    now = 1000
+
+    # Batch A plans k0,k1: their slots carry pending writes until commit.
+    cols = _Columns(2)
+    cols.algo[:] = 0
+    cols.behavior[:] = 0
+    cols.hits[:] = 1
+    cols.limit[:] = 10
+    cols.duration[:] = 60_000
+    planner = native.NativeBatchPlanner(nat, ["k0", "k1"], now)
+    _, slots_a, _, _, _, _ = planner.plan_grouped(cols, int(Behavior.RESET_REMAINING))
+    pending = set(int(s) for s in slots_a)
+
+    # Fill the rest of the capacity with committed keys.
+    s2, _ = nat.lookup_or_assign("k2", now)
+    s3, _ = nat.lookup_or_assign("k3", now)
+    nat.set_expire(s2, now + 60_000)
+    nat.set_expire(s3, now + 60_000)
+
+    # Table full; a new key must evict — but NOT a pending slot, even
+    # though k0/k1 are the LRU-coldest entries.
+    s4, _ = nat.lookup_or_assign("k4", now)
+    assert s4 not in pending
+    assert s4 == s2  # first non-pending in LRU order
+    assert nat.get_slot("k0") is not None and nat.get_slot("k1") is not None
+
+    # After commit the claims are released: next eviction takes k0.
+    planner.commit_plan(
+        np.full(2, now + 60_000, dtype=np.int64), np.zeros(2, dtype=np.uint8)
+    )
+    s5, _ = nat.lookup_or_assign("k5", now)
+    assert s5 in pending
+    assert nat.get_slot("k0") is None
+
+
+def test_eviction_falls_back_when_all_pending():
+    """When every slot has an in-flight write, eviction degrades to the
+    raw LRU head instead of failing."""
+    from gubernator_tpu.models.shard import _Columns
+
+    nat = native.NativeSlotTable(2)
+    now = 1000
+    cols = _Columns(2)
+    cols.algo[:] = 0
+    cols.behavior[:] = 0
+    cols.hits[:] = 1
+    cols.limit[:] = 10
+    cols.duration[:] = 60_000
+    planner = native.NativeBatchPlanner(nat, ["k0", "k1"], now)
+    planner.plan_grouped(cols, int(Behavior.RESET_REMAINING))
+
+    s, exists = nat.lookup_or_assign("k2", now)
+    assert not exists
+    assert 0 <= s < 2  # evicted the LRU head despite the pending claim
+
+
+def test_passthrough_reset_survives_pipelined_eviction(monkeypatch):
+    """The narrow-wire keep-sentinel reconstructs an unchanged reset_time
+    from the host expiry mirror; that value must be snapshotted at
+    dispatch time, because a later pipelined batch's planning can evict
+    and reassign the slot (zeroing expire_ms) before the earlier batch
+    resolves (advisor finding, shard.py _dispatch_columns).
+
+    The sentinel itself only fires for far-future expiries the i32 wire
+    can't carry, so instead of driving the kernel there this asserts the
+    snapshot timing directly: the expiry array handed to unpack_output32
+    must hold dispatch-time values even when the table mutates before
+    resolve."""
+    from gubernator_tpu.ops import buckets
+
+    now = 1_700_000_000_000
+    st = ShardStore(capacity=4, use_native=True)
+
+    def cols_for(key, hits):
+        return dict(
+            keys=[key], algorithm=[0], behavior=[0], hits=[hits],
+            limit=[10], duration=[60_000],
+        )
+
+    # Create "a": reset = now + 60s, committed.
+    r0 = st.apply_columns(**cols_for("a", 1), now_ms=now)
+    assert int(r0["reset_time"][0]) == now + 60_000
+    slot_a = st.table.get_slot(st.table.keys()[0])
+
+    captured = []
+    real_unpack = buckets.unpack_output32
+
+    def spy(packed, now_ms, table_expire):
+        captured.append(np.array(table_expire, copy=True))
+        return real_unpack(packed, now_ms, table_expire)
+
+    monkeypatch.setattr(buckets, "unpack_output32", spy)
+
+    # Dispatch a status query on "a", then clobber the table's expiry
+    # (as a later pipelined batch's eviction would) BEFORE resolving.
+    ha = st.apply_columns_async(**cols_for("a", 0), now_ms=now + 1)
+    st.table.set_expire(slot_a, 0)
+    ra = ha.result()
+
+    assert len(captured) == 1
+    # Snapshot taken at dispatch: pre-clobber value.
+    assert int(captured[0][0]) == now + 60_000
+    assert int(ra["remaining"][0]) == 9
+    assert int(ra["reset_time"][0]) == now + 60_000

@@ -15,7 +15,7 @@ import pytest
 
 from gubernator_tpu import tls as tlsmod
 from gubernator_tpu.client import V1Client
-from gubernator_tpu.cluster import test_behaviors
+from gubernator_tpu.cluster import fast_test_behaviors
 from gubernator_tpu.config import DaemonConfig, setup_daemon_config
 from gubernator_tpu.daemon import Daemon
 from gubernator_tpu.types import (
@@ -47,7 +47,7 @@ def spawn(tls_conf, dc=""):
     return Daemon(
         DaemonConfig(
             listen_address="127.0.0.1:0",
-            behaviors=test_behaviors(),
+            behaviors=fast_test_behaviors(),
             peer_discovery_type="static",
             data_center=dc,
             tls=tls_conf,
