@@ -246,6 +246,35 @@ def config5():
             over += sum(r.status == 1 for r in resp.responses)
         dt = time.perf_counter() - t0
         _emit(5, total, dt, regions=2, daemons=len(cl.daemons), over_limit=over)
+
+        # Plain storm (no MULTI_REGION): max-size batches of locally-mixed
+        # keys through ONE daemon's gateway — the columnar ingress path
+        # end-to-end (JSON -> columns -> fused kernel -> JSON), directly
+        # comparable to the reference's >2,000 req/s single-node number.
+        plain_iters = 12
+        plain_batches = [
+            GetRateLimitsRequest(
+                requests=[
+                    RateLimitRequest(
+                        name="c5p",
+                        unique_key=f"plain{rng.randint(4096)}",
+                        hits=1,
+                        limit=1_000_000,
+                        duration=3_600_000,
+                        algorithm=Algorithm.TOKEN_BUCKET,
+                    )
+                    for _ in range(_sz(1000, lo=16))
+                ]
+            )
+            for _ in range(plain_iters)
+        ]
+        clients[0].get_rate_limits(plain_batches[0])  # warm the batch shape
+        t0 = time.perf_counter()
+        total = 0
+        for b in plain_batches:
+            total += len(clients[0].get_rate_limits(b).responses)
+        dt = time.perf_counter() - t0
+        _emit("5_plain", total, dt, daemons=1, batch=len(plain_batches[0].requests))
     finally:
         cl.stop()
 

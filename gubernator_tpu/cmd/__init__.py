@@ -1,0 +1,21 @@
+"""CLI entry points.
+
+Shared env handling: some hosts pre-register an accelerator platform in
+`sitecustomize`, which overrides `JAX_PLATFORMS` set in the environment
+before the interpreter started.  The binaries re-assert the env var via
+`jax.config` so `JAX_PLATFORMS=cpu gubernator-server ...` (and the
+subprocess test fixtures that rely on it) behave the same everywhere.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_jax_platform_env() -> None:
+    """Force jax onto the platform named by $JAX_PLATFORMS, if set."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)

@@ -15,6 +15,10 @@ def main(argv=None) -> int:
     parser.add_argument("--nodes", type=int, default=6)
     args = parser.parse_args(argv)
 
+    from . import apply_jax_platform_env
+
+    apply_jax_platform_env()
+
     from ..cluster import Cluster
 
     cl = Cluster().start(args.nodes)

@@ -14,6 +14,10 @@ def main(argv=None) -> int:
     parser.add_argument("-debug", dest="debug", action="store_true", help="debug logging")
     args = parser.parse_args(argv)
 
+    from . import apply_jax_platform_env
+
+    apply_jax_platform_env()
+
     from ..config import setup_daemon_config
     from ..daemon import spawn_daemon
     from ..utils.logging import setup_logging

@@ -18,10 +18,85 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from .service import ApiError, V1Service
-from .types import GetRateLimitsRequest, UpdatePeerGlobal
+import numpy as np
+
+from .service import ApiError, ColumnarResult, IngressColumns, V1Service
+from .types import (
+    Algorithm,
+    GetRateLimitsRequest,
+    UpdatePeerGlobal,
+    _parse_behavior,
+)
 
 _GRPC_CODES = {"InvalidArgument": 3, "OutOfRange": 11, "Internal": 13}
+
+_STATUS_NAMES = ("UNDER_LIMIT", "OVER_LIMIT")
+
+
+def parse_columns(items: list) -> IngressColumns:
+    """Parse a JSON `requests` array straight into ingress columns (no
+    per-request dataclasses — the gateway's half of the zero-dataclass
+    hot path)."""
+    n = len(items)
+    names: list = [""] * n
+    uks: list = [""] * n
+    algo = np.zeros(n, dtype=np.int32)
+    behavior = np.zeros(n, dtype=np.int32)
+    hits = np.zeros(n, dtype=np.int64)
+    limit = np.zeros(n, dtype=np.int64)
+    duration = np.zeros(n, dtype=np.int64)
+    for i, d in enumerate(items):
+        names[i] = d.get("name", "")
+        uks[i] = d.get("uniqueKey") or d.get("unique_key") or ""
+        v = d.get("hits")
+        if v:
+            hits[i] = int(v)
+        v = d.get("limit")
+        if v:
+            limit[i] = int(v)
+        v = d.get("duration")
+        if v:
+            duration[i] = int(v)
+        v = d.get("algorithm")
+        if v:
+            # Same validation as the dataclass path (_parse_enum): an
+            # out-of-range value must fail identically at every batch size.
+            if isinstance(v, str) and v in Algorithm.__members__:
+                algo[i] = int(Algorithm[v])
+            else:
+                algo[i] = int(Algorithm(int(v)))
+        v = d.get("behavior")
+        if v:
+            behavior[i] = v if isinstance(v, int) else _parse_behavior(v)
+    return IngressColumns(
+        names=names, unique_keys=uks, algorithm=algo, behavior=behavior,
+        hits=hits, limit=limit, duration=duration,
+    )
+
+
+def render_columns(result: ColumnarResult) -> dict:
+    """Serialize a ColumnarResult to the gateway JSON payload directly
+    from the arrays."""
+    status = result.status
+    limit = result.limit
+    remaining = result.remaining
+    reset = result.reset_time
+    ov = result.overrides
+    out = []
+    for i in range(result.n):
+        r = ov.get(i)
+        if r is not None:
+            out.append(r.to_json())
+        else:
+            out.append(
+                {
+                    "status": _STATUS_NAMES[status[i]],
+                    "limit": str(limit[i]),
+                    "remaining": str(remaining[i]),
+                    "resetTime": str(reset[i]),
+                }
+            )
+    return {"responses": out}
 
 
 class _GatewayHTTPServer(ThreadingHTTPServer):
@@ -129,9 +204,18 @@ def _make_handler(service: V1Service):
             try:
                 body = self._read_json()
                 if self.path == "/v1/GetRateLimits":
-                    req = GetRateLimitsRequest.from_json(body)
-                    resp = service.get_rate_limits(req)
-                    self._send_json(200, resp.to_json())
+                    items = body.get("requests", [])
+                    if len(items) == 1:
+                        # Single-item requests keep the dataclass path:
+                        # it rides the ingress LocalBatcher so
+                        # concurrent clients coalesce into one dispatch.
+                        req = GetRateLimitsRequest.from_json(body)
+                        resp = service.get_rate_limits(req)
+                        self._send_json(200, resp.to_json())
+                    else:
+                        cols = parse_columns(items)
+                        result = service.get_rate_limits_columns(cols)
+                        self._send_json(200, render_columns(result))
                 elif self.path == "/v1/peer.GetPeerRateLimits":
                     req = GetRateLimitsRequest.from_json(body)
                     resp = service.get_peer_rate_limits(req)

@@ -126,10 +126,18 @@ def _abort_api_error(context: grpc.ServicerContext, e: ApiError):
 def _v1_handler(service: V1Service) -> grpc.GenericRpcHandler:
     def get_rate_limits(request: pb.GetRateLimitsReq, context) -> pb.GetRateLimitsResp:
         try:
-            resp = service.get_rate_limits(wire.get_rate_limits_req_from_pb(request))
+            if len(request.requests) == 1:
+                # Single-item requests keep the dataclass path: it rides
+                # the ingress LocalBatcher so concurrent clients
+                # coalesce into one device dispatch.
+                resp = service.get_rate_limits(
+                    wire.get_rate_limits_req_from_pb(request)
+                )
+                return wire.get_rate_limits_resp_to_pb(resp)
+            result = service.get_rate_limits_columns(wire.columns_from_pb(request))
+            return wire.columns_to_pb(result)
         except ApiError as e:
             _abort_api_error(context, e)
-        return wire.get_rate_limits_resp_to_pb(resp)
 
     def health_check(request: pb.HealthCheckReq, context) -> pb.HealthCheckResp:
         return wire.health_to_pb(service.health_check())

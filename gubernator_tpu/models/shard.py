@@ -339,13 +339,73 @@ def _pad(src: np.ndarray, padded: int, dtype) -> np.ndarray:
     return out
 
 
+def narrow_ok(cols: "_Columns", now_ms: int) -> bool:
+    """True when every value column fits the int32 wire
+    (buckets.apply_rounds32 preconditions)."""
+    hi = _I32_MAX
+    for a in (cols.hits, cols.limit, cols.duration):
+        if a.size and (int(a.min()) < 0 or int(a.max()) > hi):
+            return False
+    mask = cols.greg_duration != 0
+    if mask.any():
+        d = cols.greg_expire[mask] - now_ms
+        if int(d.min()) < 0 or int(d.max()) > hi or int(cols.greg_duration.max()) > hi:
+            return False
+    return True
+
+
+def decode_narrow(table, keys, slots, pn, now_ms: int, passthrough_exp):
+    """Decode one narrow-wire packed result (i32[4, n] lanes).
+
+    -2 keep-sentinel lanes reconstruct the device's pre-THIS-batch
+    expiry.  A sentinel value is unrepresentable (>i32 delta), which
+    requires a stored duration the narrow wire also can't carry — so no
+    in-flight NARROW batch can have written it, and any narrow request
+    on such a key triggers duration-change re-expiry instead of a
+    pass-through.  Hence the value always predates every in-flight
+    batch and the dispatch-time snapshot is correct even if a later
+    batch's all-pending eviction fallback steals the slot and zeroes
+    the mirror before this resolve.  Defense in depth: when the slot
+    still maps this batch's key, prefer the resolve-time table value
+    (older in-flight commits have folded in by now via the FIFO drain).
+    """
+    te = passthrough_exp
+    sent = np.nonzero(pn[2] == -2)[0]
+    if sent.size:
+        te = passthrough_exp.copy()
+        cur = table.get_expire_bulk(slots)
+        for j in sent:
+            if table.get_slot(keys[j]) == slots[j]:
+                te[j] = cur[j]
+    return buckets.unpack_output32(pn, now_ms, te)
+
+
+def make_columns(algorithm, behavior, hits, limit, duration, n,
+                 greg_expire=None, greg_duration=None) -> "_Columns":
+    """Coerce caller-provided arrays into contiguous kernel columns."""
+    cols = _Columns(0)
+    cols.algo = np.ascontiguousarray(algorithm, dtype=np.int32)
+    cols.behavior = np.ascontiguousarray(behavior, dtype=np.int32)
+    cols.hits = np.ascontiguousarray(hits, dtype=np.int64)
+    cols.limit = np.ascontiguousarray(limit, dtype=np.int64)
+    cols.duration = np.ascontiguousarray(duration, dtype=np.int64)
+    z = np.zeros(n, dtype=np.int64)
+    cols.greg_expire = (
+        z if greg_expire is None else np.ascontiguousarray(greg_expire, np.int64)
+    )
+    cols.greg_duration = (
+        z if greg_duration is None else np.ascontiguousarray(greg_duration, np.int64)
+    )
+    return cols
+
+
 class ColumnsHandle:
     """Deferred result of one pipelined columnar batch
     (ShardStore.apply_columns_async).  Handles resolve strictly in
     dispatch order — result() first drains every older in-flight batch
     so table commits never reorder."""
 
-    def __init__(self, store: "ShardStore", resolve_fn, limit_col):
+    def __init__(self, store, resolve_fn, limit_col):
         self._store = store
         self._resolve_fn = resolve_fn
         self._limit = limit_col
@@ -369,6 +429,62 @@ class ColumnsHandle:
         if not self.done:
             self._store._drain_until(self)
         return self._value
+
+
+class ColumnarPipeline:
+    """Mixin: the FIFO of in-flight columnar batches plus the two-lock
+    discipline that lets INGRESS THREADS pipeline.
+
+    Locks, in acquisition order (never the reverse):
+      * `_drain_lock` — serializes resolvers; held across the blocking
+        device readback so results commit strictly in dispatch order.
+      * `_lock` (the store mutation RLock) — guards table/state/device
+        buffers; taken by dispatchers for planning+enqueue and by
+        resolvers ONLY for the post-readback decode/commit.
+
+    The payoff: while one thread blocks on batch i's device->host
+    transfer (holding only `_drain_lock`), another thread can plan and
+    enqueue batch i+1 under `_lock`.  With a remote device every
+    readback is a full network RTT, so this overlap — not kernel speed —
+    decides service-tier throughput.  The pipelined staleness semantics
+    are unchanged from single-threaded async dispatch: planning reads
+    table expiry that may lag by the unresolved depth, and the kernel
+    revalidates expiry device-side.
+    """
+
+    def _init_pipeline(self) -> None:
+        self._inflight: "deque[ColumnsHandle]" = deque()
+        self._drain_lock = threading.Lock()
+
+    def _drain_until(self, handle: "ColumnsHandle") -> None:
+        with self._drain_lock:
+            if handle.done:
+                return  # a concurrent drain already resolved it
+            while self._inflight:
+                h = self._inflight.popleft()
+                h._do_resolve()
+                if h is handle:
+                    return
+            if not handle.done:  # not in the deque (already popped elsewhere)
+                handle._do_resolve()
+
+    def _drain_all(self) -> None:
+        with self._drain_lock:
+            while self._inflight:
+                self._inflight.popleft()._do_resolve()
+
+    def _drain_then_lock(self) -> None:
+        """Acquire the store lock with the pipeline empty: non-columnar
+        mutators (dataclass apply, snapshot, loader, GLOBAL sync) must
+        observe every older batch's table commits first.  Loops because
+        a concurrent dispatcher can enqueue between the drain and the
+        acquire."""
+        while True:
+            self._drain_all()
+            self._lock.acquire()
+            if not self._inflight:
+                return
+            self._lock.release()
 
 
 def build_round_arrays(chunk: Sequence[_Prepared], padded: int) -> Tuple[np.ndarray, ...]:
@@ -395,7 +511,7 @@ def build_round_arrays(chunk: Sequence[_Prepared], padded: int) -> Tuple[np.ndar
     return slot, exists, algo, behavior, hits, limit, duration, greg_expire, greg_duration
 
 
-class ShardStore:
+class ShardStore(ColumnarPipeline):
     """Bucket table for one shard, pinned to (at most) one device.
 
     `store` is the optional persistence SPI (gubernator_tpu.store.Store):
@@ -429,31 +545,34 @@ class ShardStore:
         self.state = state
         # host mirror of per-slot algorithm, for store-SPI removal detection
         self.algo_mirror = np.zeros(capacity, dtype=np.int32)
-        # FIFO of unresolved pipelined batches (apply_columns_async)
-        self._inflight: "deque[ColumnsHandle]" = deque()
+        self._init_pipeline()  # FIFO of unresolved pipelined batches
 
     # ------------------------------------------------------------------
     def apply(
         self, requests: Sequence[RateLimitRequest], now_ms: int
     ) -> List[RateLimitResponse]:
         """Evaluate a batch; responses come back in request order."""
-        with self._lock:
-            return self._apply_locked(requests, now_ms)
-
-    def _apply_locked(self, requests, now_ms):
         responses: List[Optional[RateLimitResponse]] = [None] * len(requests)
         if self._native and self.store is None:
+            # Rides the columnar pipeline: dispatch under the store
+            # lock, resolve outside it (ColumnarPipeline ordering).
             self._apply_native(requests, now_ms, responses)
             return [r if r is not None else RateLimitResponse() for r in responses]
-        prepared = prepare_requests(requests, now_ms, responses)
-        resolver = self._store_resolver(now_ms) if self.store is not None else None
-        planner = RoundPlanner(self.table, prepared, now_ms, resolver=resolver)
-        while True:
-            chunk = planner.next_chunk()
-            if not chunk:
-                break
-            self._run_round(chunk, now_ms, responses)
-        return [r if r is not None else RateLimitResponse() for r in responses]
+        # Store-SPI / fallback path: interleaved per-round host
+        # callbacks need the lock across the whole batch.
+        self._drain_then_lock()
+        try:
+            prepared = prepare_requests(requests, now_ms, responses)
+            resolver = self._store_resolver(now_ms) if self.store is not None else None
+            planner = RoundPlanner(self.table, prepared, now_ms, resolver=resolver)
+            while True:
+                chunk = planner.next_chunk()
+                if not chunk:
+                    break
+                self._run_round(chunk, now_ms, responses)
+            return [r if r is not None else RateLimitResponse() for r in responses]
+        finally:
+            self._lock.release()
 
     # ------------------------------------------------------------------
     # Native (C++) fast path: resolve + round-plan in host_runtime.cpp,
@@ -498,27 +617,17 @@ class ShardStore:
         (buckets.apply_rounds), and all outputs come back in ONE packed
         device->host transfer.  Returns (status, remaining, reset_time)
         arrays aligned to keys."""
-        handle = ColumnsHandle(
-            self, self._dispatch_columns(keys, cols, now_ms), cols.limit
-        )
-        self._inflight.append(handle)
+        with self._lock:
+            handle = ColumnsHandle(
+                self, self._dispatch_columns(keys, cols, now_ms), cols.limit
+            )
+            self._inflight.append(handle)
         r = handle.result()
         return r["status"], r["remaining"], r["reset_time"]
 
     @staticmethod
     def _narrow_ok(cols: "_Columns", now_ms: int) -> bool:
-        """True when every value column fits the int32 wire
-        (buckets.apply_rounds32 preconditions)."""
-        hi = _I32_MAX
-        for a in (cols.hits, cols.limit, cols.duration):
-            if a.size and (int(a.min()) < 0 or int(a.max()) > hi):
-                return False
-        mask = cols.greg_duration != 0
-        if mask.any():
-            d = cols.greg_expire[mask] - now_ms
-            if int(d.min()) < 0 or int(d.max()) > hi or int(cols.greg_duration.max()) > hi:
-                return False
-        return True
+        return narrow_ok(cols, now_ms)
 
     def _dispatch_columns(self, keys: List[str], cols: "_Columns", now_ms: int):
         """Plan + enqueue one columnar batch WITHOUT blocking on the
@@ -591,35 +700,15 @@ class ShardStore:
             )
 
         def resolve():
+            # The blocking readback happens OUTSIDE the store lock (the
+            # caller holds only _drain_lock): dispatchers keep planning
+            # while this thread waits on the device (ColumnarPipeline).
+            packed_np = np.asarray(packed)
             with self._lock:
-                packed_np = np.asarray(packed)  # the one blocking transfer
                 if narrow:
-                    pn = packed_np[:, :n]
-                    te = passthrough_exp
-                    # -2 keep-sentinel lanes reconstruct the device's
-                    # pre-THIS-batch expiry.  A sentinel value is
-                    # unrepresentable (>i32 delta), which requires a
-                    # stored duration the narrow wire also can't carry —
-                    # so no in-flight NARROW batch can have written it,
-                    # and any narrow request on such a key triggers
-                    # duration-change re-expiry instead of a pass-through.
-                    # Hence the value always predates every in-flight
-                    # batch and the dispatch-time snapshot is correct even
-                    # if a later batch's all-pending eviction fallback
-                    # steals the slot and zeroes the mirror before this
-                    # resolve.  Defense in depth: when the slot still maps
-                    # this batch's key, prefer the resolve-time table
-                    # value (older in-flight commits have folded in by
-                    # now via the FIFO drain).
-                    sent = np.nonzero(pn[2] == -2)[0]
-                    if sent.size:
-                        te = passthrough_exp.copy()
-                        cur = self.table.get_expire_bulk(slots)
-                        for j in sent:
-                            if self.table.get_slot(keys[j]) == slots[j]:
-                                te[j] = cur[j]
-                    status, removed, remaining, reset, new_exp = buckets.unpack_output32(
-                        pn, now_ms, te
+                    status, removed, remaining, reset, new_exp = decode_narrow(
+                        self.table, keys, slots, packed_np[:, :n], now_ms,
+                        passthrough_exp,
                     )
                 else:
                     status, removed, remaining, reset, new_exp = buckets.unpack_output(
@@ -630,6 +719,11 @@ class ShardStore:
                 return status, remaining, reset
 
         return resolve
+
+    @property
+    def supports_columns(self) -> bool:
+        """True when the zero-dataclass bulk path is usable."""
+        return self._native and self.store is None
 
     def apply_columns(
         self,
@@ -700,32 +794,8 @@ class ShardStore:
             raise RuntimeError(
                 "apply_columns requires the native host runtime and no Store SPI"
             )
-        cols = _Columns(0)
-        cols.algo = np.ascontiguousarray(algorithm, dtype=np.int32)
-        cols.behavior = np.ascontiguousarray(behavior, dtype=np.int32)
-        cols.hits = np.ascontiguousarray(hits, dtype=np.int64)
-        cols.limit = np.ascontiguousarray(limit, dtype=np.int64)
-        cols.duration = np.ascontiguousarray(duration, dtype=np.int64)
-        z = np.zeros(n, dtype=np.int64)
-        cols.greg_expire = (
-            z if greg_expire is None else np.ascontiguousarray(greg_expire, np.int64)
-        )
-        cols.greg_duration = (
-            z if greg_duration is None else np.ascontiguousarray(greg_duration, np.int64)
-        )
-        return cols
-
-    def _drain_until(self, handle: "ColumnsHandle") -> None:
-        with self._lock:
-            if handle.done:
-                return  # a concurrent drain already resolved it
-            while self._inflight:
-                h = self._inflight.popleft()
-                h._do_resolve()
-                if h is handle:
-                    return
-            if not handle.done:  # not in the deque (already popped elsewhere)
-                handle._do_resolve()
+        return make_columns(algorithm, behavior, hits, limit, duration, n,
+                            greg_expire, greg_duration)
 
     # ------------------------------------------------------------------
     # Store SPI integration
@@ -744,21 +814,27 @@ class ShardStore:
 
     def load_item(self, item) -> None:
         """Loader.Load path: place one persisted item (gubernator.go:78-90)."""
-        with self._lock:
+        self._drain_then_lock()
+        try:
             slot, _ = self.table.lookup_or_assign(item.key, 0)
             self._inject(slot, item)
+        finally:
+            self._lock.release()
 
     def snapshot_items(self):
         """Loader.Save path: every mapped slot as a CacheItem
-        (gubernator.go:93-111); materialized under the lock so apply()
-        cannot swap buffers mid-snapshot."""
-        with self._lock:
+        (gubernator.go:93-111); drains in-flight batches first so the
+        snapshot reflects every dispatched batch's committed state."""
+        self._drain_then_lock()
+        try:
             keys = self.table.keys()
             if not keys:
                 return []
             slots = [self.table.get_slot(k) for k in keys]
             rows = buckets.read_rows(self.state, np.asarray(slots, np.int32))
             return _rows_to_items(keys, rows)
+        finally:
+            self._lock.release()
 
 
 

@@ -87,10 +87,17 @@ class ReplicatedConsistentHash:
         return self._vnode_owner[idx]
 
     def get_batch(self, keys: Sequence[str]) -> List[str]:
-        """Vectorized owner lookup for a whole batch of keys."""
+        """Vectorized owner lookup for a whole batch of keys.  The two
+        stock hash functions hash the whole batch in the C++ runtime
+        (native.fnv1_batch); custom hash_fns fall back per key."""
         if not self._peers:
             raise RuntimeError("unable to pick a peer; pool is empty")
-        hs = np.array([self.hash_fn(k) for k in keys], dtype=np.uint64)
+        if self.hash_fn in (_fnv1_str, _fnv1a_str):
+            from .. import native
+
+            hs = native.fnv1_batch(keys, variant_1a=self.hash_fn is _fnv1a_str)
+        else:
+            hs = np.array([self.hash_fn(k) for k in keys], dtype=np.uint64)
         idxs = np.searchsorted(self._vnode_hashes, hs, side="left")
         n = len(self._vnode_owner)
         return [self._vnode_owner[i if i < n else 0] for i in idxs]

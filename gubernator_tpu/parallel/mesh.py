@@ -36,11 +36,16 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.shard import (
+    ColumnarPipeline,
+    ColumnsHandle,
     RoundPlanner,
     _rows_to_items,
     build_round_arrays,
+    decode_narrow,
     item_to_rows,
+    make_columns,
     make_store_resolver,
+    narrow_ok,
     pad_size,
     plan_grouped_python,
     prepare_requests,
@@ -136,6 +141,28 @@ def _answer_rounds_jit(state, gcols, batch, extra, round_id, n_rounds, now):
 
 
 @partial(jax.jit, donate_argnums=0)
+def _rounds32_mesh_jit(state, batch32, round_id, n_rounds, now):
+    """Narrow-wire fused rounds across all shards: the columnar ingress
+    kernel (no GLOBAL lanes, so gcols never ride the dispatch).  One
+    i32[S, 4, B] packed result."""
+
+    def one(state_s, batch_s, rid_s):
+        return buckets.apply_rounds32(state_s, batch_s, rid_s, n_rounds, now)
+
+    return jax.vmap(one)(state, batch32, round_id)
+
+
+@partial(jax.jit, donate_argnums=0)
+def _rounds64_mesh_jit(state, batch, round_id, n_rounds, now):
+    """Wide-wire twin of _rounds32_mesh_jit (values exceeding int32)."""
+
+    def one(state_s, batch_s, rid_s):
+        return buckets.apply_rounds(state_s, batch_s, rid_s, n_rounds, now)
+
+    return jax.vmap(one)(state, batch, round_id)
+
+
+@partial(jax.jit, donate_argnums=0)
 def _set_replica_jit(gcols, gslots, status, limit, remaining, reset):
     return jax.vmap(
         global_ops.set_replica, in_axes=(0, None, None, None, None, None)
@@ -219,6 +246,23 @@ def _locked(fn):
     return wrapper
 
 
+def _drained_locked(fn):
+    """_locked plus a pipeline drain first: mutators that read or commit
+    the slot tables / state wholesale must observe every in-flight
+    columnar batch's commits (ColumnarPipeline._drain_then_lock)."""
+
+    def wrapper(self, *args, **kwargs):
+        self._drain_then_lock()
+        try:
+            return fn(self, *args, **kwargs)
+        finally:
+            self._lock.release()
+
+    wrapper.__name__ = fn.__name__
+    wrapper.__doc__ = fn.__doc__
+    return wrapper
+
+
 @dataclass
 class SyncResult:
     """Host-tier work produced by one GLOBAL sync collective."""
@@ -231,7 +275,7 @@ class SyncResult:
         return len(self.broadcasts)
 
 
-class MeshBucketStore:
+class MeshBucketStore(ColumnarPipeline):
     """Bucket tables for all local shards, sharded over a device mesh.
 
     The host keeps one SlotTable per shard; requests are bucketed by
@@ -274,11 +318,9 @@ class MeshBucketStore:
         # lookup/commit runs at C++ hash-map speed.
         from .. import native as _native
 
-        _table = (
-            _native.NativeSlotTable
-            if use_native and _native.available()
-            else SlotTable
-        )
+        self._native = use_native and _native.available()
+        self._init_pipeline()  # FIFO of in-flight columnar batches
+        _table = _native.NativeSlotTable if self._native else SlotTable
         self.tables = [_table(capacity_per_shard) for _ in range(self.n_shards)]
         self.algo_mirror = [
             np.zeros(capacity_per_shard, dtype=np.int32) for _ in range(self.n_shards)
@@ -307,7 +349,7 @@ class MeshBucketStore:
         return jax.tree.map(lambda c: jax.device_put(c, self._sharding), stacked)
 
     # ------------------------------------------------------------------
-    @_locked
+    @_drained_locked
     def apply(
         self,
         requests: Sequence[RateLimitRequest],
@@ -371,6 +413,196 @@ class MeshBucketStore:
                 self._run_round(chunks, now_ms, responses)
 
         return [r if r is not None else RateLimitResponse() for r in responses]
+
+    # ------------------------------------------------------------------
+    # Columnar bulk ingress (zero-dataclass hot path)
+    # ------------------------------------------------------------------
+    @property
+    def supports_columns(self) -> bool:
+        """True when the zero-dataclass bulk path is usable (native host
+        runtime present, no synchronous Store SPI callbacks)."""
+        return self._native and self.store is None
+
+    def apply_columns(
+        self, keys, algorithm, behavior, hits, limit, duration, now_ms: int,
+        greg_expire=None, greg_duration=None,
+    ) -> dict:
+        """Columnar bulk API over the whole mesh: keys bucket onto
+        shards by the static shardmap (fnv1a % n_shards, batched in
+        C++), each shard's stream round-plans in its own C++ table, and
+        ALL shards' rounds run in ONE fused dispatch.  Returns a dict of
+        numpy arrays (status/limit/remaining/reset_time) aligned with
+        `keys`.  GLOBAL lanes are rejected — their replica-cache
+        semantics live on the dataclass path (`apply`)."""
+        return self.apply_columns_async(
+            keys, algorithm, behavior, hits, limit, duration, now_ms,
+            greg_expire, greg_duration,
+        ).result()
+
+    def apply_columns_async(
+        self, keys, algorithm, behavior, hits, limit, duration, now_ms: int,
+        greg_expire=None, greg_duration=None,
+    ) -> ColumnsHandle:
+        """Pipelined apply_columns (see ShardStore.apply_columns_async):
+        dispatch returns immediately; `handle.result()` blocks on the
+        one packed readback.  Concurrent ingress threads overlap host
+        planning with device compute via the ColumnarPipeline locks."""
+        if not (self._native and self.store is None):
+            raise RuntimeError(
+                "apply_columns requires the native host runtime and no Store SPI"
+            )
+        cols = make_columns(
+            algorithm, behavior, hits, limit, duration, len(keys),
+            greg_expire, greg_duration,
+        )
+        if (cols.behavior & int(Behavior.GLOBAL)).any():
+            raise ValueError("GLOBAL lanes must take the dataclass path (apply)")
+        with self._lock:
+            handle = ColumnsHandle(
+                self, self._dispatch_columns(keys, cols, now_ms), cols.limit
+            )
+            self._inflight.append(handle)
+        return handle
+
+    def _dispatch_columns(self, keys, cols, now_ms: int):
+        """Shard-bucket + plan + enqueue one columnar batch without
+        blocking; returns the resolve() closure (caller holds the store
+        lock for this dispatch phase, ColumnarPipeline discipline)."""
+        from .. import native as _native
+
+        S = self.n_shards
+        n = len(keys)
+        if S == 1:
+            order = None
+            shard_keys = [list(keys)]
+            shard_cols = [cols]
+            counts = np.array([n])
+        else:
+            sidx = (
+                _native.fnv1_batch(keys, variant_1a=True) % np.uint64(S)
+            ).astype(np.int64)
+            order = np.argsort(sidx, kind="stable")
+            counts = np.bincount(sidx, minlength=S)
+            bounds = np.zeros(S + 1, dtype=np.int64)
+            np.cumsum(counts, out=bounds[1:])
+            sorted_keys = [keys[i] for i in order]
+            shard_keys = [sorted_keys[bounds[s]:bounds[s + 1]] for s in range(S)]
+            shard_cols = []
+            for s in range(S):
+                sl = order[bounds[s]:bounds[s + 1]]
+                shard_cols.append(make_columns(
+                    cols.algo[sl], cols.behavior[sl], cols.hits[sl],
+                    cols.limit[sl], cols.duration[sl], len(sl),
+                    cols.greg_expire[sl], cols.greg_duration[sl],
+                ))
+
+        planners: List[object] = [None] * S
+        plans: List[object] = [None] * S
+        n_rounds = 1
+        maxb = 1
+        reset_mask = int(Behavior.RESET_REMAINING)
+        for s in range(S):
+            m = int(counts[s])
+            if m == 0:
+                continue
+            pl = _native.NativeBatchPlanner(self.tables[s], shard_keys[s], now_ms)
+            rid, slots, exists, occ, write, nr = pl.plan_grouped(
+                shard_cols[s], reset_mask
+            )
+            planners[s] = pl
+            plans[s] = (rid, slots, exists, occ, write)
+            n_rounds = max(n_rounds, nr)
+            maxb = max(maxb, m)
+
+        padded = pad_size(maxb)
+        narrow = narrow_ok(cols, now_ms)
+        slot_a = np.full((S, padded), -1, dtype=np.int32)
+        rid_a = np.zeros((S, padded), dtype=np.int32)
+        ex_a = np.zeros((S, padded), dtype=bool)
+        occ_a = np.zeros((S, padded), dtype=np.int32)
+        wr_a = np.zeros((S, padded), dtype=bool)
+        vdt = np.int32 if narrow else np.int64
+        algo_a = np.zeros((S, padded), dtype=np.int32)
+        beh_a = np.zeros((S, padded), dtype=np.int32)
+        hits_a = np.zeros((S, padded), dtype=vdt)
+        lim_a = np.zeros((S, padded), dtype=vdt)
+        dur_a = np.zeros((S, padded), dtype=vdt)
+        ge_a = np.zeros((S, padded), dtype=vdt)
+        gd_a = np.zeros((S, padded), dtype=vdt)
+        passthrough = [None] * S
+        for s in range(S):
+            m = int(counts[s])
+            if m == 0:
+                continue
+            rid, slots, exists, occ, write = plans[s]
+            c = shard_cols[s]
+            slot_a[s, :m] = slots
+            rid_a[s, :m] = rid
+            ex_a[s, :m] = exists
+            occ_a[s, :m] = occ
+            wr_a[s, :m] = write
+            algo_a[s, :m] = c.algo
+            beh_a[s, :m] = c.behavior
+            hits_a[s, :m] = c.hits
+            lim_a[s, :m] = c.limit
+            dur_a[s, :m] = c.duration
+            if narrow:
+                ge_a[s, :m] = np.where(
+                    c.greg_duration != 0, c.greg_expire - now_ms, 0
+                )
+                passthrough[s] = self.tables[s].get_expire_bulk(slots)
+            else:
+                ge_a[s, :m] = c.greg_expire
+            gd_a[s, :m] = c.greg_duration
+
+        mk = buckets.make_batch32 if narrow else buckets.make_batch
+        batch = mk(
+            slot_a, ex_a, algo_a, beh_a, hits_a, lim_a, dur_a, ge_a, gd_a,
+            occ=occ_a, write=wr_a,
+        )
+        batch = jax.tree.map(lambda a: jax.device_put(a, self._sharding), batch)
+        rid_dev = jax.device_put(jnp.asarray(rid_a), self._sharding)
+        fn = _rounds32_mesh_jit if narrow else _rounds64_mesh_jit
+        self.state, packed = fn(self.state, batch, rid_dev, n_rounds, now_ms)
+
+        def resolve():
+            # Blocking readback outside the store lock (ColumnarPipeline).
+            packed_np = np.asarray(packed)  # [S, 4, padded]
+            status_f = np.empty(n, dtype=np.int32)
+            rem_f = np.empty(n, dtype=np.int64)
+            reset_f = np.empty(n, dtype=np.int64)
+            with self._lock:
+                pos = 0
+                for s in range(S):
+                    m = int(counts[s])
+                    if m == 0:
+                        continue
+                    _, slots, _, _, _ = plans[s]
+                    pn = packed_np[s][:, :m]
+                    if narrow:
+                        st, rm, remaining, reset, new_exp = decode_narrow(
+                            self.tables[s], shard_keys[s], slots, pn, now_ms,
+                            passthrough[s],
+                        )
+                    else:
+                        st, rm, remaining, reset, new_exp = buckets.unpack_output(pn)
+                    planners[s].commit_plan(new_exp, rm)
+                    self.algo_mirror[s][slots] = shard_cols[s].algo
+                    status_f[pos:pos + m] = st
+                    rem_f[pos:pos + m] = remaining
+                    reset_f[pos:pos + m] = reset
+                    pos += m
+            if order is None:
+                return status_f, rem_f, reset_f
+            status = np.empty(n, dtype=np.int32)
+            rem = np.empty(n, dtype=np.int64)
+            reset = np.empty(n, dtype=np.int64)
+            status[order] = status_f
+            rem[order] = rem_f
+            reset[order] = reset_f
+            return status, rem, reset
+
+        return resolve
 
     # ------------------------------------------------------------------
     def _apply_fused(self, by_shard, now_ms: int, responses) -> None:
@@ -530,14 +762,14 @@ class MeshBucketStore:
         for (_, p), item in zip(live, items):
             self.store.on_change(p.req, item)
 
-    @_locked
+    @_drained_locked
     def load_item(self, item) -> None:
         """Loader.Load path (gubernator.go:78-90), routed to the owner shard."""
         s = shard_of_key(item.key, self.n_shards)
         slot, _ = self.tables[s].lookup_or_assign(item.key, 0)
         self._inject(s, slot, item)
 
-    @_locked
+    @_drained_locked
     def snapshot_items(self):
         """Loader.Save path (gubernator.go:93-111) across all shards.
         Materialized under the lock so a concurrent apply cannot swap
@@ -574,7 +806,7 @@ class MeshBucketStore:
         self.gtable.algorithm[g] = int(update.algorithm)
 
     # ------------------------------------------------------------------
-    @_locked
+    @_drained_locked
     def sync_globals(self, now_ms: int) -> "SyncResult":
         """Run one GLOBAL sync collective (the TPU-native stand-in for
         GlobalSyncWait ticks of all three global.go pipelines).
@@ -683,7 +915,6 @@ class MeshBucketStore:
         return result
 
     # ------------------------------------------------------------------
-    @_locked
     def warmup(self, now_ms: int) -> None:
         """Compile the hot programs before serving traffic.  A daemon
         that starts answering RPCs cold pays the first-dispatch XLA
@@ -699,8 +930,14 @@ class MeshBucketStore:
             name="__warmup__", unique_key="__warmup__", hits=0, limit=1,
             duration=1, behavior=Behavior.GLOBAL,
         )
-        self.apply([req], now_ms)  # reentrant: the instance lock is an RLock
+        self.apply([req], now_ms)
         self.sync_globals(now_ms)
+        if self._native and self.store is None:
+            # Compile the columnar ingress kernel too (the gateway/gRPC
+            # hot path); wider batches recompile per pad_size bucket.
+            self.apply_columns(
+                ["__warmup_____warmup__"], [0], [0], [0], [1], [1], now_ms
+            )
 
     def size(self) -> int:
         return sum(len(t) for t in self.tables)

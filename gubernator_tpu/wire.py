@@ -106,6 +106,51 @@ def peer_rate_limits_resp_from_pb(m: peers_pb.GetPeerRateLimitsResp) -> GetRateL
     return GetRateLimitsResponse(responses=[resp_from_pb(r) for r in m.rate_limits])
 
 
+# ---- columnar fast path ---------------------------------------------
+def columns_from_pb(m: pb.GetRateLimitsReq):
+    """Parse the pb batch straight into ingress columns (the gRPC half
+    of the zero-dataclass hot path)."""
+    import numpy as np
+
+    from .service import IngressColumns
+
+    items = m.requests
+    n = len(items)
+    return IngressColumns(
+        names=[r.name for r in items],
+        unique_keys=[r.unique_key for r in items],
+        algorithm=np.fromiter((r.algorithm for r in items), np.int32, count=n),
+        behavior=np.fromiter((r.behavior for r in items), np.int32, count=n),
+        hits=np.fromiter((r.hits for r in items), np.int64, count=n),
+        limit=np.fromiter((r.limit for r in items), np.int64, count=n),
+        duration=np.fromiter((r.duration for r in items), np.int64, count=n),
+    )
+
+
+def columns_to_pb(result) -> pb.GetRateLimitsResp:
+    """Serialize a service.ColumnarResult directly from its arrays."""
+    ov = result.overrides
+    status = result.status
+    limit = result.limit
+    remaining = result.remaining
+    reset = result.reset_time
+    out = []
+    for i in range(result.n):
+        r = ov.get(i)
+        if r is not None:
+            out.append(resp_to_pb(r))
+        else:
+            out.append(
+                pb.RateLimitResp(
+                    status=int(status[i]),
+                    limit=int(limit[i]),
+                    remaining=int(remaining[i]),
+                    reset_time=int(reset[i]),
+                )
+            )
+    return pb.GetRateLimitsResp(responses=out)
+
+
 # ---- GLOBAL broadcast ------------------------------------------------
 def update_global_to_pb(u: UpdatePeerGlobal) -> peers_pb.UpdatePeerGlobal:
     return peers_pb.UpdatePeerGlobal(

@@ -163,6 +163,30 @@ def test_forwarding_sets_owner_metadata(cluster):
     assert rl.remaining == 3
 
 
+def test_columnar_batch_mixes_local_and_forwarded(cluster):
+    """A multi-item request (the columnar gateway path) whose keys are
+    owned by DIFFERENT daemons: locally-owned lanes answer columnar,
+    foreign lanes forward — all in one call, each lane correct."""
+    entry = cluster.daemons[0]
+    reqs = [mk("test_colfwd", f"{i}_cf", limit=7) for i in range(20)]
+    owners = {
+        r.unique_key: entry.service.get_peer(r.hash_key()).info for r in reqs
+    }
+    assert any(o.is_owner for o in owners.values())
+    assert any(not o.is_owner for o in owners.values())
+    client = V1Client(entry.peer_info.http_address)
+    resp = client.get_rate_limits(GetRateLimitsRequest(requests=reqs))
+    assert len(resp.responses) == 20
+    for r, rl in zip(reqs, resp.responses):
+        assert rl.error == ""
+        assert rl.remaining == 6
+        if not owners[r.unique_key].is_owner:
+            assert rl.metadata.get("owner") == owners[r.unique_key].grpc_address
+    # Second pass shows shared state across the same mixed routing.
+    resp = client.get_rate_limits(GetRateLimitsRequest(requests=reqs))
+    assert all(rl.remaining == 5 for rl in resp.responses)
+
+
 def test_health_check(cluster):
     client = client_for(cluster)
     hc = client.health_check()
