@@ -88,6 +88,11 @@ class DaemonConfig:
     # transport when set.  See gubernator_tpu.tls.TLSConfig.
     tls: object = None  # Optional[tls.TLSConfig]
     devices: Optional[list] = None  # jax devices for the mesh (None = all)
+    # Columnar-kernel pad buckets (lane counts) to compile during
+    # startup warmup: each pad_size bucket is a distinct XLA program,
+    # and on a remote device its first dispatch pays a multi-second
+    # executable load — better inside startup than a client deadline.
+    warmup_shapes: List[int] = field(default_factory=lambda: [1])
 
     def resolved_advertise(self) -> str:
         return self.advertise_address or self.listen_address
@@ -178,6 +183,10 @@ def setup_daemon_config(
         merged, "GUBER_GLOBAL_CACHE_SIZE", conf.global_cache_size
     )
     conf.data_center = merged.get("GUBER_DATA_CENTER", "")
+    if merged.get("GUBER_WARMUP_SHAPES"):
+        conf.warmup_shapes = [
+            int(s) for s in merged["GUBER_WARMUP_SHAPES"].split(",") if s.strip()
+        ]
     conf.debug = merged.get("GUBER_DEBUG", "").lower() in ("true", "1", "yes")
     conf.peer_discovery_type = merged.get("GUBER_PEER_DISCOVERY_TYPE", "static")
     if conf.peer_discovery_type not in ("static", "file", "etcd", "member-list", "k8s"):

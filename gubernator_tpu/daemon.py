@@ -72,7 +72,9 @@ class Daemon:
         # Compile the device programs BEFORE accepting traffic: a cold
         # first dispatch (remote-tunnel compiles take tens of seconds)
         # would otherwise land inside a client's RPC deadline.
-        self.service.store.warmup(self.clock.now_ms())
+        self.service.store.warmup(
+            self.clock.now_ms(), warm_shapes=self.conf.warmup_shapes
+        )
         grpc_listen = self.conf.grpc_listen_address
         if not grpc_listen:
             host, _, _ = self.conf.listen_address.partition(":")

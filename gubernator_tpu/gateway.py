@@ -186,8 +186,14 @@ def _make_handler(service: V1Service):
                 return
             try:
                 if self.path == "/v1/HealthCheck":
-                    self._send_json(200, service.health_check().to_json())
+                    with service.metrics.observe_rpc("/pb.gubernator.V1/HealthCheck"):
+                        hc = service.health_check()
+                    self._send_json(200, hc.to_json())
                 elif self.path == "/metrics":
+                    # Collect-on-scrape: refresh the cache gauges from
+                    # the store (the reference's prometheus Collector
+                    # pattern, cache.go:205-218).
+                    service.metrics.observe_cache(service.store)
                     self._send_bytes(
                         200, "text/plain; version=0.0.4", service.metrics.render()
                     )
@@ -204,30 +210,31 @@ def _make_handler(service: V1Service):
             try:
                 body = self._read_json()
                 if self.path == "/v1/GetRateLimits":
-                    items = body.get("requests", [])
-                    if len(items) == 1:
-                        # Single-item requests keep the dataclass path:
-                        # it rides the ingress LocalBatcher so
-                        # concurrent clients coalesce into one dispatch.
-                        req = GetRateLimitsRequest.from_json(body)
-                        resp = service.get_rate_limits(req)
-                        self._send_json(200, resp.to_json())
-                    else:
-                        cols = parse_columns(items)
-                        result = service.get_rate_limits_columns(cols)
-                        self._send_json(200, render_columns(result))
+                    with service.metrics.observe_rpc("/pb.gubernator.V1/GetRateLimits"):
+                        cols = parse_columns(body.get("requests", []))
+                        payload = render_columns(
+                            service.get_rate_limits_columns(cols)
+                        )
+                    self._send_json(200, payload)
                 elif self.path == "/v1/peer.GetPeerRateLimits":
-                    req = GetRateLimitsRequest.from_json(body)
-                    resp = service.get_peer_rate_limits(req)
+                    with service.metrics.observe_rpc(
+                        "/pb.gubernator.PeersV1/GetPeerRateLimits"
+                    ):
+                        req = GetRateLimitsRequest.from_json(body)
+                        resp = service.get_peer_rate_limits(req)
                     # PeersV1 response field is rate_limits (peers.proto:42-45).
                     self._send_json(
                         200, {"rateLimits": [r.to_json() for r in resp.responses]}
                     )
                 elif self.path == "/v1/peer.UpdatePeerGlobals":
-                    updates = [
-                        UpdatePeerGlobal.from_json(u) for u in body.get("globals", [])
-                    ]
-                    service.update_peer_globals(updates)
+                    with service.metrics.observe_rpc(
+                        "/pb.gubernator.PeersV1/UpdatePeerGlobals"
+                    ):
+                        updates = [
+                            UpdatePeerGlobal.from_json(u)
+                            for u in body.get("globals", [])
+                        ]
+                        service.update_peer_globals(updates)
                     self._send_json(200, {})
                 else:
                     self._send_json(

@@ -28,6 +28,35 @@ log = logging.getLogger("gubernator.grpc")
 
 MAX_RECV_BYTES = 1024 * 1024  # daemon.go:88
 
+
+class MetricsInterceptor(grpc.ServerInterceptor):
+    """Per-RPC stats at the TRANSPORT layer (reference GRPCStatsHandler,
+    grpc_stats.go:95-118): every method served by this grpc.Server —
+    including ones added later — is counted and timed under
+    gubernator_grpc_request_counts / gubernator_grpc_request_duration,
+    with no per-handler hand-instrumentation.  An abort() or raise
+    counts as status="1"."""
+
+    def __init__(self, metrics):
+        self.metrics = metrics
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None or self.metrics is None or handler.unary_unary is None:
+            return handler  # only unary-unary methods exist here
+        inner = handler.unary_unary
+        method = handler_call_details.method
+
+        def wrapped(request, context):
+            with self.metrics.observe_rpc(method):
+                return inner(request, context)
+
+        return grpc.unary_unary_rpc_method_handler(
+            wrapped,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+
 _STATUS_CODES = {
     "InvalidArgument": grpc.StatusCode.INVALID_ARGUMENT,
     "OutOfRange": grpc.StatusCode.OUT_OF_RANGE,
@@ -52,6 +81,7 @@ class GrpcServer:
                 ("grpc.max_receive_message_length", MAX_RECV_BYTES),
                 ("grpc.so_reuseport", 0),
             ],
+            interceptors=(MetricsInterceptor(service.metrics),),
         )
         self._server.add_generic_rpc_handlers(
             (_v1_handler(service), _peers_v1_handler(service))
@@ -126,14 +156,6 @@ def _abort_api_error(context: grpc.ServicerContext, e: ApiError):
 def _v1_handler(service: V1Service) -> grpc.GenericRpcHandler:
     def get_rate_limits(request: pb.GetRateLimitsReq, context) -> pb.GetRateLimitsResp:
         try:
-            if len(request.requests) == 1:
-                # Single-item requests keep the dataclass path: it rides
-                # the ingress LocalBatcher so concurrent clients
-                # coalesce into one device dispatch.
-                resp = service.get_rate_limits(
-                    wire.get_rate_limits_req_from_pb(request)
-                )
-                return wire.get_rate_limits_resp_to_pb(resp)
             result = service.get_rate_limits_columns(wire.columns_from_pb(request))
             return wire.columns_to_pb(result)
         except ApiError as e:

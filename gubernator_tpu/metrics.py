@@ -10,6 +10,9 @@ gubernator_async_durations + gubernator_broadcast_durations
 
 from __future__ import annotations
 
+import time
+from contextlib import contextmanager
+
 from prometheus_client import CollectorRegistry, Counter, Gauge, Summary, generate_latest
 
 
@@ -49,6 +52,26 @@ class Metrics:
             "The duration of GLOBAL broadcasts to peers in seconds.",
             registry=self.registry,
         )
+
+    @contextmanager
+    def observe_rpc(self, method: str):
+        """Count + time one RPC by fully-qualified method name — the
+        per-RPC tagging of the reference's stats handler
+        (grpc_stats.go:95-118).  Status label is the WIRE outcome: "0"
+        unless the handler raised (an unhealthy HealthCheck payload is
+        still a successful RPC)."""
+        start = time.perf_counter()
+        status = "0"
+        try:
+            yield
+        except BaseException:
+            status = "1"
+            raise
+        finally:
+            self.request_counts.labels(status=status, method=method).inc()
+            self.request_duration.labels(method=method).observe(
+                time.perf_counter() - start
+            )
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

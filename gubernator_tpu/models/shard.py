@@ -401,32 +401,56 @@ def make_columns(algorithm, behavior, hits, limit, duration, n,
 
 class ColumnsHandle:
     """Deferred result of one pipelined columnar batch
-    (ShardStore.apply_columns_async).  Handles resolve strictly in
-    dispatch order — result() first drains every older in-flight batch
-    so table commits never reorder."""
+    (ShardStore.apply_columns_async).  Commits apply strictly in
+    dispatch order — result() drains every older in-flight batch —
+    but the device->host READBACK runs outside the ordering locks:
+    concurrent waiters overlap their transfers (on a remote device each
+    readback is a full network RTT, so serializing them caps the whole
+    service at 1/RTT batches per second)."""
 
-    def __init__(self, store, resolve_fn, limit_col):
+    def __init__(self, store, fetch_fn, commit_fn, limit_col):
         self._store = store
-        self._resolve_fn = resolve_fn
+        self._fetch_fn = fetch_fn
+        self._commit_fn = commit_fn
+        self._fetched = None
+        self._fetch_lock = threading.Lock()
         self._limit = limit_col
         self._value = None
         self.done = False
 
+    def _fetch(self):
+        """Blocking device readback; idempotent and safe to call from
+        any thread (no store/drain lock held).  Returns None when the
+        handle already resolved (a racing waiter's courtesy fetch)."""
+        with self._fetch_lock:
+            if self.done:
+                return None
+            if self._fetched is None:
+                self._fetched = self._fetch_fn()
+                self._fetch_fn = None
+            return self._fetched
+
     def _do_resolve(self) -> None:
-        status, remaining, reset = self._resolve_fn()
+        packed_np = self._fetch()
+        status, remaining, reset = self._commit_fn(packed_np)
         self._value = {
             "status": status,
             "limit": self._limit,
             "remaining": remaining,
             "reset_time": reset,
         }
-        # Drop the closure: it pins the planner (C++ batch + key
+        # Drop the closures: they pin the planner (C++ batch + key
         # buffer), the device output array, and the padded columns.
-        self._resolve_fn = None
-        self.done = True
+        # done flips under the fetch lock so a racing waiter's _fetch
+        # never sees half-cleared state.
+        self._commit_fn = None
+        with self._fetch_lock:
+            self._fetched = None
+            self.done = True
 
     def result(self) -> dict:
         if not self.done:
+            self._fetch()  # overlap readbacks across waiter threads
             self._store._drain_until(self)
         return self._value
 
@@ -619,15 +643,12 @@ class ShardStore(ColumnarPipeline):
         arrays aligned to keys."""
         with self._lock:
             handle = ColumnsHandle(
-                self, self._dispatch_columns(keys, cols, now_ms), cols.limit
+                self, *self._dispatch_columns(keys, cols, now_ms), cols.limit
             )
             self._inflight.append(handle)
         r = handle.result()
         return r["status"], r["remaining"], r["reset_time"]
 
-    @staticmethod
-    def _narrow_ok(cols: "_Columns", now_ms: int) -> bool:
-        return narrow_ok(cols, now_ms)
 
     def _dispatch_columns(self, keys: List[str], cols: "_Columns", now_ms: int):
         """Plan + enqueue one columnar batch WITHOUT blocking on the
@@ -652,7 +673,7 @@ class ShardStore(ColumnarPipeline):
         occ_col[:n] = occ
         wr_col = np.zeros(padded, dtype=bool)
         wr_col[:n] = write
-        narrow = self._narrow_ok(cols, now_ms)
+        narrow = narrow_ok(cols, now_ms)
         # Snapshot the pass-through expiry NOW: the -2 keep-sentinel means
         # "the kernel left this slot's pre-batch expiry unchanged", and
         # pre-batch is defined at plan time.  A later pipelined batch's
@@ -699,11 +720,13 @@ class ShardStore(ColumnarPipeline):
                 self.state, batch, rid_col, n_rounds, now_ms
             )
 
-        def resolve():
-            # The blocking readback happens OUTSIDE the store lock (the
-            # caller holds only _drain_lock): dispatchers keep planning
-            # while this thread waits on the device (ColumnarPipeline).
-            packed_np = np.asarray(packed)
+        def fetch():
+            # The blocking readback: runs with NO store/drain lock held,
+            # so concurrent waiters overlap transfers and dispatchers
+            # keep planning (ColumnarPipeline).
+            return np.asarray(packed)
+
+        def commit(packed_np):
             with self._lock:
                 if narrow:
                     status, removed, remaining, reset, new_exp = decode_narrow(
@@ -718,7 +741,7 @@ class ShardStore(ColumnarPipeline):
                 self.algo_mirror[slots] = cols.algo
                 return status, remaining, reset
 
-        return resolve
+        return fetch, commit
 
     @property
     def supports_columns(self) -> bool:
@@ -750,7 +773,7 @@ class ShardStore(ColumnarPipeline):
                                   len(keys), greg_expire, greg_duration)
         with self._lock:
             handle = ColumnsHandle(
-                self, self._dispatch_columns(keys, cols, now_ms), cols.limit
+                self, *self._dispatch_columns(keys, cols, now_ms), cols.limit
             )
             self._inflight.append(handle)
         return handle.result()
@@ -783,7 +806,7 @@ class ShardStore(ColumnarPipeline):
                                   len(keys), greg_expire, greg_duration)
         with self._lock:
             handle = ColumnsHandle(
-                self, self._dispatch_columns(keys, cols, now_ms), cols.limit
+                self, *self._dispatch_columns(keys, cols, now_ms), cols.limit
             )
             self._inflight.append(handle)
         return handle

@@ -459,7 +459,7 @@ class MeshBucketStore(ColumnarPipeline):
             raise ValueError("GLOBAL lanes must take the dataclass path (apply)")
         with self._lock:
             handle = ColumnsHandle(
-                self, self._dispatch_columns(keys, cols, now_ms), cols.limit
+                self, *self._dispatch_columns(keys, cols, now_ms), cols.limit
             )
             self._inflight.append(handle)
         return handle
@@ -565,9 +565,12 @@ class MeshBucketStore(ColumnarPipeline):
         fn = _rounds32_mesh_jit if narrow else _rounds64_mesh_jit
         self.state, packed = fn(self.state, batch, rid_dev, n_rounds, now_ms)
 
-        def resolve():
-            # Blocking readback outside the store lock (ColumnarPipeline).
-            packed_np = np.asarray(packed)  # [S, 4, padded]
+        def fetch():
+            # Blocking readback with no ordering locks held: concurrent
+            # waiters overlap transfers (ColumnarPipeline).
+            return np.asarray(packed)  # [S, 4, padded]
+
+        def commit(packed_np):
             status_f = np.empty(n, dtype=np.int32)
             rem_f = np.empty(n, dtype=np.int64)
             reset_f = np.empty(n, dtype=np.int64)
@@ -602,7 +605,7 @@ class MeshBucketStore(ColumnarPipeline):
             reset[order] = reset_f
             return status, rem, reset
 
-        return resolve
+        return fetch, commit
 
     # ------------------------------------------------------------------
     def _apply_fused(self, by_shard, now_ms: int, responses) -> None:
@@ -915,7 +918,7 @@ class MeshBucketStore(ColumnarPipeline):
         return result
 
     # ------------------------------------------------------------------
-    def warmup(self, now_ms: int) -> None:
+    def warmup(self, now_ms: int, warm_shapes: Optional[Sequence[int]] = None) -> None:
         """Compile the hot programs before serving traffic.  A daemon
         that starts answering RPCs cold pays the first-dispatch XLA
         compile (tens of seconds over a remote-device tunnel) inside a
@@ -934,10 +937,19 @@ class MeshBucketStore(ColumnarPipeline):
         self.sync_globals(now_ms)
         if self._native and self.store is None:
             # Compile the columnar ingress kernel too (the gateway/gRPC
-            # hot path); wider batches recompile per pad_size bucket.
-            self.apply_columns(
-                ["__warmup_____warmup__"], [0], [0], [0], [1], [1], now_ms
-            )
+            # hot path).  Each pad_size bucket is its own XLA program,
+            # and on a remote device even a compile-cache HIT pays a
+            # multi-second executable load at first dispatch — so warm
+            # every bucket the deployment expects (`warm_shapes`, lane
+            # counts) during startup, not inside a client's deadline.
+            for lanes in sorted(set(warm_shapes or (1,))):
+                lanes = max(int(lanes), 1)
+                self.apply_columns(
+                    ["__warmup_____warmup__"] * lanes,
+                    np.zeros(lanes, np.int32), np.zeros(lanes, np.int32),
+                    np.zeros(lanes, np.int64), np.ones(lanes, np.int64),
+                    np.ones(lanes, np.int64), now_ms,
+                )
 
     def size(self) -> int:
         return sum(len(t) for t in self.tables)

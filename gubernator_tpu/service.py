@@ -198,6 +198,71 @@ class ColumnarResult:
         )
 
 
+class ColumnarBatcher:
+    """Ingress coalescer for COLUMN-form batches: concurrent multi-item
+    requests inside one BatchWait window (config.go:107-109 semantics)
+    merge into ONE device dispatch; each caller gets back a slice of
+    the shared handle.  The flush thread only dispatches — waiters
+    resolve the handle themselves, so readbacks overlap across callers
+    (ColumnarPipeline).  NO_BATCHING batches bypass the window."""
+
+    MAX_SUBMISSIONS = 64  # x 1000-lane cap each = device batch <= 64k lanes
+
+    def __init__(self, store, behaviors: BehaviorConfig, clock: Clock):
+        self.store = store
+        self.clock = clock
+        self._window = BatchWindow(
+            self._flush, behaviors.batch_wait_s, self.MAX_SUBMISSIONS
+        )
+
+    def submit(self, keys, algo, behavior, hits, limit, duration,
+               greg_expire, greg_duration) -> "Future":
+        fut: Future = Future()
+        if self._window.stopped:
+            fut.set_exception(PeerError(ERR_BATCHER_CLOSED))
+            return fut
+        n = len(keys)
+        ge = np.zeros(n, np.int64) if greg_expire is None else greg_expire
+        gd = np.zeros(n, np.int64) if greg_duration is None else greg_duration
+        self._window.submit(
+            ((keys, algo, behavior, hits, limit, duration, ge, gd), fut)
+        )
+        return fut
+
+    def _flush(self, batch) -> None:
+        try:
+            if len(batch) == 1:
+                (cols, fut) = batch[0]
+                keys = cols[0]
+                arrays = cols[1:]
+            else:
+                keys = []
+                for (c, _) in batch:
+                    keys.extend(c[0])
+                arrays = tuple(
+                    np.concatenate([c[i] for c, _ in batch])
+                    for i in range(1, 8)
+                )
+            algo, beh, hits, limit, duration, ge, gd = arrays
+            handle = self.store.apply_columns_async(
+                keys, algo, beh, hits, limit, duration,
+                self.clock.now_ms(), ge, gd,
+            )
+            lo = 0
+            for (c, fut) in batch:
+                hi = lo + len(c[0])
+                if not fut.done():
+                    fut.set_result((handle, lo, hi))
+                lo = hi
+        except Exception as e:  # noqa: BLE001
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+
+    def stop(self) -> None:
+        self._window.stop()
+
+
 class V1Service:
     def __init__(self, conf: ServiceConfig):
         self.conf = conf
@@ -221,6 +286,7 @@ class V1Service:
                 self.store.load_item(item)
 
         self.local_batcher = LocalBatcher(self.store, conf.behaviors, self.clock)
+        self.columnar_batcher = ColumnarBatcher(self.store, conf.behaviors, self.clock)
         self.global_mgr = GlobalManager(self)
         self.multi_region_mgr = MultiRegionManager(self)
 
@@ -246,25 +312,15 @@ class V1Service:
 
     # ------------------------------------------------------------------
     def get_rate_limits(self, req: GetRateLimitsRequest) -> GetRateLimitsResponse:
-        """gubernator.go:116-227."""
-        start = time.perf_counter()
-        try:
-            if len(req.requests) > MAX_BATCH_SIZE:
-                raise ApiError(
-                    "OutOfRange",
-                    f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'",
-                )
-            resp = self._route(req.requests)
-            self.metrics.request_counts.labels(status="0", method="/pb.gubernator.V1/GetRateLimits").inc()
-            return resp
-        except ApiError:
-            self.metrics.request_counts.labels(status="1", method="/pb.gubernator.V1/GetRateLimits").inc()
-            raise
-        finally:
-            self.metrics.request_duration.labels(
-                method="/pb.gubernator.V1/GetRateLimits"
-            ).observe(time.perf_counter() - start)
-            self.metrics.observe_cache(self.store)
+        """gubernator.go:116-227.  Per-RPC stats live at the transport
+        edges (grpc_server.MetricsInterceptor / the gateway handlers),
+        like the reference's stats handler (grpc_stats.go:95-118)."""
+        if len(req.requests) > MAX_BATCH_SIZE:
+            raise ApiError(
+                "OutOfRange",
+                f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'",
+            )
+        return self._route(req.requests)
 
     # ------------------------------------------------------------------
     # Columnar ingress (zero-dataclass hot path)
@@ -276,25 +332,12 @@ class V1Service:
         with no per-request dataclasses.  GLOBAL / MULTI_REGION /
         remotely-owned lanes fall back to the dataclass path lane-wise.
         """
-        start = time.perf_counter()
-        method = "/pb.gubernator.V1/GetRateLimits"
-        try:
-            if len(cols) > MAX_BATCH_SIZE:
-                raise ApiError(
-                    "OutOfRange",
-                    f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'",
-                )
-            result = self._route_columns(cols)
-            self.metrics.request_counts.labels(status="0", method=method).inc()
-            return result
-        except ApiError:
-            self.metrics.request_counts.labels(status="1", method=method).inc()
-            raise
-        finally:
-            self.metrics.request_duration.labels(method=method).observe(
-                time.perf_counter() - start
+        if len(cols) > MAX_BATCH_SIZE:
+            raise ApiError(
+                "OutOfRange",
+                f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'",
             )
-            self.metrics.observe_cache(self.store)
+        return self._route_columns(cols)
 
     def _route_columns(self, cols: IngressColumns) -> ColumnarResult:
         n = len(cols)
@@ -302,9 +345,11 @@ class V1Service:
         if n == 0:
             return result
         store_columnar = getattr(self.store, "supports_columns", False)
-        if not store_columnar:
-            # No native runtime / Store SPI active: whole batch takes the
-            # dataclass path.
+        if n == 1 or not store_columnar:
+            # Single-item requests ride the dataclass path: its
+            # LocalBatcher coalesces concurrent single-key clients into
+            # one dispatch (the routing policy lives HERE so the HTTP
+            # and gRPC edges cannot diverge).
             resp = self._route([cols.request_at(i) for i in range(n)])
             result.overrides = dict(enumerate(resp.responses))
             return result
@@ -402,19 +447,30 @@ class V1Service:
             for r in agg.values():
                 self.multi_region_mgr.queue_hits(r)
 
-        now = self.clock.now_ms()
-        handle = None
+        pending = None  # (handle, lo, hi) after the dispatch resolves
         fast_idx = np.nonzero(fast)[0]
         if fast_idx.size:
             full = fast_idx.size == n
             sl = slice(None) if full else fast_idx
-            handle = self.store.apply_columns_async(
-                hash_keys if full else [hash_keys[i] for i in fast_idx],
-                cols.algorithm[sl], beh[sl], cols.hits[sl],
-                cols.limit[sl], cols.duration[sl], now,
+            keys_sel = hash_keys if full else [hash_keys[i] for i in fast_idx]
+            args = (
+                keys_sel, cols.algorithm[sl], beh[sl], cols.hits[sl],
+                cols.limit[sl], cols.duration[sl],
                 None if greg_expire is None else greg_expire[sl],
                 None if greg_duration is None else greg_duration[sl],
             )
+            if (beh[sl] & int(Behavior.NO_BATCHING)).any():
+                # Any NO_BATCHING lane opts the dispatch out of the
+                # coalescing window — parity with the dataclass path,
+                # which dispatches multi-item batches immediately.
+                handle = self.store.apply_columns_async(
+                    *args[:6], self.clock.now_ms(), *args[6:]
+                )
+                pending = (handle, 0, fast_idx.size)
+            else:
+                # Concurrent requests inside one BatchWait window share
+                # a single device dispatch (ColumnarBatcher).
+                pending = self.columnar_batcher.submit(*args)
 
         # Slow lanes (GLOBAL / MULTI_REGION / remote owners) ride the
         # dataclass router while the fast dispatch is in flight.
@@ -424,18 +480,32 @@ class V1Service:
             for i, r in zip(slow_idx, resp.responses):
                 result.overrides[int(i)] = r
 
-        if handle is not None:
-            out = handle.result()
+        if pending is not None:
+            try:
+                handle, lo, hi = (
+                    pending.result() if isinstance(pending, Future) else pending
+                )
+                out = handle.result()
+            except Exception as e:  # noqa: BLE001
+                # Per-lane error conversion, like the dataclass batcher
+                # path: a dispatch failure (e.g. shutdown race) must not
+                # 500 lanes whose responses were already computed.
+                for i in fast_idx:
+                    result.overrides[int(i)] = RateLimitResponse(
+                        error=f"while applying rate limit '{hash_keys[int(i)]}' - '{e}'"
+                    )
+                return result
+            sl = slice(lo, hi)
             if fast_idx.size == n:
-                result.status = np.asarray(out["status"], dtype=np.int32)
-                result.limit = np.asarray(out["limit"], dtype=np.int64)
-                result.remaining = np.asarray(out["remaining"], dtype=np.int64)
-                result.reset_time = np.asarray(out["reset_time"], dtype=np.int64)
+                result.status = np.asarray(out["status"][sl], dtype=np.int32)
+                result.limit = np.asarray(out["limit"][sl], dtype=np.int64)
+                result.remaining = np.asarray(out["remaining"][sl], dtype=np.int64)
+                result.reset_time = np.asarray(out["reset_time"][sl], dtype=np.int64)
             else:
-                result.status[fast_idx] = out["status"]
-                result.limit[fast_idx] = out["limit"]
-                result.remaining[fast_idx] = out["remaining"]
-                result.reset_time[fast_idx] = out["reset_time"]
+                result.status[fast_idx] = out["status"][sl]
+                result.limit[fast_idx] = out["limit"][sl]
+                result.remaining[fast_idx] = out["remaining"][sl]
+                result.reset_time[fast_idx] = out["reset_time"][sl]
         return result
 
     def _route(self, requests: Sequence[RateLimitRequest]) -> GetRateLimitsResponse:
@@ -568,56 +638,29 @@ class V1Service:
     def get_peer_rate_limits(self, req: GetRateLimitsRequest) -> GetRateLimitsResponse:
         """Owner-authoritative batch (gubernator.go:275-292); never
         re-forwards."""
-        method = "/pb.gubernator.PeersV1/GetPeerRateLimits"
-        start = time.perf_counter()
-        try:
-            if len(req.requests) > MAX_BATCH_SIZE:
-                self.metrics.request_counts.labels(status="1", method=method).inc()
-                raise ApiError(
-                    "OutOfRange",
-                    f"'PeerRequest.rate_limits' list too large; max size is '{MAX_BATCH_SIZE}'",
-                )
-            now = self.clock.now_ms()
-            resps = self.store.apply(list(req.requests), now)
-            for r in req.requests:
-                if has_behavior(r.behavior, Behavior.MULTI_REGION):
-                    self.multi_region_mgr.queue_hits(r)
-            self.metrics.request_counts.labels(status="0", method=method).inc()
-            return GetRateLimitsResponse(responses=resps)
-        finally:
-            self.metrics.request_duration.labels(method=method).observe(
-                time.perf_counter() - start
+        if len(req.requests) > MAX_BATCH_SIZE:
+            raise ApiError(
+                "OutOfRange",
+                f"'PeerRequest.rate_limits' list too large; max size is '{MAX_BATCH_SIZE}'",
             )
+        now = self.clock.now_ms()
+        resps = self.store.apply(list(req.requests), now)
+        for r in req.requests:
+            if has_behavior(r.behavior, Behavior.MULTI_REGION):
+                self.multi_region_mgr.queue_hits(r)
+        return GetRateLimitsResponse(responses=resps)
 
     def update_peer_globals(self, updates: Sequence[UpdatePeerGlobal]) -> None:
         """gubernator.go:259-272."""
-        method = "/pb.gubernator.PeersV1/UpdatePeerGlobals"
         now = self.clock.now_ms()
         for u in updates:
             self.store.set_replica(u, now)
-        self.metrics.request_counts.labels(status="0", method=method).inc()
 
     # ------------------------------------------------------------------
     def health_check(self) -> HealthCheckResponse:
-        """gubernator.go:295-333.  Counted + timed like every RPC (the
-        reference's stats handler tags all methods, grpc_stats.go:95-118)."""
-        method = "/pb.gubernator.V1/HealthCheck"
-        start = time.perf_counter()
-        # Status label = WIRE outcome, like every other method here (and
-        # the reference's stats handler, grpc_stats.go:95-118): an RPC
-        # that successfully reports an unhealthy payload is still a
-        # successful RPC; only a raise counts as an error.
-        status = "0"
-        try:
-            return self._health_check()
-        except Exception:
-            status = "1"
-            raise
-        finally:
-            self.metrics.request_counts.labels(status=status, method=method).inc()
-            self.metrics.request_duration.labels(method=method).observe(
-                time.perf_counter() - start
-            )
+        """gubernator.go:295-333.  Counted + timed at the transport
+        edges like every RPC (grpc_stats.go:95-118 parity)."""
+        return self._health_check()
 
     def _health_check(self) -> HealthCheckResponse:
         errs: List[str] = []
@@ -686,6 +729,7 @@ class V1Service:
             return
         self._closed = True
         self.local_batcher.stop()
+        self.columnar_batcher.stop()
         self.global_mgr.stop()
         self.multi_region_mgr.stop()
         self._forward_pool.shutdown(wait=False)

@@ -380,17 +380,19 @@ def test_health_check_unhealthy_on_peer_failure(cluster, clock):
 
 def test_health_check_error_label_on_raise(cluster, monkeypatch):
     """A HealthCheck RPC that RAISES is counted with status="1" (wire
-    outcome), matching the reference's per-RPC error tagging
-    (grpc_stats.go:95-118)."""
-    svc = cluster.daemons[0].service
+    outcome) at the transport edge, matching the reference's per-RPC
+    error tagging (grpc_stats.go:95-118)."""
+    daemon = cluster.daemons[0]
+    svc = daemon.service
     counts = svc.metrics.request_counts
     label = counts.labels(status="1", method="/pb.gubernator.V1/HealthCheck")
     before = label._value.get()
     monkeypatch.setattr(
         svc, "_health_check", lambda: (_ for _ in ()).throw(RuntimeError("boom"))
     )
-    with pytest.raises(RuntimeError):
-        svc.health_check()
+    client = V1Client(daemon.peer_info.http_address)
+    with pytest.raises(Exception):
+        client.health_check()  # gateway returns 500; edge counts the raise
     assert label._value.get() == before + 1
 
 

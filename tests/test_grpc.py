@@ -107,6 +107,44 @@ def test_health_check_over_grpc(cluster):
         client.close()
 
 
+def test_grpc_transport_metrics_interceptor(cluster):
+    """Every gRPC RPC is tagged at the TRANSPORT layer (the reference's
+    stats handler tags all methods, grpc_stats.go:95-118): HealthCheck
+    and GetRateLimits counts and durations appear after one call each,
+    and an OutOfRange abort is counted as an error."""
+    d = cluster.daemons[0]
+
+    def count(method, status):
+        c = d.service.metrics.request_counts
+        return c.labels(status=status, method=method)._value.get()
+
+    hc_before = count("/pb.gubernator.V1/HealthCheck", "0")
+    rl_before = count("/pb.gubernator.V1/GetRateLimits", "0")
+    err_before = count("/pb.gubernator.V1/GetRateLimits", "1")
+    client = dial_v1_server(cluster.peers[0].grpc_address)
+    try:
+        client.health_check()
+        client.get_rate_limits(
+            GetRateLimitsRequest(requests=[mk("grpc_metrics", "m1")])
+        )
+        with pytest.raises(grpc.RpcError):
+            client.get_rate_limits(
+                GetRateLimitsRequest(
+                    requests=[mk("grpc_metrics", f"m{i}") for i in range(1001)]
+                )
+            )
+    finally:
+        client.close()
+    assert count("/pb.gubernator.V1/HealthCheck", "0") == hc_before + 1
+    assert count("/pb.gubernator.V1/GetRateLimits", "0") == rl_before + 1
+    assert count("/pb.gubernator.V1/GetRateLimits", "1") == err_before + 1
+    # Durations ride the same tagging (summary count tracks the counter).
+    dur = d.service.metrics.request_duration.labels(
+        method="/pb.gubernator.V1/HealthCheck"
+    )
+    assert dur._count.get() >= hc_before + 1
+
+
 def test_raw_protobuf_wire_parity(cluster):
     """Dial with a bare channel + hand-built protobuf bytes: proves the
     fully-qualified method names and field numbers match the published
