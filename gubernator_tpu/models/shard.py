@@ -650,7 +650,8 @@ class ShardStore(ColumnarPipeline):
         return r["status"], r["remaining"], r["reset_time"]
 
 
-    def _dispatch_columns(self, keys: List[str], cols: "_Columns", now_ms: int):
+    def _dispatch_columns(self, keys: List[str], cols: "_Columns", now_ms: int,
+                          force_wire: Optional[str] = None):
         """Plan + enqueue one columnar batch WITHOUT blocking on the
         device, returning a resolve() closure that performs the one
         blocking readback and the table commit.  The split is what
@@ -673,7 +674,7 @@ class ShardStore(ColumnarPipeline):
         occ_col[:n] = occ
         wr_col = np.zeros(padded, dtype=bool)
         wr_col[:n] = write
-        narrow = narrow_ok(cols, now_ms)
+        narrow = narrow_ok(cols, now_ms) and force_wire != "wide"
         # Snapshot the pass-through expiry NOW: the -2 keep-sentinel means
         # "the kernel left this slot's pre-batch expiry unchanged", and
         # pre-batch is defined at plan time.  A later pipelined batch's
@@ -682,7 +683,20 @@ class ShardStore(ColumnarPipeline):
         # would reconstruct a wrong reset_time for far-future
         # pass-through lanes.
         passthrough_exp = self.table.get_expire_bulk(slots) if narrow else None
-        if narrow:
+        dict_enc = None
+        if (narrow and force_wire is None and n_rounds <= 255
+                and int(occ_col.max(initial=0)) <= 65535):
+            dict_enc = buckets.build_config_dict(cols, now_ms)
+        if dict_enc is not None:
+            cfg_idx, table = dict_enc
+            batch = buckets.make_batch_dict(
+                slot_col, ex_col, wr_col, _pad(cfg_idx, padded, np.uint8),
+                occ_col, table,
+            )
+            self.state, packed = buckets.apply_rounds_dict_jit(
+                self.state, batch, rid_col.astype(np.uint8), n_rounds, now_ms
+            )
+        elif narrow:
             greg_delta = np.where(
                 cols.greg_duration != 0, cols.greg_expire - now_ms, 0
             ).astype(np.int32)
@@ -759,6 +773,7 @@ class ShardStore(ColumnarPipeline):
         now_ms: int,
         greg_expire=None,
         greg_duration=None,
+        force_wire=None,
     ):
         """Columnar bulk API: the zero-dataclass ingress path.
 
@@ -773,7 +788,9 @@ class ShardStore(ColumnarPipeline):
                                   len(keys), greg_expire, greg_duration)
         with self._lock:
             handle = ColumnsHandle(
-                self, *self._dispatch_columns(keys, cols, now_ms), cols.limit
+                self,
+                *self._dispatch_columns(keys, cols, now_ms, force_wire),
+                cols.limit,
             )
             self._inflight.append(handle)
         return handle.result()
@@ -789,6 +806,7 @@ class ShardStore(ColumnarPipeline):
         now_ms: int,
         greg_expire=None,
         greg_duration=None,
+        force_wire=None,
     ) -> ColumnsHandle:
         """Pipelined apply_columns: plans and enqueues the batch, then
         returns immediately with a ColumnsHandle; `handle.result()`
@@ -806,7 +824,9 @@ class ShardStore(ColumnarPipeline):
                                   len(keys), greg_expire, greg_duration)
         with self._lock:
             handle = ColumnsHandle(
-                self, *self._dispatch_columns(keys, cols, now_ms), cols.limit
+                self,
+                *self._dispatch_columns(keys, cols, now_ms, force_wire),
+                cols.limit,
             )
             self._inflight.append(handle)
         return handle

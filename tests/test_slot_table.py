@@ -100,7 +100,7 @@ class TestColumnarNarrowAndPipelined:
     def test_narrow_predicate(self):
         import numpy as np
 
-        from gubernator_tpu.models.shard import ShardStore, _Columns
+        from gubernator_tpu.models.shard import _Columns, narrow_ok
 
         now = 1_700_000_000_000
         c = _Columns(4)
@@ -109,14 +109,74 @@ class TestColumnarNarrowAndPipelined:
         c.duration[:] = 1000
         c.greg_expire[:] = 0
         c.greg_duration[:] = 0
-        assert ShardStore._narrow_ok(c, now)
+        assert narrow_ok(c, now)
         c.limit[2] = 2**31
-        assert not ShardStore._narrow_ok(c, now)
+        assert not narrow_ok(c, now)
         c.limit[2] = 10
         # Gregorian monthly: delta exceeds int32 only for huge spans
         c.greg_duration[1] = 3_000_000_000
         c.greg_expire[1] = now + 1000
-        assert not ShardStore._narrow_ok(c, now)
+        assert not narrow_ok(c, now)
+
+    def test_dict_wire_parity_and_fallback(self):
+        """The config-dictionary wire (few distinct configs) must match
+        the per-lane narrow wire exactly; >256 distinct configs fall
+        back; the lane->config mapping is exact."""
+        import numpy as np
+
+        from gubernator_tpu.models.shard import ShardStore, make_columns
+        from gubernator_tpu.ops import buckets
+
+        rng = np.random.RandomState(11)
+        now = 1_700_000_000_000
+        n = 400
+        key_ids = rng.randint(0, 200, size=n)
+        keys = [f"dw:{k}" for k in key_ids]
+        few = dict(
+            algorithm=(key_ids % 2).astype(np.int32),
+            behavior=np.zeros(n, np.int32),
+            hits=(1 + key_ids % 3).astype(np.int64),
+            limit=np.full(n, 50, np.int64),
+            duration=(60_000 + (key_ids % 4) * 1000).astype(np.int64),
+        )
+        # few-configs batch dict-encodes: 2 algos x 3 hits x 4 durations
+        cols = make_columns(few["algorithm"], few["behavior"], few["hits"],
+                            few["limit"], few["duration"], n)
+        enc = buckets.build_config_dict(cols, now)
+        assert enc is not None
+        cfg_idx, table = enc
+        for j in range(0, n, 37):  # spot-check exact lane->config mapping
+            k = cfg_idx[j]
+            assert table[0][k] == few["algorithm"][j]
+            assert table[2][k] == few["hits"][j]
+            assert table[4][k] == few["duration"][j]
+
+        # >256 distinct configs: fallback to per-lane wire
+        many = dict(few)
+        many["limit"] = (10 + np.arange(n)).astype(np.int64)
+        cols_many = make_columns(many["algorithm"], many["behavior"],
+                                 many["hits"], many["limit"],
+                                 many["duration"], n)
+        assert buckets.build_config_dict(cols_many, now) is None
+
+        # End-to-end: the dict wire must match the WIDE path lane for
+        # lane on identical values (wide forced by one int64 lane,
+        # which is excluded from the comparison).
+        a = ShardStore(capacity=1024)
+        b = ShardStore(capacity=1024)
+        wide_keys = keys + ["dw:wide"]
+        for step in range(3):
+            r1 = a.apply_columns(keys, now_ms=now + step, **few)
+            r2 = b.apply_columns(
+                wide_keys, now_ms=now + step,
+                algorithm=np.append(few["algorithm"], 0).astype(np.int32),
+                behavior=np.append(few["behavior"], 0).astype(np.int32),
+                hits=np.append(few["hits"], 1),
+                limit=np.append(few["limit"], 2**32),  # forces wide
+                duration=np.append(few["duration"], 60_000),
+            )
+            for f in ("status", "remaining", "reset_time"):
+                assert (np.asarray(r1[f]) == np.asarray(r2[f])[:-1]).all(), (step, f)
 
     def test_pipelined_matches_sync_with_duplicates(self):
         import numpy as np
