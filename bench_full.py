@@ -196,6 +196,28 @@ def config4():
     dt = time.perf_counter() - t0
     _emit("4_amortized", batch * iters * 4, dt, shards=n_dev,
           broadcasts=syncs, sync_every=4)
+    # Device-only cost of ONE sync collective + the window the
+    # GlobalManager auto-tuner would derive from it.
+    from gubernator_tpu.service import GlobalManager
+
+    cost_s = store.measure_sync_cost_s(NOW + 10_000)
+    g_active = max(len(store.gtable.active_gslots()), 1)
+    print(
+        json.dumps(
+            {
+                "metric": "global_sync_device_cost_us",
+                "value": round(cost_s * 1e6, 1),
+                "unit": "us/sync",
+                "vs_baseline": 0,
+                "us_per_gslot": round(cost_s * 1e6 / g_active, 2),
+                "recommended_sync_wait_ms": round(
+                    GlobalManager.window_for_cost(cost_s) * 1e3, 1
+                ),
+                "shards": n_dev,
+            }
+        ),
+        flush=True,
+    )
 
 
 def config5():

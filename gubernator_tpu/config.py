@@ -34,7 +34,12 @@ class BehaviorConfig:
     batch_limit: int = 1000
 
     global_timeout_s: float = 0.5
-    global_sync_wait_s: float = 0.1
+    # None = AUTO: size the window from the measured device cost of one
+    # sync collective (GlobalManager resolves it at startup so the sync
+    # overhead stays ~10% of the window).  Set a float (or
+    # GUBER_GLOBAL_SYNC_WAIT) to pin it, as the test harness does
+    # (cluster.py uses 50ms, mirroring cluster/cluster.go:104-110).
+    global_sync_wait_s: Optional[float] = None
     global_batch_limit: int = 1000
 
     multi_region_timeout_s: float = 0.5

@@ -953,6 +953,57 @@ class MeshBucketStore(ColumnarPipeline):
         return result
 
     # ------------------------------------------------------------------
+    def measure_sync_cost_s(self, now_ms: int, iters: int = 6) -> float:
+        """BENCHMARK UTILITY: device-only steady-state cost (seconds)
+        of ONE GLOBAL sync collective on this mesh (the reference's
+        sync is a map drain, global.go:163-195; here it is a device
+        collective).  Enqueues `iters` syncs back-to-back (donated
+        state chains them on device) and forces completion with one
+        small readback — the only reliable barrier on a remote device.
+
+        Do NOT call on a store serving GLOBAL traffic: the timed raw
+        syncs drain device-side hit accumulations without the
+        host-side commit/broadcast legs (the serving tuner instead
+        times its real sync passes in situ, service.GlobalManager)."""
+        req = RateLimitRequest(
+            name="__synccal__", unique_key="__synccal__", hits=1,
+            limit=1_000_000, duration=60_000, behavior=Behavior.GLOBAL,
+        )
+        self.apply([req], now_ms)
+        self.sync_globals(now_ms)  # resolve owner slots + compile
+        self._drain_then_lock()
+        try:
+            import time as _time
+
+            cfg = global_ops.SyncConfig(
+                owner_slot=jnp.asarray(self.gtable.owner_slot),
+                owner_shard=jnp.asarray(self.gtable.owner_shard),
+                algorithm=jnp.asarray(self.gtable.algorithm),
+                behavior=jnp.asarray(self.gtable.behavior),
+                limit=jnp.asarray(self.gtable.limit),
+                duration=jnp.asarray(self.gtable.duration),
+                greg_expire=jnp.asarray(self.gtable.greg_expire),
+                greg_duration=jnp.asarray(self.gtable.greg_duration),
+            )
+            dirty_dev = jax.device_put(jnp.asarray(self.dirty), self._sharding)
+
+            def one():
+                self.state, self.gcols, packed = self._sync_fn(
+                    self.state, self.gcols, cfg, dirty_dev, now_ms
+                )
+                return packed
+
+            packed = one()
+            np.asarray(packed[:1, :1, :1])  # drain queue + honest mode
+            t0 = _time.perf_counter()
+            for _ in range(iters):
+                packed = one()
+            np.asarray(packed[:1, :1, :1])
+            return (_time.perf_counter() - t0) / iters
+        finally:
+            self._lock.release()
+
+    # ------------------------------------------------------------------
     def warmup(self, now_ms: int, warm_shapes: Optional[Sequence[int]] = None) -> None:
         """Compile the hot programs before serving traffic.  A daemon
         that starts answering RPCs cold pays the first-dispatch XLA

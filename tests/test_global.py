@@ -10,6 +10,8 @@ the in-process equivalent of waiting out GlobalSyncWait ticks as
 TestGlobalRateLimits does by polling metrics (functional_test.go:478-546).
 """
 
+import pytest
+
 from gubernator_tpu.parallel.mesh import MeshBucketStore, shard_of_key
 from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest, Status
 from gubernator_tpu.utils.clock import Clock
@@ -160,3 +162,63 @@ def test_gslot_eviction_clears_device_rows():
     _, oth3 = owner_and_other(store, "e3")
     r = store.apply([mk("e3", hits=0)], T0 + 3, home_shard=oth3)[0]
     assert r.remaining == 9
+
+
+def test_measure_sync_cost_and_autotune():
+    """measure_sync_cost_s returns the device cost of one collective;
+    the GlobalManager sizes the sync window from its in-situ sync
+    timings (<=10% overhead, clamped) once GLOBAL traffic is observed."""
+    from gubernator_tpu.service import GlobalManager, ServiceConfig, V1Service
+    from gubernator_tpu.types import PeerInfo
+
+    store = MeshBucketStore(capacity_per_shard=256, g_capacity=64)
+    cost = store.measure_sync_cost_s(T0, iters=2)
+    assert 0 < cost < 60.0
+
+    clock = Clock()
+    clock.freeze(T0)
+    svc = V1Service(ServiceConfig(store=store, clock=clock,
+                                  advertise_address="127.0.0.1:9991"))
+    svc.set_peers([PeerInfo(grpc_address="127.0.0.1:9991", is_owner=True)])
+    try:
+        mgr = svc.global_mgr
+        # default config leaves the window on AUTO at the fallback value
+        assert mgr._auto and mgr.sync_wait_s == GlobalManager.SYNC_WAIT_FALLBACK_S
+        # drive ticks manually: the background interval must not race us
+        mgr._interval.stop()
+        from gubernator_tpu.types import GetRateLimitsRequest
+
+        svc.get_rate_limits(
+            GetRateLimitsRequest(requests=[mk("tune", hits=1, limit=10)])
+        )
+        mgr._tick()  # one real tick: does work, observes its own cost
+        assert mgr.measured_sync_cost_s is not None
+        expected = GlobalManager.window_for_cost(mgr.measured_sync_cost_s)
+        assert mgr.sync_wait_s == pytest.approx(expected)
+        assert mgr._interval.duration_s == pytest.approx(expected)
+        # still AUTO: the window keeps adapting as sync cost changes
+        assert mgr._auto
+        mgr._observe_sync_cost(10.0)  # clamped at the max
+        assert mgr.sync_wait_s == GlobalManager.SYNC_WAIT_MAX_S
+    finally:
+        svc.close()
+
+
+def test_configured_sync_wait_disables_autotune():
+    from gubernator_tpu.config import BehaviorConfig
+    from gubernator_tpu.service import ServiceConfig, V1Service
+    from gubernator_tpu.types import PeerInfo
+
+    clock = Clock()
+    clock.freeze(T0)
+    svc = V1Service(ServiceConfig(
+        cache_size=256,
+        behaviors=BehaviorConfig(global_sync_wait_s=0.05),
+        clock=clock, advertise_address="127.0.0.1:9992",
+    ))
+    svc.set_peers([PeerInfo(grpc_address="127.0.0.1:9992", is_owner=True)])
+    try:
+        assert not svc.global_mgr._auto
+        assert svc.global_mgr.sync_wait_s == 0.05
+    finally:
+        svc.close()
