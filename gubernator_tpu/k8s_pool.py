@@ -63,6 +63,9 @@ class K8sApiClient:
         api_url: str = "",
         token: str = "",
         ca_file: str = "",
+        client_cert_file: str = "",
+        client_key_file: str = "",
+        skip_tls_verify: bool = False,
     ):
         if not api_url:
             host = os.environ.get("KUBERNETES_SERVICE_HOST", "")
@@ -89,6 +92,104 @@ class K8sApiClient:
             self._ssl_ctx = ssl.create_default_context(
                 cafile=ca_file or None
             )
+            if client_cert_file:
+                self._ssl_ctx.load_cert_chain(
+                    client_cert_file, client_key_file or None
+                )
+            if skip_tls_verify:
+                self._ssl_ctx.check_hostname = False
+                self._ssl_ctx.verify_mode = ssl.CERT_NONE
+
+    @classmethod
+    def auto(cls) -> "K8sApiClient":
+        """In-cluster config when the service-account env is present,
+        otherwise the local kubeconfig — the reference's build-tag pair
+        (kubernetesconfig.go:1-11 in-cluster /
+        kubernetesconfig_local.go:1-38 ~/.kube/config)."""
+        if os.environ.get("KUBERNETES_SERVICE_HOST"):
+            return cls()
+        try:
+            return cls.from_kubeconfig()
+        except FileNotFoundError as e:
+            raise RuntimeError(
+                "not running in-cluster (no KUBERNETES_SERVICE_HOST) and no "
+                f"kubeconfig found ({e.filename}); set KUBECONFIG or mount "
+                "the service account"
+            ) from e
+
+    @classmethod
+    def from_kubeconfig(cls, path: str = "", context: str = "") -> "K8sApiClient":
+        """Out-of-cluster client from a kubeconfig file
+        (kubernetesconfig_local.go:1-38 equivalent: clientcmd loading
+        rules — $KUBECONFIG, then ~/.kube/config).  Supports server +
+        CA (file or inline base64 data), bearer token, and client
+        cert/key auth; `context` overrides current-context."""
+        import base64
+        import tempfile
+
+        import yaml
+
+        path = (
+            path
+            or os.environ.get("KUBECONFIG", "")
+            or os.path.expanduser("~/.kube/config")
+        )
+        with open(path) as f:
+            cfg = yaml.safe_load(f) or {}
+
+        def by_name(section, name):
+            for entry in cfg.get(section, []) or []:
+                if entry.get("name") == name:
+                    return entry.get(section.rstrip("s"), {})
+            raise ValueError(f"kubeconfig: no {section} entry named {name!r}")
+
+        ctx_name = context or cfg.get("current-context", "")
+        if not ctx_name:
+            raise ValueError("kubeconfig: no current-context set")
+        ctx = by_name("contexts", ctx_name)
+        cluster = by_name("clusters", ctx.get("cluster", ""))
+        user = by_name("users", ctx.get("user", ""))
+        for unsupported in ("exec", "auth-provider"):
+            if user.get(unsupported):
+                # Silently ignoring these would yield an unauthenticated
+                # client that 401s at runtime with no hint why.
+                raise ValueError(
+                    f"kubeconfig: user {ctx.get('user')!r} uses "
+                    f"'{unsupported}' auth, which this client does not "
+                    "support; use a token or client certificate"
+                )
+
+        def materialize(file_key: str, data_key: str, source: dict) -> str:
+            """Inline base64 *-data wins over the file path variant.
+            Materialized files (which may hold a client PRIVATE KEY)
+            are 0600 and removed at interpreter exit."""
+            data = source.get(data_key, "")
+            if data:
+                import atexit
+
+                tmp = tempfile.NamedTemporaryFile(
+                    prefix="guber-kubeconfig-", delete=False
+                )
+                tmp.write(base64.b64decode(data))
+                tmp.close()
+                atexit.register(
+                    lambda p=tmp.name: os.path.exists(p) and os.remove(p)
+                )
+                return tmp.name
+            return source.get(file_key, "")
+
+        return cls(
+            api_url=cluster.get("server", ""),
+            token=user.get("token", ""),
+            ca_file=materialize(
+                "certificate-authority", "certificate-authority-data", cluster
+            ),
+            client_cert_file=materialize(
+                "client-certificate", "client-certificate-data", user
+            ),
+            client_key_file=materialize("client-key", "client-key-data", user),
+            skip_tls_verify=bool(cluster.get("insecure-skip-tls-verify")),
+        )
 
     def _connect(self, timeout: Optional[float]):
         scheme, _, rest = self.api_url.partition("://")
@@ -203,7 +304,9 @@ class K8sPool:
         self.pod_port = pod_port
         self.mechanism = watch_mechanism_from_string(mechanism)
         self.backoff_s = backoff_s
-        self.client = api_client or K8sApiClient()
+        # In-cluster service account or local kubeconfig, like the
+        # reference's build-tag pair (kubernetesconfig*.go).
+        self.client = api_client or K8sApiClient.auto()
         self._store: Dict[str, dict] = {}  # namespace/name -> object
         self._stop = threading.Event()
         # The informer loop: list -> watch -> (on failure) relist.

@@ -48,6 +48,23 @@ def _build() -> Optional[ctypes.CDLL]:
         _lib_err = f"native load failed: {e}"
         return None
 
+    # Prune superseded builds: each source edit leaves a hash-named .so
+    # behind, which otherwise accumulates without bound.  Only delete
+    # files comfortably older than any concurrently-starting process's
+    # build window — a racing starter with a different source digest
+    # must not lose its fresh .so between write and dlopen.
+    import glob
+    import time
+
+    cutoff = time.time() - 600
+    for stale in glob.glob(_LIB_TMPL.format(digest="*")):
+        if stale != lib_path:
+            try:
+                if os.path.getmtime(stale) < cutoff:
+                    os.remove(stale)
+            except OSError:
+                pass
+
     c = ctypes
     lib.gt_table_new.restype = c.c_void_p
     lib.gt_table_new.argtypes = [c.c_int64]

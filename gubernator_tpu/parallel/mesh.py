@@ -1047,3 +1047,23 @@ class MeshBucketStore(ColumnarPipeline):
 
     def size(self) -> int:
         return sum(len(t) for t in self.tables)
+
+    @_drained_locked
+    def check_consistency(self) -> None:
+        """Test/debug invariant sweep over the host tier (the
+        race-detector analogue of the reference's `-race` runs,
+        Makefile:8-9): every shard's key->slot mapping must be a
+        bijection onto live slots and sized consistently.  Raises
+        AssertionError on corruption."""
+        for s in range(self.n_shards):
+            t = self.tables[s]
+            keys = t.keys()
+            slots = [t.get_slot(k) for k in keys]
+            assert None not in slots, f"shard {s}: unmapped key in keys()"
+            assert len(set(slots)) == len(slots), f"shard {s}: slot aliasing"
+            assert len(keys) == len(t), (
+                f"shard {s}: size {len(t)} != mapped keys {len(keys)}"
+            )
+            assert all(0 <= x < self.capacity_per_shard for x in slots), (
+                f"shard {s}: slot out of range"
+            )

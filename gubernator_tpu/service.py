@@ -788,15 +788,15 @@ class GlobalManager:
             self.SYNC_WAIT_FALLBACK_S if configured is None else configured
         )
         self.measured_sync_cost_s: Optional[float] = None
+        self._last_sync_cost_s: Optional[float] = None
         self._interval = Interval(self.sync_wait_s, self._tick)
         self._interval.next()
 
     def _tick(self) -> None:
         try:
-            start = time.perf_counter()
             did_work = self.run_once()
-            if did_work and self._auto:
-                self._observe_sync_cost(time.perf_counter() - start)
+            if did_work and self._auto and self._last_sync_cost_s is not None:
+                self._observe_sync_cost(self._last_sync_cost_s)
         finally:
             if not self._stopped:
                 self._interval.next()
@@ -814,9 +814,16 @@ class GlobalManager:
 
     def run_once(self) -> bool:
         """One sync pass; returns whether the sync produced host-tier
-        work (the auto-tuner's signal that GLOBAL is in real use)."""
+        work (the auto-tuner's signal that GLOBAL is in real use).
+
+        Only the store sync (device collective + decode) counts as
+        "sync cost" for window sizing — the peer fan-out legs below are
+        dominated by network timeouts under failure, and a dead peer
+        must not inflate the window for every healthy peer."""
         svc = self.service
+        t0 = time.perf_counter()
         res = svc.store.sync_globals(svc.clock.now_ms())
+        self._last_sync_cost_s = time.perf_counter() - t0
         if res.remote_hits:
             start = time.perf_counter()
             by_owner: Dict[str, List[RateLimitRequest]] = {}

@@ -24,7 +24,7 @@ import tempfile
 from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
-from .utils.net import discover_ip
+from .utils.net import discover_network_addresses
 
 
 class TLSError(Exception):
@@ -84,20 +84,22 @@ def self_cert(
     client: bool = False,
 ) -> Tuple[str, str]:
     """Generate a CA-signed cert (tls.go:265-362). SANs cover loopback,
-    the discovered host IP, and the hostname (net.go:70-106 discovery).
-    Returns (crt, key)."""
+    every non-loopback interface IP, their reverse-DNS names, and the
+    hostname (net.go:70-106 discovery).  Returns (crt, key)."""
     key = os.path.join(dir_, f"{name}.key")
     csr = os.path.join(dir_, f"{name}.csr")
     crt = os.path.join(dir_, f"{name}.crt")
     ext = os.path.join(dir_, f"{name}.ext")
     sans = ["DNS:localhost", "IP:127.0.0.1", "IP:0.0.0.0"]
-    ip = discover_ip()
-    if not ip.startswith("127."):
-        sans.append(f"IP:{ip}")
+    ips, dns_names = discover_network_addresses()
+    sans.extend(f"IP:{ip}" for ip in ips)
+    sans.extend(f"DNS:{n}" for n in dns_names)
     try:
         import socket
 
-        sans.append(f"DNS:{socket.gethostname()}")
+        host = socket.gethostname()
+        if f"DNS:{host}" not in sans:
+            sans.append(f"DNS:{host}")
     except OSError:
         pass
     usage = "clientAuth" if client else "serverAuth,clientAuth"

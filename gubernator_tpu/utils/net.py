@@ -35,6 +35,44 @@ def discover_ip() -> str:
     return "127.0.0.1"
 
 
+def discover_network_addresses() -> "tuple[list[str], list[str]]":
+    """Every non-loopback IPv4 interface address on this host plus the
+    DNS names they reverse-resolve to (net.go:70-106) — the SAN set for
+    AutoTLS self-signed certificates.  Interface enumeration uses the
+    Linux SIOCGIFADDR ioctl; other platforms degrade to the
+    route-probed address from discover_ip()."""
+    ips = set()
+    try:
+        import fcntl
+        import struct
+
+        SIOCGIFADDR = 0x8915
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            for _, ifname in socket.if_nameindex():
+                try:
+                    packed = fcntl.ioctl(
+                        s.fileno(), SIOCGIFADDR,
+                        struct.pack("256s", ifname[:15].encode()),
+                    )
+                except OSError:
+                    continue  # interface without an IPv4 address
+                ip = socket.inet_ntoa(packed[20:24])
+                if not ip.startswith("127."):
+                    ips.add(ip)
+    except (ImportError, OSError):
+        pass
+    fallback = discover_ip()
+    if fallback != "127.0.0.1":
+        ips.add(fallback)
+    names = set()
+    for ip in ips:
+        try:
+            names.add(socket.gethostbyaddr(ip)[0])
+        except OSError:
+            pass
+    return sorted(ips), sorted(names)
+
+
 def resolve_host_ip(addr: str) -> str:
     """Replace a wildcard host in 'host:port' with a routable IP
     (net.go:12-33)."""

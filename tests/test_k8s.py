@@ -267,3 +267,76 @@ def test_k8s_env_parsing():
 def test_k8s_selector_required():
     with pytest.raises(ValueError, match="ENDPOINTS_SELECTOR"):
         setup_daemon_config(env={"GUBER_PEER_DISCOVERY_TYPE": "k8s"})
+
+
+def test_kubeconfig_local_mode(tmp_path, monkeypatch):
+    """Out-of-cluster client from a kubeconfig file
+    (kubernetesconfig_local.go:1-38 parity): server/CA/token from the
+    current-context chain; inline base64 *-data materializes to files;
+    $KUBECONFIG is honored by auto() outside a cluster."""
+    import base64
+
+    from gubernator_tpu.k8s_pool import K8sApiClient
+    from gubernator_tpu.tls import self_ca
+
+    ca_crt, _ = self_ca(str(tmp_path))
+    ca_pem = open(ca_crt, "rb").read()
+    kc = tmp_path / "config"
+    kc.write_text(
+        "\n".join([
+            "apiVersion: v1",
+            "kind: Config",
+            "current-context: dev",
+            "contexts:",
+            "- name: dev",
+            "  context: {cluster: devc, user: devu}",
+            "- name: other",
+            "  context: {cluster: devc, user: devu}",
+            "clusters:",
+            "- name: devc",
+            "  cluster:",
+            "    server: https://k8s.example:6443",
+            f"    certificate-authority-data: {base64.b64encode(ca_pem).decode()}",
+            "users:",
+            "- name: devu",
+            "  user:",
+            "    token: sekret",
+        ])
+    )
+    client = K8sApiClient.from_kubeconfig(str(kc))
+    assert client.api_url == "https://k8s.example:6443"
+    assert client.token == "sekret"
+    assert client._ssl_ctx is not None
+
+    # auto() outside a cluster follows $KUBECONFIG
+    monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+    monkeypatch.setenv("KUBECONFIG", str(kc))
+    auto = K8sApiClient.auto()
+    assert auto.api_url == "https://k8s.example:6443"
+
+    # unknown context name errors clearly
+    with pytest.raises(ValueError, match="contexts"):
+        K8sApiClient.from_kubeconfig(str(kc), context="missing")
+
+
+def test_kubeconfig_http_server_no_tls(tmp_path):
+    from gubernator_tpu.k8s_pool import K8sApiClient
+
+    kc = tmp_path / "config"
+    kc.write_text(
+        "\n".join([
+            "current-context: dev",
+            "contexts:",
+            "- name: dev",
+            "  context: {cluster: c, user: u}",
+            "clusters:",
+            "- name: c",
+            "  cluster: {server: 'http://127.0.0.1:8001'}",
+            "users:",
+            "- name: u",
+            "  user: {}",
+        ])
+    )
+    client = K8sApiClient.from_kubeconfig(str(kc))
+    assert client.api_url == "http://127.0.0.1:8001"
+    assert client._ssl_ctx is None
