@@ -127,7 +127,13 @@ class K8sApiClient:
         import base64
         import tempfile
 
-        import yaml
+        try:
+            import yaml
+        except ImportError as e:  # pragma: no cover
+            raise RuntimeError(
+                "kubeconfig support requires PyYAML "
+                "(pip install 'gubernator-tpu[k8s]')"
+            ) from e
 
         path = (
             path
@@ -136,6 +142,7 @@ class K8sApiClient:
         )
         with open(path) as f:
             cfg = yaml.safe_load(f) or {}
+        base_dir = os.path.dirname(os.path.abspath(path))
 
         def by_name(section, name):
             for entry in cfg.get(section, []) or []:
@@ -162,7 +169,9 @@ class K8sApiClient:
         def materialize(file_key: str, data_key: str, source: dict) -> str:
             """Inline base64 *-data wins over the file path variant.
             Materialized files (which may hold a client PRIVATE KEY)
-            are 0600 and removed at interpreter exit."""
+            are 0600 and removed at interpreter exit.  Relative file
+            paths resolve against the kubeconfig's own directory
+            (clientcmd semantics)."""
             data = source.get(data_key, "")
             if data:
                 import atexit
@@ -176,7 +185,10 @@ class K8sApiClient:
                     lambda p=tmp.name: os.path.exists(p) and os.remove(p)
                 )
                 return tmp.name
-            return source.get(file_key, "")
+            file_path = source.get(file_key, "")
+            if file_path and not os.path.isabs(file_path):
+                file_path = os.path.join(base_dir, file_path)
+            return file_path
 
         return cls(
             api_url=cluster.get("server", ""),

@@ -977,13 +977,13 @@ def make_store_resolver(table, algo_mirror, store, inject_fn, now_ms: int):
     return resolve
 
 
-def item_to_rows(item) -> "buckets.BucketState":
-    """Convert one SPI CacheItem to a single-row BucketState."""
+def item_to_rows(item) -> "buckets.BucketRows":
+    """Convert one SPI CacheItem to a single-row BucketRows."""
     from ..store import LeakyBucketItem
 
     v = item.value
     if isinstance(v, LeakyBucketItem):
-        return buckets.BucketState(
+        return buckets.BucketRows(
             algo=np.array([int(Algorithm.LEAKY_BUCKET)], np.int32),
             limit=np.array([v.limit], np.int64),
             remaining=np.array([int(v.remaining * buckets.LEAKY_SCALE)], np.int64),
@@ -992,7 +992,7 @@ def item_to_rows(item) -> "buckets.BucketState":
             expire_at=np.array([item.expire_at], np.int64),
             status=np.array([0], np.int32),
         )
-    return buckets.BucketState(
+    return buckets.BucketRows(
         algo=np.array([int(Algorithm.TOKEN_BUCKET)], np.int32),
         limit=np.array([v.limit], np.int64),
         remaining=np.array([v.remaining], np.int64),

@@ -33,7 +33,16 @@ def _build() -> Optional[ctypes.CDLL]:
     with open(_SRC, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
     lib_path = _LIB_TMPL.format(digest=digest)
-    if not os.path.exists(lib_path):
+    if os.path.exists(lib_path):
+        # Refresh mtime: the stale-prune below is age-based, and an
+        # old-mtime .so being REUSED by this process must not look
+        # prunable to a concurrently starting process (TOCTOU between
+        # our exists() and CDLL()).
+        try:
+            os.utime(lib_path)
+        except OSError:
+            pass
+    else:
         tmp = f"{lib_path}.{os.getpid()}.tmp"
         cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
         try:

@@ -148,27 +148,86 @@ def _leak_amounts(el_c, lim_nn, rn):
 
 
 class BucketState(NamedTuple):
-    """Struct-of-arrays bucket table for one shard (capacity C).
+    """Struct-of-arrays bucket table for one shard (capacity C), stored
+    as SPLIT int32 columns.
 
-    Union of the reference's TokenBucketItem / LeakyBucketItem
-    (store.go:11-24) plus CacheItem bookkeeping (cache.go:64-76):
-      algo:      Algorithm per slot
-      limit:     configured limit
-      remaining: token -> whole tokens; leaky -> tokens * LEAKY_SCALE
-      duration:  stored duration (ms)
-      stamp:     token -> CreatedAt; leaky -> UpdatedAt (ms epoch)
-      expire_at: CacheItem.ExpireAt (ms epoch); <= now means the slot is
-                 dead and recyclable (expiry-as-miss)
-      status:    token sticky Status
+    Logically each slot holds the union of the reference's
+    TokenBucketItem / LeakyBucketItem (store.go:11-24) plus CacheItem
+    bookkeeping (cache.go:64-76): algo, limit, remaining (leaky scaled
+    by LEAKY_SCALE), duration, stamp (CreatedAt/UpdatedAt), expire_at
+    (expiry-as-miss), sticky status.
+
+    PHYSICALLY every int64 value is stored as a lo/hi int32 pair and
+    algo+status pack into one flags column (bits 0-1 algo, bit 2
+    status).  Rationale (measured on TPU v5e): the kernel is
+    scatter-bound and XLA's random-index scatters cost ~3x more per
+    int64 element than per int32 — splitting 5 i64 + 2 i32 columns into
+    11 i32 columns cuts the per-batch device time ~3.5x.  The kernel
+    recomposes to int64 after the gather and decomposes before the
+    scatter, so the arithmetic (and the wire formats) are bit-identical
+    to the logical layout.  Host exchange uses BucketRows.
     """
 
-    algo: jax.Array  # i32[C]
-    limit: jax.Array  # i64[C]
-    remaining: jax.Array  # i64[C]
-    duration: jax.Array  # i64[C]
-    stamp: jax.Array  # i64[C]
-    expire_at: jax.Array  # i64[C]
-    status: jax.Array  # i32[C]
+    flags: jax.Array  # i32[C]: bits 0-1 algo, bit 2 sticky status
+    limit_lo: jax.Array  # i32[C]
+    limit_hi: jax.Array  # i32[C]
+    remaining_lo: jax.Array  # i32[C]
+    remaining_hi: jax.Array  # i32[C]
+    duration_lo: jax.Array  # i32[C]
+    duration_hi: jax.Array  # i32[C]
+    stamp_lo: jax.Array  # i32[C]
+    stamp_hi: jax.Array  # i32[C]
+    expire_lo: jax.Array  # i32[C]
+    expire_hi: jax.Array  # i32[C]
+
+
+class BucketRows(NamedTuple):
+    """Logical (composed int64) row form: the host exchange format for
+    Store/Loader snapshots and row injection (read_rows/write_rows)."""
+
+    algo: jax.Array  # i32[N]
+    limit: jax.Array  # i64[N]
+    remaining: jax.Array  # i64[N]
+    duration: jax.Array  # i64[N]
+    stamp: jax.Array  # i64[N]
+    expire_at: jax.Array  # i64[N]
+    status: jax.Array  # i32[N]
+
+
+_MASK32 = (1 << 32) - 1
+
+
+def _compose64(lo, hi):
+    """Exact int64 from a lo/hi int32 pair (sign lives in hi)."""
+    return (hi.astype(_I64) << 32) | (lo.astype(_I64) & _MASK32)
+
+
+def _lo32(v):
+    return v.astype(_I32)  # modular truncation keeps the low 32 bits
+
+
+def _hi32(v):
+    return (v >> 32).astype(_I32)
+
+
+def rows_to_split(rows: BucketRows) -> BucketState:
+    """Decompose logical rows into the split column layout (same
+    leading length); the write-side twin of read_rows' composition."""
+    algo = jnp.asarray(rows.algo, _I32)
+    status = jnp.asarray(rows.status, _I32)
+    limit = jnp.asarray(rows.limit, _I64)
+    remaining = jnp.asarray(rows.remaining, _I64)
+    duration = jnp.asarray(rows.duration, _I64)
+    stamp = jnp.asarray(rows.stamp, _I64)
+    expire = jnp.asarray(rows.expire_at, _I64)
+    return BucketState(
+        flags=(algo & 3) | ((status & 1) << 2),
+        limit_lo=_lo32(limit), limit_hi=_hi32(limit),
+        remaining_lo=_lo32(remaining), remaining_hi=_hi32(remaining),
+        duration_lo=_lo32(duration), duration_hi=_hi32(duration),
+        stamp_lo=_lo32(stamp), stamp_hi=_hi32(stamp),
+        expire_lo=_lo32(expire), expire_hi=_hi32(expire),
+    )
 
 
 class RequestBatch(NamedTuple):
@@ -211,15 +270,7 @@ class BatchOutput(NamedTuple):
 
 def init_state(capacity: int) -> BucketState:
     """Fresh all-expired bucket table (expire_at=0 => every slot is free)."""
-    return BucketState(
-        algo=jnp.zeros((capacity,), _I32),
-        limit=jnp.zeros((capacity,), _I64),
-        remaining=jnp.zeros((capacity,), _I64),
-        duration=jnp.zeros((capacity,), _I64),
-        stamp=jnp.zeros((capacity,), _I64),
-        expire_at=jnp.zeros((capacity,), _I64),
-        status=jnp.zeros((capacity,), _I32),
-    )
+    return BucketState(*[jnp.zeros((capacity,), _I32) for _ in BucketState._fields])
 
 
 def make_batch(
@@ -262,18 +313,19 @@ def apply_batch(state: BucketState, req: RequestBatch, now_ms) -> "tuple[BucketS
     is race-free.
     """
     now = jnp.asarray(now_ms, _I64)
-    C = state.limit.shape[0]
+    C = state.flags.shape[0]
 
     valid = req.slot >= 0
     s = jnp.clip(req.slot, 0, C - 1)
 
-    g_algo = state.algo[s]
-    g_limit = state.limit[s]
-    g_rem = state.remaining[s]
-    g_dur = state.duration[s]
-    g_stamp = state.stamp[s]
-    g_exp = state.expire_at[s]
-    g_status = state.status[s]
+    g_flags = state.flags[s]
+    g_algo = g_flags & 3
+    g_status = (g_flags >> 2) & 1
+    g_limit = _compose64(state.limit_lo[s], state.limit_hi[s])
+    g_rem = _compose64(state.remaining_lo[s], state.remaining_hi[s])
+    g_dur = _compose64(state.duration_lo[s], state.duration_hi[s])
+    g_stamp = _compose64(state.stamp_lo[s], state.stamp_hi[s])
+    g_exp = _compose64(state.expire_lo[s], state.expire_hi[s])
 
     # Expiry-as-miss: reference expires strictly (`ExpireAt < now`,
     # cache.go:151), so a slot at exactly its expiry is still live.
@@ -459,14 +511,19 @@ def apply_batch(state: BucketState, req: RequestBatch, now_ms) -> "tuple[BucketS
     writes = valid if req.write is None else (valid & req.write)
     scat = jnp.where(writes, req.slot, C)
     drop = dict(mode="drop")
+    n_flags = (n_algo & 3) | ((n_status & 1) << 2)
     new_state = BucketState(
-        algo=state.algo.at[scat].set(n_algo, **drop),
-        limit=state.limit.at[scat].set(n_limit, **drop),
-        remaining=state.remaining.at[scat].set(n_rem, **drop),
-        duration=state.duration.at[scat].set(n_dur, **drop),
-        stamp=state.stamp.at[scat].set(n_stamp, **drop),
-        expire_at=state.expire_at.at[scat].set(n_exp, **drop),
-        status=state.status.at[scat].set(n_status, **drop),
+        flags=state.flags.at[scat].set(n_flags, **drop),
+        limit_lo=state.limit_lo.at[scat].set(_lo32(n_limit), **drop),
+        limit_hi=state.limit_hi.at[scat].set(_hi32(n_limit), **drop),
+        remaining_lo=state.remaining_lo.at[scat].set(_lo32(n_rem), **drop),
+        remaining_hi=state.remaining_hi.at[scat].set(_hi32(n_rem), **drop),
+        duration_lo=state.duration_lo.at[scat].set(_lo32(n_dur), **drop),
+        duration_hi=state.duration_hi.at[scat].set(_hi32(n_dur), **drop),
+        stamp_lo=state.stamp_lo.at[scat].set(_lo32(n_stamp), **drop),
+        stamp_hi=state.stamp_hi.at[scat].set(_hi32(n_stamp), **drop),
+        expire_lo=state.expire_lo.at[scat].set(_lo32(n_exp), **drop),
+        expire_hi=state.expire_hi.at[scat].set(_hi32(n_exp), **drop),
     )
 
     out = BatchOutput(
@@ -619,8 +676,9 @@ def apply_rounds32(
     )
     # Pre-batch expiry per lane, read BEFORE the rounds mutate state:
     # the pass-through detector for the -2 sentinel.
-    C = state.expire_at.shape[0]
-    pre_exp = state.expire_at[jnp.clip(req32.slot, 0, C - 1)]
+    C = state.flags.shape[0]
+    si = jnp.clip(req32.slot, 0, C - 1)
+    pre_exp = _compose64(state.expire_lo[si], state.expire_hi[si])
 
     state, packed64 = apply_rounds(state, req, round_id, n_rounds, now_ms)
     hi = jnp.asarray((1 << 31) - 1, _I64)
@@ -803,21 +861,31 @@ def unpack_output32(packed, now_ms: int, table_expire):
 
 
 @jax.jit
-def read_rows(state: BucketState, slots) -> BucketState:
+def read_rows(state: BucketState, slots) -> BucketRows:
     """Gather full bucket rows for the given slots (host-bound: Store
     OnChange callbacks and Loader snapshots need the item state the way
     the reference passes CacheItems, store.go:29-45)."""
     s = jnp.asarray(slots, _I32)
-    return BucketState(*[col[s] for col in state])
+    flags = state.flags[s]
+    return BucketRows(
+        algo=flags & 3,
+        limit=_compose64(state.limit_lo[s], state.limit_hi[s]),
+        remaining=_compose64(state.remaining_lo[s], state.remaining_hi[s]),
+        duration=_compose64(state.duration_lo[s], state.duration_hi[s]),
+        stamp=_compose64(state.stamp_lo[s], state.stamp_hi[s]),
+        expire_at=_compose64(state.expire_lo[s], state.expire_hi[s]),
+        status=(flags >> 2) & 1,
+    )
 
 
 @partial(jax.jit, donate_argnums=0)
-def write_rows(state: BucketState, slots, rows: BucketState) -> BucketState:
+def write_rows(state: BucketState, slots, rows: BucketRows) -> BucketState:
     """Scatter full bucket rows (Store.Get results / Loader.Load items).
     Negative slots are mapped out of bounds and dropped."""
-    C = state.limit.shape[0]
+    C = state.flags.shape[0]
     s = jnp.asarray(slots, _I32)
     s = jnp.where(s >= 0, s, C)
+    vals = rows_to_split(rows)
     return BucketState(
-        *[col.at[s].set(val, mode="drop") for col, val in zip(state, rows)]
+        *[col.at[s].set(val, mode="drop") for col, val in zip(state, vals)]
     )

@@ -188,8 +188,10 @@ def _clear_jit(gcols, idx):
 @partial(jax.jit, donate_argnums=0)
 def _write_row_jit(state, s, slot, rows):
     # Donated single-row scatter: store-miss injection / loader placement
-    # without copying the whole [S, C] state.
-    return jax.tree.map(lambda col, val: col.at[s, slot].set(val[0]), state, rows)
+    # without copying the whole [S, C] state.  `rows` is a logical
+    # BucketRows; decompose into the split i32 layout first.
+    vals = buckets.rows_to_split(rows)
+    return jax.tree.map(lambda col, val: col.at[s, slot].set(val[0]), state, vals)
 
 
 _SYNC_FN_CACHE: dict = {}
@@ -782,7 +784,8 @@ class MeshBucketStore(ColumnarPipeline):
 
     def _read_shard_rows(self, s: int, slots):
         idx = np.asarray(slots, np.int32)
-        return jax.tree.map(lambda col: np.asarray(col[s][idx]), self.state)
+        shard_state = jax.tree.map(lambda col: col[s], self.state)
+        return jax.tree.map(np.asarray, buckets.read_rows(shard_state, idx))
 
     def _fire_store_callbacks(self, s: int, chunk, cached_row, removed_row) -> None:
         live = []

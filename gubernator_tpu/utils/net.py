@@ -64,12 +64,26 @@ def discover_network_addresses() -> "tuple[list[str], list[str]]":
     fallback = discover_ip()
     if fallback != "127.0.0.1":
         ips.add(fallback)
+    # Reverse-DNS with a hard deadline: a broken resolver must not add
+    # its full timeout+retry cycle per IP to daemon startup (this runs
+    # inside AutoTLS cert generation).
     names = set()
-    for ip in ips:
-        try:
-            names.add(socket.gethostbyaddr(ip)[0])
-        except OSError:
-            pass
+    if ips:
+        from concurrent.futures import ThreadPoolExecutor, wait
+
+        def rdns(ip):
+            try:
+                return socket.gethostbyaddr(ip)[0]
+            except OSError:
+                return None
+
+        pool = ThreadPoolExecutor(max_workers=min(len(ips), 8))
+        futs = [pool.submit(rdns, ip) for ip in ips]
+        done, _ = wait(futs, timeout=1.5)
+        names = {f.result() for f in done if f.result()}
+        # Do NOT join stragglers (a with-block would): a stuck resolver
+        # call may outlive the deadline; it dies with its thread.
+        pool.shutdown(wait=False, cancel_futures=True)
     return sorted(ips), sorted(names)
 
 

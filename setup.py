@@ -29,6 +29,10 @@ setup(
         "grpcio>=1.60",
         "protobuf>=4.21",
     ],
+    extras_require={
+        # kubeconfig-based (out-of-cluster) k8s discovery
+        "k8s": ["PyYAML>=6.0"],
+    },
     entry_points={
         "console_scripts": [
             "gubernator-tpu=gubernator_tpu.cmd.server:main",

@@ -92,16 +92,25 @@ def run_storm(svc, n_workers, iters, churn_fn=None, behaviors=(0,)):
                     errors.append(e)
             time.sleep(0.002)
 
-    threads = [threading.Thread(target=worker, args=(t,)) for t in range(n_workers)]
-    churn_thread = threading.Thread(target=churner) if churn_fn else None
-    for t in threads:
-        t.start()
-    if churn_thread:
-        churn_thread.start()
-    for t in threads:
-        t.join(timeout=120)
-        assert not t.is_alive(), "worker deadlocked"
-    stop.set()
+    # daemon=True + stop in finally: a DETECTED deadlock must fail the
+    # test, not hang pytest at interpreter exit with the diagnosis lost.
+    threads = [
+        threading.Thread(target=worker, args=(t,), daemon=True)
+        for t in range(n_workers)
+    ]
+    churn_thread = (
+        threading.Thread(target=churner, daemon=True) if churn_fn else None
+    )
+    try:
+        for t in threads:
+            t.start()
+        if churn_thread:
+            churn_thread.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "worker deadlocked"
+    finally:
+        stop.set()
     if churn_thread:
         churn_thread.join(timeout=10)
         assert not churn_thread.is_alive(), "churner deadlocked"
@@ -180,7 +189,9 @@ def test_shutdown_races_traffic():
             with lock:
                 outcome["done"] += 1
 
-    threads = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
+    threads = [
+        threading.Thread(target=worker, args=(t,), daemon=True) for t in range(4)
+    ]
     for t in threads:
         t.start()
     started.wait(timeout=10)
