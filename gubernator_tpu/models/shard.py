@@ -604,6 +604,33 @@ class ShardStore(ColumnarPipeline):
     # ------------------------------------------------------------------
     def _apply_native(self, requests, now_ms: int, responses) -> None:
         n = len(requests)
+        if n == 0:
+            return
+        greg_bit = int(Behavior.DURATION_IS_GREGORIAN)
+        behavior = np.fromiter((r.behavior for r in requests), np.int32, count=n)
+        if not (behavior & greg_bit).any():
+            # Common case: no calendar lanes — extract each field in one
+            # tight comprehension pass instead of a per-request loop
+            # (the dataclass API's host cost is exactly this extraction).
+            keys = [r.hash_key() for r in requests]
+            cols = make_columns(
+                np.fromiter((r.algorithm for r in requests), np.int32, count=n),
+                behavior,
+                np.fromiter((r.hits for r in requests), np.int64, count=n),
+                np.fromiter((r.limit for r in requests), np.int64, count=n),
+                np.fromiter((r.duration for r in requests), np.int64, count=n),
+                n,
+            )
+            status, remaining, reset = self._run_columns(keys, cols, now_ms)
+            limit = cols.limit
+            for j in range(n):
+                responses[j] = RateLimitResponse(
+                    status=int(status[j]),
+                    limit=int(limit[j]),
+                    remaining=int(remaining[j]),
+                    reset_time=int(reset[j]),
+                )
+            return
         keys: List[str] = []
         vidx = np.empty(n, dtype=np.int64)
         cols = _Columns(n)

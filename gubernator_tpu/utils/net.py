@@ -66,24 +66,37 @@ def discover_network_addresses() -> "tuple[list[str], list[str]]":
         ips.add(fallback)
     # Reverse-DNS with a hard deadline: a broken resolver must not add
     # its full timeout+retry cycle per IP to daemon startup (this runs
-    # inside AutoTLS cert generation).
-    names = set()
+    # inside AutoTLS cert generation).  Plain DAEMON threads, not a
+    # ThreadPoolExecutor: concurrent.futures' atexit hook joins its
+    # non-daemon workers, so one stuck gethostbyaddr would hang process
+    # shutdown; daemon threads genuinely die with the process.
+    names: set = set()
     if ips:
-        from concurrent.futures import ThreadPoolExecutor, wait
+        import threading
+
+        lock = threading.Lock()
 
         def rdns(ip):
             try:
-                return socket.gethostbyaddr(ip)[0]
+                name = socket.gethostbyaddr(ip)[0]
             except OSError:
-                return None
+                return
+            with lock:
+                names.add(name)
 
-        pool = ThreadPoolExecutor(max_workers=min(len(ips), 8))
-        futs = [pool.submit(rdns, ip) for ip in ips]
-        done, _ = wait(futs, timeout=1.5)
-        names = {f.result() for f in done if f.result()}
-        # Do NOT join stragglers (a with-block would): a stuck resolver
-        # call may outlive the deadline; it dies with its thread.
-        pool.shutdown(wait=False, cancel_futures=True)
+        threads = [
+            threading.Thread(target=rdns, args=(ip,), daemon=True) for ip in ips
+        ]
+        for t in threads:
+            t.start()
+        deadline = 1.5
+        import time
+
+        end = time.monotonic() + deadline
+        for t in threads:
+            t.join(timeout=max(end - time.monotonic(), 0))
+        with lock:
+            names = set(names)
     return sorted(ips), sorted(names)
 
 
