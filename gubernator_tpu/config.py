@@ -97,7 +97,9 @@ class DaemonConfig:
     # startup warmup: each pad_size bucket is a distinct XLA program,
     # and on a remote device its first dispatch pays a multi-second
     # executable load — better inside startup than a client deadline.
-    warmup_shapes: List[int] = field(default_factory=lambda: [1])
+    # The default covers every bucket up to the 1000-item request cap
+    # (pads 64/256/1024), so client and peer RPCs never dispatch cold.
+    warmup_shapes: List[int] = field(default_factory=lambda: [1, 250, 1000])
 
     def resolved_advertise(self) -> str:
         return self.advertise_address or self.listen_address

@@ -220,11 +220,11 @@ def _make_handler(service: V1Service):
                     with service.metrics.observe_rpc(
                         "/pb.gubernator.PeersV1/GetPeerRateLimits"
                     ):
-                        req = GetRateLimitsRequest.from_json(body)
-                        resp = service.get_peer_rate_limits(req)
+                        cols = parse_columns(body.get("requests", []))
+                        result = service.get_peer_rate_limits_columns(cols)
                     # PeersV1 response field is rate_limits (peers.proto:42-45).
                     self._send_json(
-                        200, {"rateLimits": [r.to_json() for r in resp.responses]}
+                        200, {"rateLimits": render_columns(result)["responses"]}
                     )
                 elif self.path == "/v1/peer.UpdatePeerGlobals":
                     with service.metrics.observe_rpc(

@@ -186,10 +186,12 @@ def _peers_v1_handler(service: V1Service) -> grpc.GenericRpcHandler:
         request: peers_pb.GetPeerRateLimitsReq, context
     ) -> peers_pb.GetPeerRateLimitsResp:
         try:
-            resp = service.get_peer_rate_limits(wire.peer_rate_limits_req_from_pb(request))
+            result = service.get_peer_rate_limits_columns(
+                wire.columns_from_pb(request)
+            )
+            return wire.columns_to_peer_pb(result)
         except ApiError as e:
             _abort_api_error(context, e)
-        return wire.peer_rate_limits_resp_to_pb(resp)
 
     def update_peer_globals(
         request: peers_pb.UpdatePeerGlobalsReq, context

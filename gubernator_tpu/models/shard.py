@@ -33,16 +33,23 @@ from ..types import (
 from ..utils import gregorian
 from .slot_table import SlotTable
 
-# Batches are padded to a power of TWO >= 64: compiles are expensive on
-# a TPU tunnel while padded kernel lanes are microseconds, so few
-# distinct shapes beats tight padding (one compilation per size ever
-# seen); power-of-two growth keeps wasted transfer bytes under 2x.
+# Batches pad to a small set of bucket sizes: each bucket is its own
+# XLA program, and a program's FIRST dispatch pays a compile (or, on a
+# remote device, a multi-second executable load) — so few distinct
+# shapes beats tight padding.  Below 1024 buckets grow 4x (64, 256,
+# 1024: padded lanes cost microseconds, and these are the sizes the
+# service/peer planes hit, where a cold bucket can blow an RPC
+# deadline); above 1024 they grow 2x (wasting up to half a large
+# batch's scatter time would be real money).
 _PAD_MIN = 64
+_PAD_COARSE_MAX = 1024
 _PAD_MAX = 1 << 20
 
 
 def pad_size(n: int) -> int:
     p = _PAD_MIN
+    while p < n and p < _PAD_COARSE_MAX:
+        p <<= 2
     while p < n and p < _PAD_MAX:
         p <<= 1
     if n <= p:

@@ -382,7 +382,12 @@ class V1Service:
 
         # Ownership: the single-self-peer daemon (the common standalone
         # topology) owns everything; multi-peer rings resolve owners in
-        # one vectorized pass.
+        # one vectorized pass.  Plain remote lanes group by owner for
+        # ONE forwarded RPC per owner (the batch-sized analogue of the
+        # reference's per-item forward window); GLOBAL remote lanes
+        # keep the replica-cache dataclass path.
+        remote_groups: Dict[str, list] = {}  # owner addr -> [lane idx]
+        remote_peers: Dict[str, PeerClient] = {}
         with self._peer_mutex:
             psize = self.local_picker.size()
             single_owner = False
@@ -410,103 +415,144 @@ class V1Service:
                     peer = self.local_picker.get_by_peer_id(next(it))
                     if peer is None or not peer.info.is_owner:
                         fast[i] = False
+                        if peer is not None and not slow[i]:
+                            # Plain remote lane: group-forward.  A None
+                            # peer (churn mid-resolve) stays on the
+                            # dataclass router, which re-picks.
+                            addr = peer.info.grpc_address
+                            remote_groups.setdefault(addr, []).append(i)
+                            remote_peers[addr] = peer
                         slow[i] = True
 
-        # Gregorian precompute for fast lanes (slow lanes redo it in
-        # prepare_requests; cheap, memoized per duration).
-        greg_expire = greg_duration = None
-        greg_lanes = fast & ((beh & int(Behavior.DURATION_IS_GREGORIAN)) != 0)
-        if greg_lanes.any():
-            from .models.shard import GregResolver
-            from .utils import gregorian as _greg
+        self._queue_mr_fast(cols, beh, fast, hash_keys)
+        pending, fast_idx = self._dispatch_fast(cols, beh, fast, hash_keys, result)
 
-            greg_expire = np.zeros(n, dtype=np.int64)
-            greg_duration = np.zeros(n, dtype=np.int64)
-            resolver = GregResolver(self.clock.now_ms())
-            for i in np.nonzero(greg_lanes)[0]:
-                cached = resolver.resolve(int(cols.duration[i]))
-                if isinstance(cached, _greg.GregorianError):
-                    result.overrides[int(i)] = RateLimitResponse(error=str(cached))
-                    fast[i] = False
-                    continue
-                greg_expire[i], greg_duration[i] = cached
-
-        # MULTI_REGION fast lanes owe the async cross-region hit queue
-        # (gubernator.go:343-345): aggregate per key first so the queue
-        # sees one materialized request per unique key, not per lane.
-        mr = fast & ((beh & int(Behavior.MULTI_REGION)) != 0)
-        if mr.any():
-            agg: Dict[str, RateLimitRequest] = {}
-            for i in np.nonzero(mr)[0]:
-                k = hash_keys[int(i)]
-                cur = agg.get(k)
-                if cur is None:
-                    agg[k] = cols.request_at(int(i))
-                else:
-                    cur.hits += int(cols.hits[i])
-            for r in agg.values():
-                self.multi_region_mgr.queue_hits(r)
-
-        pending = None  # (handle, lo, hi) after the dispatch resolves
-        fast_idx = np.nonzero(fast)[0]
-        if fast_idx.size:
-            full = fast_idx.size == n
-            sl = slice(None) if full else fast_idx
-            keys_sel = hash_keys if full else [hash_keys[i] for i in fast_idx]
-            args = (
-                keys_sel, cols.algorithm[sl], beh[sl], cols.hits[sl],
-                cols.limit[sl], cols.duration[sl],
-                None if greg_expire is None else greg_expire[sl],
-                None if greg_duration is None else greg_duration[sl],
+        # Plain remote lanes: ONE forwarded GetPeerRateLimits per owner,
+        # dispatched in parallel while the local fast dispatch is in
+        # flight (the batch-sized analogue of the per-item forward,
+        # gubernator.go:195-210).
+        group_futs = {}
+        grouped: set = set()
+        for addr, idxs in remote_groups.items():
+            grouped.update(idxs)
+            reqs = [cols.request_at(int(i)) for i in idxs]
+            group_futs[addr] = self._forward_pool.submit(
+                self._forward_group, remote_peers[addr], reqs
             )
-            if (beh[sl] & int(Behavior.NO_BATCHING)).any():
-                # Any NO_BATCHING lane opts the dispatch out of the
-                # coalescing window — parity with the dataclass path,
-                # which dispatches multi-item batches immediately.
-                handle = self.store.apply_columns_async(
-                    *args[:6], self.clock.now_ms(), *args[6:]
-                )
-                pending = (handle, 0, fast_idx.size)
-            else:
-                # Concurrent requests inside one BatchWait window share
-                # a single device dispatch (ColumnarBatcher).
-                pending = self.columnar_batcher.submit(*args)
 
-        # Slow lanes (GLOBAL / MULTI_REGION / remote owners) ride the
-        # dataclass router while the fast dispatch is in flight.
-        slow_idx = np.nonzero(slow)[0]
-        if slow_idx.size:
-            resp = self._route([cols.request_at(int(i)) for i in slow_idx])
+        # Remaining slow lanes (GLOBAL remote/local specials) ride the
+        # dataclass router.
+        slow_idx = [int(i) for i in np.nonzero(slow)[0] if int(i) not in grouped]
+        if slow_idx:
+            resp = self._route([cols.request_at(i) for i in slow_idx])
             for i, r in zip(slow_idx, resp.responses):
+                result.overrides[i] = r
+
+        for addr, fut in group_futs.items():
+            resps = fut.result()
+            for i, r in zip(remote_groups[addr], resps):
                 result.overrides[int(i)] = r
 
-        if pending is not None:
-            try:
-                handle, lo, hi = (
-                    pending.result() if isinstance(pending, Future) else pending
-                )
-                out = handle.result()
-            except Exception as e:  # noqa: BLE001
-                # Per-lane error conversion, like the dataclass batcher
-                # path: a dispatch failure (e.g. shutdown race) must not
-                # 500 lanes whose responses were already computed.
-                for i in fast_idx:
-                    result.overrides[int(i)] = RateLimitResponse(
-                        error=f"while applying rate limit '{hash_keys[int(i)]}' - '{e}'"
-                    )
-                return result
-            sl = slice(lo, hi)
-            if fast_idx.size == n:
-                result.status = np.asarray(out["status"][sl], dtype=np.int32)
-                result.limit = np.asarray(out["limit"][sl], dtype=np.int64)
-                result.remaining = np.asarray(out["remaining"][sl], dtype=np.int64)
-                result.reset_time = np.asarray(out["reset_time"][sl], dtype=np.int64)
-            else:
-                result.status[fast_idx] = out["status"][sl]
-                result.limit[fast_idx] = out["limit"][sl]
-                result.remaining[fast_idx] = out["remaining"][sl]
-                result.reset_time[fast_idx] = out["reset_time"][sl]
+        self._resolve_fast(pending, fast_idx, hash_keys, result)
         return result
+
+    # -- shared fast-lane halves of the two columnar entry points ------
+    def _resolve_greg_fast(self, cols, beh, fast, result):
+        """Gregorian precompute for fast lanes (slow lanes redo it in
+        prepare_requests; cheap, memoized per duration).  Mutates `fast`
+        for error lanes; returns (greg_expire, greg_duration) or Nones."""
+        n = len(cols)
+        greg_lanes = fast & ((beh & int(Behavior.DURATION_IS_GREGORIAN)) != 0)
+        if not greg_lanes.any():
+            return None, None
+        from .models.shard import GregResolver
+        from .utils import gregorian as _greg
+
+        greg_expire = np.zeros(n, dtype=np.int64)
+        greg_duration = np.zeros(n, dtype=np.int64)
+        resolver = GregResolver(self.clock.now_ms())
+        for i in np.nonzero(greg_lanes)[0]:
+            cached = resolver.resolve(int(cols.duration[i]))
+            if isinstance(cached, _greg.GregorianError):
+                result.overrides[int(i)] = RateLimitResponse(error=str(cached))
+                fast[i] = False
+                continue
+            greg_expire[i], greg_duration[i] = cached
+        return greg_expire, greg_duration
+
+    def _queue_mr_fast(self, cols, beh, fast, hash_keys) -> None:
+        """MULTI_REGION fast lanes owe the async cross-region hit queue
+        (gubernator.go:343-345): aggregate per key first so the queue
+        sees one materialized request per unique key, not per lane."""
+        mr = fast & ((beh & int(Behavior.MULTI_REGION)) != 0)
+        if not mr.any():
+            return
+        agg: Dict[str, RateLimitRequest] = {}
+        for i in np.nonzero(mr)[0]:
+            k = hash_keys[int(i)]
+            cur = agg.get(k)
+            if cur is None:
+                agg[k] = cols.request_at(int(i))
+            else:
+                cur.hits += int(cols.hits[i])
+        for r in agg.values():
+            self.multi_region_mgr.queue_hits(r)
+
+    def _dispatch_fast(self, cols, beh, fast, hash_keys, result):
+        """Dispatch the fast lanes (Gregorian precompute included):
+        through the coalescing window normally, directly when any lane
+        opts out with NO_BATCHING (parity with the dataclass path,
+        which dispatches multi-item batches immediately).  Returns
+        (pending, fast_idx) for _resolve_fast."""
+        greg_expire, greg_duration = self._resolve_greg_fast(cols, beh, fast, result)
+        fast_idx = np.nonzero(fast)[0]
+        if not fast_idx.size:
+            return None, fast_idx
+        n = len(cols)
+        full = fast_idx.size == n
+        sl = slice(None) if full else fast_idx
+        keys_sel = hash_keys if full else [hash_keys[i] for i in fast_idx]
+        args = (
+            keys_sel, cols.algorithm[sl], beh[sl], cols.hits[sl],
+            cols.limit[sl], cols.duration[sl],
+            None if greg_expire is None else greg_expire[sl],
+            None if greg_duration is None else greg_duration[sl],
+        )
+        if (beh[sl] & int(Behavior.NO_BATCHING)).any():
+            handle = self.store.apply_columns_async(
+                *args[:6], self.clock.now_ms(), *args[6:]
+            )
+            return (handle, 0, fast_idx.size), fast_idx
+        return self.columnar_batcher.submit(*args), fast_idx
+
+    def _resolve_fast(self, pending, fast_idx, hash_keys, result) -> None:
+        """Block on the fast dispatch and scatter its arrays into the
+        result; a dispatch failure (e.g. shutdown race) converts to
+        per-lane errors instead of failing lanes already computed."""
+        if pending is None:
+            return
+        try:
+            handle, lo, hi = (
+                pending.result() if isinstance(pending, Future) else pending
+            )
+            out = handle.result()
+        except Exception as e:  # noqa: BLE001
+            for i in fast_idx:
+                result.overrides[int(i)] = RateLimitResponse(
+                    error=f"while applying rate limit '{hash_keys[int(i)]}' - '{e}'"
+                )
+            return
+        sl = slice(lo, hi)
+        if fast_idx.size == result.n:
+            result.status = np.asarray(out["status"][sl], dtype=np.int32)
+            result.limit = np.asarray(out["limit"][sl], dtype=np.int64)
+            result.remaining = np.asarray(out["remaining"][sl], dtype=np.int64)
+            result.reset_time = np.asarray(out["reset_time"][sl], dtype=np.int64)
+        else:
+            result.status[fast_idx] = out["status"][sl]
+            result.limit[fast_idx] = out["limit"][sl]
+            result.remaining[fast_idx] = out["remaining"][sl]
+            result.reset_time[fast_idx] = out["reset_time"][sl]
 
     def _route(self, requests: Sequence[RateLimitRequest]) -> GetRateLimitsResponse:
         n = len(requests)
@@ -601,6 +647,36 @@ class V1Service:
         except PeerError as e:
             return None, e
 
+    def _forward_group(
+        self, peer: PeerClient, reqs: List[RateLimitRequest]
+    ) -> List[RateLimitResponse]:
+        """Forward a whole owner-group in one GetPeerRateLimits RPC
+        (columnar ingress).  A not-ready peer degrades to the per-item
+        forward path, which owns the re-pick retry loop
+        (gubernator.go:154-162); other failures convert per lane."""
+        try:
+            resp = peer.get_peer_rate_limits(
+                GetRateLimitsRequest(requests=reqs),
+                timeout_s=self.conf.behaviors.batch_timeout_s,
+            )
+            # PeerClient raises on any response-length mismatch, so the
+            # zip below is always aligned.
+            out = list(resp.responses)
+            for r in out:
+                r.metadata = {"owner": peer.info.grpc_address}
+            return out
+        except Exception as e:  # noqa: BLE001
+            if is_not_ready(e):
+                return [self._forward_one(r, peer) for r in reqs]
+            return [
+                RateLimitResponse(
+                    error=(
+                        f"while fetching rate limit '{r.hash_key()}' from peer - '{e}'"
+                    )
+                )
+                for r in reqs
+            ]
+
     def _forward_one(self, r: RateLimitRequest, peer: PeerClient) -> RateLimitResponse:
         """Forward to the owner (the BATCHING leg, gubernator.go:195-210),
         retrying with a re-pick when the peer is not ready."""
@@ -649,6 +725,53 @@ class V1Service:
             if has_behavior(r.behavior, Behavior.MULTI_REGION):
                 self.multi_region_mgr.queue_hits(r)
         return GetRateLimitsResponse(responses=resps)
+
+    def get_peer_rate_limits_columns(self, cols: IngressColumns) -> ColumnarResult:
+        """Column-form PeersV1 receive path: every lane is owned HERE
+        (the sender already routed), so non-GLOBAL lanes go straight to
+        the columnar kernel via the shared coalescing window —
+        concurrent peers' sub-batches merge into one device dispatch.
+        GLOBAL lanes keep the dataclass path (owner-side dirty marking
+        for the broadcast pipeline, gubernator.go:339-341)."""
+        n = len(cols)
+        if n > MAX_BATCH_SIZE:
+            raise ApiError(
+                "OutOfRange",
+                f"'PeerRequest.rate_limits' list too large; max size is '{MAX_BATCH_SIZE}'",
+            )
+        result = ColumnarResult.empty(n)
+        if n == 0:
+            return result
+        beh = cols.behavior
+        if not getattr(self.store, "supports_columns", False):
+            req = GetRateLimitsRequest(
+                requests=[cols.request_at(i) for i in range(n)]
+            )
+            result.overrides = dict(enumerate(self.get_peer_rate_limits(req).responses))
+            return result
+
+        slow = (beh & int(Behavior.GLOBAL)) != 0
+        fast = np.logical_not(slow)
+        hash_keys = [
+            f"{nm}_{uk}" for nm, uk in zip(cols.names, cols.unique_keys)
+        ]
+        # MULTI_REGION queueing covers EVERY lane here (the reference
+        # queues after applying each forwarded request,
+        # gubernator.go:340-341 via GetPeerRateLimits); pass an all-True
+        # mask so GLOBAL+MULTI_REGION lanes queue too.
+        self._queue_mr_fast(cols, beh, np.ones(n, dtype=bool), hash_keys)
+        pending, fast_idx = self._dispatch_fast(cols, beh, fast, hash_keys, result)
+
+        slow_idx = np.nonzero(slow)[0]
+        if slow_idx.size:
+            resps = self.store.apply(
+                [cols.request_at(int(i)) for i in slow_idx], self.clock.now_ms()
+            )
+            for i, r in zip(slow_idx, resps):
+                result.overrides[int(i)] = r
+
+        self._resolve_fast(pending, fast_idx, hash_keys, result)
+        return result
 
     def update_peer_globals(self, updates: Sequence[UpdatePeerGlobal]) -> None:
         """gubernator.go:259-272."""

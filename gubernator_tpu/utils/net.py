@@ -96,7 +96,8 @@ def discover_network_addresses() -> "tuple[list[str], list[str]]":
         for t in threads:
             t.join(timeout=max(end - time.monotonic(), 0))
         with lock:
-            names = set(names)
+            snapshot = set(names)
+        return sorted(ips), sorted(snapshot)
     return sorted(ips), sorted(names)
 
 

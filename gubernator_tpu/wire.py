@@ -127,8 +127,7 @@ def columns_from_pb(m: pb.GetRateLimitsReq):
     )
 
 
-def columns_to_pb(result) -> pb.GetRateLimitsResp:
-    """Serialize a service.ColumnarResult directly from its arrays."""
+def _columns_to_resp_list(result):
     ov = result.overrides
     status = result.status
     limit = result.limit
@@ -148,7 +147,18 @@ def columns_to_pb(result) -> pb.GetRateLimitsResp:
                     reset_time=int(reset[i]),
                 )
             )
-    return pb.GetRateLimitsResp(responses=out)
+    return out
+
+
+def columns_to_pb(result) -> pb.GetRateLimitsResp:
+    """Serialize a service.ColumnarResult directly from its arrays."""
+    return pb.GetRateLimitsResp(responses=_columns_to_resp_list(result))
+
+
+def columns_to_peer_pb(result) -> peers_pb.GetPeerRateLimitsResp:
+    """PeersV1 twin of columns_to_pb (field name rate_limits,
+    peers.proto:42-45)."""
+    return peers_pb.GetPeerRateLimitsResp(rate_limits=_columns_to_resp_list(result))
 
 
 # ---- GLOBAL broadcast ------------------------------------------------

@@ -340,3 +340,58 @@ def test_kubeconfig_http_server_no_tls(tmp_path):
     client = K8sApiClient.from_kubeconfig(str(kc))
     assert client.api_url == "http://127.0.0.1:8001"
     assert client._ssl_ctx is None
+
+
+def test_kubeconfig_client_cert_relative_paths(tmp_path):
+    """Client-certificate auth with RELATIVE paths: clientcmd resolves
+    them against the kubeconfig's own directory, and so do we; the ssl
+    context must actually load the chain (a bad key errors here)."""
+    from gubernator_tpu.k8s_pool import K8sApiClient
+    from gubernator_tpu.tls import self_ca, self_cert
+
+    ca_crt, ca_key = self_ca(str(tmp_path))
+    crt, key = self_cert(str(tmp_path), ca_crt, ca_key, name="client", client=True)
+    kc = tmp_path / "config"
+    kc.write_text(
+        "\n".join([
+            "current-context: dev",
+            "contexts:",
+            "- name: dev",
+            "  context: {cluster: c, user: u}",
+            "clusters:",
+            "- name: c",
+            "  cluster:",
+            "    server: https://k8s.example:6443",
+            "    certificate-authority: ca.crt",  # relative to kubeconfig dir
+            "users:",
+            "- name: u",
+            "  user:",
+            "    client-certificate: client.crt",
+            "    client-key: client.key",
+        ])
+    )
+    client = K8sApiClient.from_kubeconfig(str(kc))
+    assert client._ssl_ctx is not None  # chain loaded without error
+
+
+def test_kubeconfig_exec_auth_rejected(tmp_path):
+    from gubernator_tpu.k8s_pool import K8sApiClient
+
+    kc = tmp_path / "config"
+    kc.write_text(
+        "\n".join([
+            "current-context: dev",
+            "contexts:",
+            "- name: dev",
+            "  context: {cluster: c, user: u}",
+            "clusters:",
+            "- name: c",
+            "  cluster: {server: 'https://k8s.example:6443'}",
+            "users:",
+            "- name: u",
+            "  user:",
+            "    exec: {command: aws}",
+        ])
+    )
+    with pytest.raises(ValueError, match="exec"):
+        K8sApiClient.from_kubeconfig(str(kc))
