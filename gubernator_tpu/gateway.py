@@ -21,12 +21,7 @@ from typing import Optional
 import numpy as np
 
 from .service import ApiError, ColumnarResult, IngressColumns, V1Service
-from .types import (
-    Algorithm,
-    GetRateLimitsRequest,
-    UpdatePeerGlobal,
-    _parse_behavior,
-)
+from .types import Algorithm, UpdatePeerGlobal, _parse_behavior
 
 _GRPC_CODES = {"InvalidArgument": 3, "OutOfRange": 11, "Internal": 13}
 
