@@ -1,0 +1,129 @@
+"""Device probes for the bucket-kernel commit path redesign (round 3).
+
+Measures, on the real chip, the primitive costs that decide the fused
+kernel design: XLA gather vs scatter per-element cost, scatter variants
+(column/row/sorted/unique), and Pallas dynamic-index feasibility.
+
+Each probe chains ITERS dependent iterations inside one jit so the
+tunnel RTT amortizes; reported number is device time per iteration.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+C = 2_000_000
+B = 131_072
+ITERS = 8
+N_COLS = 11
+
+rng = np.random.RandomState(7)
+idx_np = rng.choice(C, size=B, replace=False).astype(np.int32)
+idx_sorted_np = np.sort(idx_np)
+vals_np = rng.randint(0, 1 << 30, size=(B,), dtype=np.int32)
+
+
+def bench(name, fn, *args, **extra):
+    out = jax.block_until_ready(fn(*args))  # compile + warm
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(*args))
+    dt = (time.perf_counter() - t0) / ITERS
+    del out
+    print(f"{name:42s} {dt*1e6:10.1f} us/iter  {extra}")
+    return dt
+
+
+def chain(body):
+    """jit a fori_loop that chains `body(state, i) -> state` ITERS times."""
+
+    @jax.jit
+    def run(state, *rest):
+        def f(i, st):
+            return body(st, i, *rest)
+
+        return jax.lax.fori_loop(0, ITERS, f, state)
+
+    return run
+
+
+def main():
+    cols = [jnp.zeros((C,), jnp.int32) for _ in range(N_COLS)]
+    idx = jnp.asarray(idx_np)
+    idx_sorted = jnp.asarray(idx_sorted_np)
+    vals = jnp.asarray(vals_np)
+
+    # --- elementwise pass over the batch (compute-ish floor) ---
+    def ew(st, i):
+        return [c + 1 for c in st]
+
+    bench("elementwise 11 cols full table", chain(ew), cols)
+
+    # --- gather: 11 columns at B random indices ---
+    def gath(st, i, ix):
+        acc = jnp.zeros((B,), jnp.int32)
+        for c in st:
+            acc = acc + c[ix]
+        return [st[0].at[0].set(acc[0])] + st[1:]
+
+    bench("gather 11 cols x131k random", chain(gath), cols, idx)
+
+    # --- scatter variants ---
+    def scat_cols(st, i, ix, v):
+        return [c.at[ix].set(v + i, mode="drop") for c in st]
+
+    bench("scatter 11 cols x131k random", chain(scat_cols), cols, idx, vals)
+
+    def scat_cols_u(st, i, ix, v):
+        return [
+            c.at[ix].set(v + i, mode="drop", unique_indices=True) for c in st
+        ]
+
+    bench("scatter 11 cols unique_indices", chain(scat_cols_u), cols, idx, vals)
+    bench("scatter 11 cols sorted+unique", chain(scat_cols_u), cols, idx_sorted, vals)
+
+    # --- row-major state: one scatter of [B,16] rows ---
+    rows_state = jnp.zeros((C, 16), jnp.int32)
+    row_vals = jnp.zeros((B, 16), jnp.int32)
+
+    def scat_rows(st, i, ix, v):
+        return st.at[ix].set(v + i, mode="drop", unique_indices=True)
+
+    bench("scatter rows [C,16] unique", chain(scat_rows), rows_state, idx, row_vals)
+    bench("scatter rows [C,16] sorted", chain(scat_rows), rows_state, idx_sorted, row_vals)
+
+    rows8 = jnp.zeros((C, 8), jnp.int32)
+    rv8 = jnp.zeros((B, 8), jnp.int32)
+    bench("scatter rows [C,8] unique", chain(scat_rows), rows8, idx, rv8)
+
+    rows128 = jnp.zeros((C // 8, 128), jnp.int32)
+    rv128 = jnp.zeros((B, 128), jnp.int32)
+    idx8 = jnp.asarray(idx_np % (C // 8))
+    bench("scatter rows [C/8,128] unique", chain(scat_rows), rows128, idx8, rv128)
+
+    def gath_rows(st, i, ix):
+        g = st[ix]
+        return st.at[0, 0].set(g[0, 0] + i)
+
+    bench("gather rows [C,16] x131k", chain(gath_rows), rows_state, idx)
+
+    # --- on-device sort cost (for slot-sorted scatter) ---
+    def sortcost(st, i, v):
+        s = jnp.sort(v + i)
+        return st.at[0].set(s[0], mode="drop")
+
+    bench("sort 131k i32", chain(sortcost), cols[0], idx)
+
+    def argsortcost(st, i, v):
+        s = jnp.argsort(v + i)
+        return st.at[0].set(s[0].astype(jnp.int32), mode="drop")
+
+    bench("argsort 131k i32", chain(argsortcost), cols[0], idx)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+"""Round-3 probe #2: DCE-proof device costs.
+
+Every body is a gather->modify->scatter chain on the same state, so no
+iteration can be elided; all ITERS run inside ONE jit dispatch so the
+tunnel's per-dispatch cost is excluded.  Cross-checks bench.py's 32ms
+"device_batch_us" (which pays one tunnel enqueue per batch).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+C = 262_144
+B = 131_072
+ITERS = 16
+N_COLS = 11
+
+rng = np.random.RandomState(7)
+idx_np = rng.choice(C, size=B, replace=False).astype(np.int32)
+
+
+def bench(name, fn, *args):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(*args))
+    dt = (time.perf_counter() - t0) / ITERS
+    del out
+    print(f"{name:44s} {dt*1e6:10.1f} us/iter", flush=True)
+    return dt
+
+
+def chain(body):
+    @jax.jit
+    def run(state, *rest):
+        def f(i, st):
+            return body(st, i, *rest)
+
+        return jax.lax.fori_loop(0, ITERS, f, state)
+
+    return run
+
+
+def main():
+    cols = [
+        jnp.asarray(rng.randint(0, 1 << 20, size=C, dtype=np.int32))
+        for _ in range(N_COLS)
+    ]
+    idx = jnp.asarray(idx_np)
+
+    # rmw: gather all 11, add, scatter all 11 (the commit path shape)
+    def rmw_cols(st, i, ix):
+        gs = [c[ix] for c in st]
+        return [
+            c.at[ix].set(g + 1, mode="drop", unique_indices=True)
+            for c, g in zip(st, gs)
+        ]
+
+    bench("rmw 11 cols gather+scatter", chain(rmw_cols), cols, idx)
+
+    # same but only 4 columns scattered (hot-column variant)
+    def rmw_cols4(st, i, ix):
+        gs = [c[ix] for c in st]
+        upd = [
+            c.at[ix].set(g + 1, mode="drop", unique_indices=True)
+            for c, g in zip(st[:4], gs[:4])
+        ]
+        return upd + [c + g[0] * 0 for c, g in zip(st[4:], gs[4:])]
+
+    bench("rmw gather 11 / scatter 4 cols", chain(rmw_cols4), cols, idx)
+
+    # row-major [C,16]
+    rows = jnp.asarray(rng.randint(0, 1 << 20, size=(C, 16), dtype=np.int32))
+
+    def rmw_rows(st, i, ix):
+        g = st[ix]
+        return st.at[ix].set(g + 1, mode="drop", unique_indices=True)
+
+    bench("rmw rows [C,16]", chain(rmw_rows), rows, idx)
+
+    # full-table elementwise (bandwidth sanity: 11 cols r+w)
+    def ew(st, i, ix):
+        return [c + jnp.int32(i) for c in st]
+
+    bench("elementwise 11 cols full table", chain(ew), cols, idx)
+
+    # the real kernel, chained in one jit
+    from gubernator_tpu.ops import buckets
+
+    state = buckets.init_state(C)
+    slot = np.arange(B, dtype=np.int32)
+    b32 = buckets.make_batch32(
+        slot,
+        np.ones(B, dtype=bool),
+        (slot % 2).astype(np.int32),
+        np.zeros(B, np.int32),
+        np.ones(B, np.int32),
+        np.full(B, 1 << 30, np.int32),
+        np.full(B, 3_600_000, np.int32),
+    )
+    rid = jnp.zeros(B, jnp.int32)
+    now0 = jnp.int64(1_700_000_000_000)
+
+    @jax.jit
+    def kern_chain(st, req, rid):
+        def f(i, st):
+            st, packed = buckets.apply_rounds32(
+                st, req, rid, jnp.int32(1), now0 + i.astype(jnp.int64)
+            )
+            # fold one packed element back in so nothing is dead
+            st = st._replace(hot=st.hot.at[0, 0].add(packed[0, 0] & 0))
+            return st
+
+        return jax.lax.fori_loop(0, ITERS, f, st)
+
+    # create buckets first
+    create = b32._replace(exists=jnp.zeros(B, bool))
+    state, _ = buckets.apply_rounds32_jit(state, create, rid, jnp.int32(1), now0)
+    bench("apply_rounds32 in-jit chain", kern_chain, state, b32, rid)
+
+    # per-dispatch enqueue cost over the tunnel (bench.py methodology)
+    state2 = buckets.init_state(C)
+    state2, packed = buckets.apply_rounds32_jit(state2, create, rid, jnp.int32(1), now0)
+    np.asarray(packed[0, :1])
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state2, packed = buckets.apply_rounds32_jit(
+            state2, b32, rid, jnp.int32(1), now0
+        )
+    np.asarray(packed[0, :1])
+    dt = (time.perf_counter() - t0) / ITERS
+    print(f"{'apply_rounds32 per-dispatch (tunnel)':44s} {dt*1e6:10.1f} us/iter")
+
+
+if __name__ == "__main__":
+    main()

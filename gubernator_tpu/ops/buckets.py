@@ -148,37 +148,47 @@ def _leak_amounts(el_c, lim_nn, rn):
 
 
 class BucketState(NamedTuple):
-    """Struct-of-arrays bucket table for one shard (capacity C), stored
-    as SPLIT int32 columns.
+    """Bucket table for one shard (capacity C), stored as TWO row-major
+    int32 arrays of shape [C, 8].
 
     Logically each slot holds the union of the reference's
     TokenBucketItem / LeakyBucketItem (store.go:11-24) plus CacheItem
     bookkeeping (cache.go:64-76): algo, limit, remaining (leaky scaled
     by LEAKY_SCALE), duration, stamp (CreatedAt/UpdatedAt), expire_at
-    (expiry-as-miss), sticky status.
+    (expiry-as-miss), sticky status.  Every int64 value is a lo/hi i32
+    pair; algo+status pack into one flags lane (bits 0-1 algo, bit 2
+    status).
 
-    PHYSICALLY every int64 value is stored as a lo/hi int32 pair and
-    algo+status pack into one flags column (bits 0-1 algo, bit 2
-    status).  Rationale (measured on TPU v5e): the kernel is
-    scatter-bound and XLA's random-index scatters cost ~3x more per
-    int64 element than per int32 — splitting 5 i64 + 2 i32 columns into
-    11 i32 columns cuts the per-batch device time ~3.5x.  The kernel
-    recomposes to int64 after the gather and decomposes before the
-    scatter, so the arithmetic (and the wire formats) are bit-identical
-    to the logical layout.  Host exchange uses BucketRows.
+    PHYSICAL layout (measured on TPU v5e, round 3): XLA's random-index
+    scatter is the kernel's whole cost, and its price is per scattered
+    ROW, not per element — 11 separate [C] column scatters cost ~24ms
+    per 131k batch where ONE [C,8] row scatter costs ~2.7ms (and i64
+    rows cost ~6x i32 rows).  So the state is two 8-lane i32 row
+    tables split by write frequency:
+
+      hot[C, 8]  — rewritten on every hit:
+        0 flags, 1 remaining_lo, 2 remaining_hi, 3 stamp_lo,
+        4 stamp_hi, 5 expire_lo, 6 expire_hi, 7 spare
+      cold[C, 8] — rewritten only when a lane's stored config changes
+                   (create, limit/duration hot-change, algo switch):
+        0 limit_lo, 1 limit_hi, 2 duration_lo, 3 duration_hi, 4-7 spare
+
+    The cold scatter is guarded by a lax.cond on "any lane changed its
+    config", so steady-state traffic pays exactly one row scatter per
+    batch.  The kernel recomposes int64 after the gather and decomposes
+    before the scatter, so the arithmetic (and the wire formats) are
+    bit-identical to the logical layout.  Host exchange uses BucketRows.
     """
 
-    flags: jax.Array  # i32[C]: bits 0-1 algo, bit 2 sticky status
-    limit_lo: jax.Array  # i32[C]
-    limit_hi: jax.Array  # i32[C]
-    remaining_lo: jax.Array  # i32[C]
-    remaining_hi: jax.Array  # i32[C]
-    duration_lo: jax.Array  # i32[C]
-    duration_hi: jax.Array  # i32[C]
-    stamp_lo: jax.Array  # i32[C]
-    stamp_hi: jax.Array  # i32[C]
-    expire_lo: jax.Array  # i32[C]
-    expire_hi: jax.Array  # i32[C]
+    hot: jax.Array  # i32[C, 8]
+    cold: jax.Array  # i32[C, 8]
+
+
+# hot lane indices
+_H_FLAGS, _H_REM_LO, _H_REM_HI = 0, 1, 2
+_H_STAMP_LO, _H_STAMP_HI, _H_EXP_LO, _H_EXP_HI = 3, 4, 5, 6
+# cold lane indices
+_C_LIM_LO, _C_LIM_HI, _C_DUR_LO, _C_DUR_HI = 0, 1, 2, 3
 
 
 class BucketRows(NamedTuple):
@@ -210,8 +220,36 @@ def _hi32(v):
     return (v >> 32).astype(_I32)
 
 
+def _pack_hot(flags, remaining, stamp, expire) -> jax.Array:
+    """Stack hot row values into [N, 8] (lane order: see BucketState)."""
+    z = jnp.zeros_like(flags)
+    return jnp.stack(
+        (
+            flags,
+            _lo32(remaining), _hi32(remaining),
+            _lo32(stamp), _hi32(stamp),
+            _lo32(expire), _hi32(expire),
+            z,
+        ),
+        axis=-1,
+    )
+
+
+def _pack_cold(limit, duration) -> jax.Array:
+    """Stack cold row values into [N, 8]."""
+    z = jnp.zeros_like(_lo32(limit))
+    return jnp.stack(
+        (
+            _lo32(limit), _hi32(limit),
+            _lo32(duration), _hi32(duration),
+            z, z, z, z,
+        ),
+        axis=-1,
+    )
+
+
 def rows_to_split(rows: BucketRows) -> BucketState:
-    """Decompose logical rows into the split column layout (same
+    """Decompose logical rows into the hot/cold row layout (same
     leading length); the write-side twin of read_rows' composition."""
     algo = jnp.asarray(rows.algo, _I32)
     status = jnp.asarray(rows.status, _I32)
@@ -220,13 +258,10 @@ def rows_to_split(rows: BucketRows) -> BucketState:
     duration = jnp.asarray(rows.duration, _I64)
     stamp = jnp.asarray(rows.stamp, _I64)
     expire = jnp.asarray(rows.expire_at, _I64)
+    flags = (algo & 3) | ((status & 1) << 2)
     return BucketState(
-        flags=(algo & 3) | ((status & 1) << 2),
-        limit_lo=_lo32(limit), limit_hi=_hi32(limit),
-        remaining_lo=_lo32(remaining), remaining_hi=_hi32(remaining),
-        duration_lo=_lo32(duration), duration_hi=_hi32(duration),
-        stamp_lo=_lo32(stamp), stamp_hi=_hi32(stamp),
-        expire_lo=_lo32(expire), expire_hi=_hi32(expire),
+        hot=_pack_hot(flags, remaining, stamp, expire),
+        cold=_pack_cold(limit, duration),
     )
 
 
@@ -270,7 +305,10 @@ class BatchOutput(NamedTuple):
 
 def init_state(capacity: int) -> BucketState:
     """Fresh all-expired bucket table (expire_at=0 => every slot is free)."""
-    return BucketState(*[jnp.zeros((capacity,), _I32) for _ in BucketState._fields])
+    return BucketState(
+        hot=jnp.zeros((capacity, 8), _I32),
+        cold=jnp.zeros((capacity, 8), _I32),
+    )
 
 
 def make_batch(
@@ -304,28 +342,39 @@ def make_batch(
     )
 
 
-def apply_batch(state: BucketState, req: RequestBatch, now_ms) -> "tuple[BucketState, BatchOutput]":
+def apply_batch(
+    state: BucketState, req: RequestBatch, now_ms, cold_cond: bool = True
+) -> "tuple[BucketState, BatchOutput]":
     """Evaluate one batch against the bucket table.
 
     Pure function: returns (new_state, responses).  Slots must be unique
     within the batch (the host splits duplicate-key batches into
     flush-separated rounds; see ShardStore.apply) so the gather/scatter
     is race-free.
+
+    `cold_cond` (static) guards the cold-row scatter with a lax.cond so
+    steady-state batches skip it.  Under jax.vmap (the mesh store's
+    per-shard kernels) cond lowers to executing BOTH branches plus a
+    select — strictly worse than scattering unconditionally — so
+    vmapped callers must pass cold_cond=False.
     """
     now = jnp.asarray(now_ms, _I64)
-    C = state.flags.shape[0]
+    C = state.hot.shape[0]
 
     valid = req.slot >= 0
     s = jnp.clip(req.slot, 0, C - 1)
 
-    g_flags = state.flags[s]
+    # Two row gathers (cheap, vectorized) instead of 11 column gathers.
+    hot_g = state.hot[s]  # [B, 8]
+    cold_g = state.cold[s]  # [B, 8]
+    g_flags = hot_g[:, _H_FLAGS]
     g_algo = g_flags & 3
     g_status = (g_flags >> 2) & 1
-    g_limit = _compose64(state.limit_lo[s], state.limit_hi[s])
-    g_rem = _compose64(state.remaining_lo[s], state.remaining_hi[s])
-    g_dur = _compose64(state.duration_lo[s], state.duration_hi[s])
-    g_stamp = _compose64(state.stamp_lo[s], state.stamp_hi[s])
-    g_exp = _compose64(state.expire_lo[s], state.expire_hi[s])
+    g_limit = _compose64(cold_g[:, _C_LIM_LO], cold_g[:, _C_LIM_HI])
+    g_rem = _compose64(hot_g[:, _H_REM_LO], hot_g[:, _H_REM_HI])
+    g_dur = _compose64(cold_g[:, _C_DUR_LO], cold_g[:, _C_DUR_HI])
+    g_stamp = _compose64(hot_g[:, _H_STAMP_LO], hot_g[:, _H_STAMP_HI])
+    g_exp = _compose64(hot_g[:, _H_EXP_LO], hot_g[:, _H_EXP_HI])
 
     # Expiry-as-miss: reference expires strictly (`ExpireAt < now`,
     # cache.go:151), so a slot at exactly its expiry is still live.
@@ -508,23 +557,38 @@ def apply_batch(state: BucketState, req: RequestBatch, now_ms) -> "tuple[BucketS
     # `.at[-1]` wraps like NumPy negative indexing, so map them to C
     # (out of bounds) where mode='drop' actually drops them.  In grouped
     # mode only the LAST occurrence of each duplicate group writes.
+    #
+    # ONE hot row scatter always; the cold scatter only runs when some
+    # write lane actually changed its stored config (create, limit or
+    # duration hot-change, algo switch) — steady-state batches skip it
+    # entirely (the lax.cond prices it at one scalar predicate).
     writes = valid if req.write is None else (valid & req.write)
     scat = jnp.where(writes, req.slot, C)
-    drop = dict(mode="drop")
+    drop = dict(mode="drop", unique_indices=True)
     n_flags = (n_algo & 3) | ((n_status & 1) << 2)
-    new_state = BucketState(
-        flags=state.flags.at[scat].set(n_flags, **drop),
-        limit_lo=state.limit_lo.at[scat].set(_lo32(n_limit), **drop),
-        limit_hi=state.limit_hi.at[scat].set(_hi32(n_limit), **drop),
-        remaining_lo=state.remaining_lo.at[scat].set(_lo32(n_rem), **drop),
-        remaining_hi=state.remaining_hi.at[scat].set(_hi32(n_rem), **drop),
-        duration_lo=state.duration_lo.at[scat].set(_lo32(n_dur), **drop),
-        duration_hi=state.duration_hi.at[scat].set(_hi32(n_dur), **drop),
-        stamp_lo=state.stamp_lo.at[scat].set(_lo32(n_stamp), **drop),
-        stamp_hi=state.stamp_hi.at[scat].set(_hi32(n_stamp), **drop),
-        expire_lo=state.expire_lo.at[scat].set(_lo32(n_exp), **drop),
-        expire_hi=state.expire_hi.at[scat].set(_hi32(n_exp), **drop),
+    new_hot = state.hot.at[scat].set(
+        _pack_hot(n_flags, n_rem, n_stamp, n_exp), **drop
     )
+
+    cold_changed = writes & ((n_limit != g_limit) | (n_dur != g_dur))
+    scat_cold = jnp.where(cold_changed, req.slot, C)
+    cold_rows = _pack_cold(n_limit, n_dur)
+
+    if cold_cond:
+        def _scatter_cold(args):
+            cold, idx, rows = args
+            return cold.at[idx].set(rows, **drop)
+
+        def _keep_cold(args):
+            return args[0]
+
+        new_cold = jax.lax.cond(
+            jnp.any(cold_changed), _scatter_cold, _keep_cold,
+            (state.cold, scat_cold, cold_rows),
+        )
+    else:
+        new_cold = state.cold.at[scat_cold].set(cold_rows, **drop)
+    new_state = BucketState(hot=new_hot, cold=new_cold)
 
     out = BatchOutput(
         status=jnp.where(valid, resp_status, UNDER),
@@ -565,7 +629,8 @@ def unpack_output(packed):
 
 
 def apply_rounds(
-    state: BucketState, req: RequestBatch, round_id, n_rounds, now_ms
+    state: BucketState, req: RequestBatch, round_id, n_rounds, now_ms,
+    cold_cond: bool = True,
 ) -> "tuple[BucketState, jax.Array]":
     """Evaluate a whole duplicate-key batch in ONE dispatch.
 
@@ -590,7 +655,7 @@ def apply_rounds(
         r, st, packed = c
         active = round_id == r
         req_r = req._replace(slot=jnp.where(active, req.slot, -1))
-        st, out = apply_batch(st, req_r, now_ms)
+        st, out = apply_batch(st, req_r, now_ms, cold_cond=cold_cond)
         packed = jnp.where(active[None, :], _pack_output(out), packed)
         return r + 1, st, packed
 
@@ -600,7 +665,9 @@ def apply_rounds(
     return state, packed
 
 
-apply_rounds_jit = jax.jit(apply_rounds, donate_argnums=0)
+apply_rounds_jit = jax.jit(
+    apply_rounds, donate_argnums=0, static_argnames=("cold_cond",)
+)
 
 
 class RequestBatch32(NamedTuple):
@@ -645,7 +712,8 @@ def make_batch32(
 
 
 def apply_rounds32(
-    state: BucketState, req32: RequestBatch32, round_id, n_rounds, now_ms
+    state: BucketState, req32: RequestBatch32, round_id, n_rounds, now_ms,
+    cold_cond: bool = True,
 ) -> "tuple[BucketState, jax.Array]":
     """apply_rounds with an int32 wire on BOTH directions.
 
@@ -676,11 +744,13 @@ def apply_rounds32(
     )
     # Pre-batch expiry per lane, read BEFORE the rounds mutate state:
     # the pass-through detector for the -2 sentinel.
-    C = state.flags.shape[0]
+    C = state.hot.shape[0]
     si = jnp.clip(req32.slot, 0, C - 1)
-    pre_exp = _compose64(state.expire_lo[si], state.expire_hi[si])
+    pre_exp = _compose64(state.hot[si, _H_EXP_LO], state.hot[si, _H_EXP_HI])
 
-    state, packed64 = apply_rounds(state, req, round_id, n_rounds, now_ms)
+    state, packed64 = apply_rounds(
+        state, req, round_id, n_rounds, now_ms, cold_cond=cold_cond
+    )
     hi = jnp.asarray((1 << 31) - 1, _I64)
 
     def delta(v):
@@ -709,7 +779,9 @@ def apply_rounds32(
     return state, packed32
 
 
-apply_rounds32_jit = jax.jit(apply_rounds32, donate_argnums=0)
+apply_rounds32_jit = jax.jit(
+    apply_rounds32, donate_argnums=0, static_argnames=("cold_cond",)
+)
 
 
 class RequestBatchDict(NamedTuple):
@@ -743,7 +815,8 @@ DICT_TABLE_ROWS = 256  # fixed so K never forces a recompile
 
 
 def apply_rounds_dict(
-    state: BucketState, reqd: RequestBatchDict, round_id8, n_rounds, now_ms
+    state: BucketState, reqd: RequestBatchDict, round_id8, n_rounds, now_ms,
+    cold_cond: bool = True,
 ) -> "tuple[BucketState, jax.Array]":
     """apply_rounds32 behind the config-dictionary wire.  round_id8 is
     u8 (planner guarantees n_rounds <= 255 or falls back)."""
@@ -761,10 +834,15 @@ def apply_rounds_dict(
         occ=reqd.occ.astype(_I32),
         write=(reqd.flags & 2) != 0,
     )
-    return apply_rounds32(state, req32, round_id8.astype(_I32), n_rounds, now_ms)
+    return apply_rounds32(
+        state, req32, round_id8.astype(_I32), n_rounds, now_ms,
+        cold_cond=cold_cond,
+    )
 
 
-apply_rounds_dict_jit = jax.jit(apply_rounds_dict, donate_argnums=0)
+apply_rounds_dict_jit = jax.jit(
+    apply_rounds_dict, donate_argnums=0, static_argnames=("cold_cond",)
+)
 
 
 def make_batch_dict(slot, exists, write, cfg, occ, table, shards: int = 0) -> RequestBatchDict:
@@ -866,14 +944,16 @@ def read_rows(state: BucketState, slots) -> BucketRows:
     OnChange callbacks and Loader snapshots need the item state the way
     the reference passes CacheItems, store.go:29-45)."""
     s = jnp.asarray(slots, _I32)
-    flags = state.flags[s]
+    hot = state.hot[s]
+    cold = state.cold[s]
+    flags = hot[:, _H_FLAGS]
     return BucketRows(
         algo=flags & 3,
-        limit=_compose64(state.limit_lo[s], state.limit_hi[s]),
-        remaining=_compose64(state.remaining_lo[s], state.remaining_hi[s]),
-        duration=_compose64(state.duration_lo[s], state.duration_hi[s]),
-        stamp=_compose64(state.stamp_lo[s], state.stamp_hi[s]),
-        expire_at=_compose64(state.expire_lo[s], state.expire_hi[s]),
+        limit=_compose64(cold[:, _C_LIM_LO], cold[:, _C_LIM_HI]),
+        remaining=_compose64(hot[:, _H_REM_LO], hot[:, _H_REM_HI]),
+        duration=_compose64(cold[:, _C_DUR_LO], cold[:, _C_DUR_HI]),
+        stamp=_compose64(hot[:, _H_STAMP_LO], hot[:, _H_STAMP_HI]),
+        expire_at=_compose64(hot[:, _H_EXP_LO], hot[:, _H_EXP_HI]),
         status=(flags >> 2) & 1,
     )
 
@@ -882,10 +962,11 @@ def read_rows(state: BucketState, slots) -> BucketRows:
 def write_rows(state: BucketState, slots, rows: BucketRows) -> BucketState:
     """Scatter full bucket rows (Store.Get results / Loader.Load items).
     Negative slots are mapped out of bounds and dropped."""
-    C = state.flags.shape[0]
+    C = state.hot.shape[0]
     s = jnp.asarray(slots, _I32)
     s = jnp.where(s >= 0, s, C)
     vals = rows_to_split(rows)
     return BucketState(
-        *[col.at[s].set(val, mode="drop") for col, val in zip(state, vals)]
+        hot=state.hot.at[s].set(vals.hot, mode="drop"),
+        cold=state.cold.at[s].set(vals.cold, mode="drop"),
     )

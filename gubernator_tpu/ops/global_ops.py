@@ -151,6 +151,7 @@ def answer_batch(
     req: RequestBatch,
     extra: GlobalBatchExtra,
     now_ms,
+    cold_cond: bool = True,
 ):
     """Unified per-shard request kernel: bucket evaluation + GLOBAL
     replica-cache short-circuit + hit accumulation.
@@ -169,7 +170,7 @@ def answer_batch(
 
     # Cached lanes skip local bucket evaluation entirely.
     local_req = req._replace(slot=jnp.where(cached, -1, req.slot))
-    new_state, out = buckets.apply_batch(state, local_req, now)
+    new_state, out = buckets.apply_batch(state, local_req, now, cold_cond=cold_cond)
 
     status = jnp.where(cached, gcols.rep_status[g], out.status)
     limit = jnp.where(cached, gcols.rep_limit[g], out.limit)

@@ -83,7 +83,7 @@ def _answer_jit(state, gcols, batch, extra, now):
 
     def one(state_s, gcols_s, batch_s, extra_s):
         ns, ng, out, cached = global_ops.answer_batch(
-            state_s, gcols_s, batch_s, extra_s, now
+            state_s, gcols_s, batch_s, extra_s, now, cold_cond=False
         )
         row0 = (
             out.status.astype(jnp.int64)
@@ -120,7 +120,7 @@ def _answer_rounds_jit(state, gcols, batch, extra, round_id, n_rounds, now):
             active = rid_s == r
             b_r = batch_s._replace(slot=jnp.where(active, batch_s.slot, -1))
             e_r = extra_s._replace(gslot=jnp.where(active, extra_s.gslot, -1))
-            st, gc, out, cached = global_ops.answer_batch(st, gc, b_r, e_r, now)
+            st, gc, out, cached = global_ops.answer_batch(st, gc, b_r, e_r, now, cold_cond=False)
             row0 = (
                 out.status.astype(jnp.int64)
                 | (out.removed.astype(jnp.int64) << 1)
@@ -147,7 +147,7 @@ def _rounds32_mesh_jit(state, batch32, round_id, n_rounds, now):
     i32[S, 4, B] packed result."""
 
     def one(state_s, batch_s, rid_s):
-        return buckets.apply_rounds32(state_s, batch_s, rid_s, n_rounds, now)
+        return buckets.apply_rounds32(state_s, batch_s, rid_s, n_rounds, now, cold_cond=False)
 
     return jax.vmap(one)(state, batch32, round_id)
 
@@ -157,7 +157,7 @@ def _rounds64_mesh_jit(state, batch, round_id, n_rounds, now):
     """Wide-wire twin of _rounds32_mesh_jit (values exceeding int32)."""
 
     def one(state_s, batch_s, rid_s):
-        return buckets.apply_rounds(state_s, batch_s, rid_s, n_rounds, now)
+        return buckets.apply_rounds(state_s, batch_s, rid_s, n_rounds, now, cold_cond=False)
 
     return jax.vmap(one)(state, batch, round_id)
 
@@ -168,7 +168,7 @@ def _rounds_dict_mesh_jit(state, batchd, round_id8, n_rounds, now):
     ~5x fewer host->device bytes per lane than the narrow wire."""
 
     def one(state_s, b_s, rid_s):
-        return buckets.apply_rounds_dict(state_s, b_s, rid_s, n_rounds, now)
+        return buckets.apply_rounds_dict(state_s, b_s, rid_s, n_rounds, now, cold_cond=False)
 
     return jax.vmap(one)(state, batchd, round_id8)
 

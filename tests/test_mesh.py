@@ -33,10 +33,10 @@ def test_requires_8_devices():
 def test_state_is_sharded():
     store = MeshBucketStore(capacity_per_shard=64)
     assert store.n_shards == 8
-    shard_dim = store.state.flags.shape[0]
+    shard_dim = store.state.hot.shape[0]
     assert shard_dim == 8
-    # each column must actually be laid out across all 8 devices
-    assert len(store.state.flags.sharding.device_set) == 8
+    # each row table must actually be laid out across all 8 devices
+    assert len(store.state.hot.sharding.device_set) == 8
 
 
 def test_shard_assignment_is_stable_and_covers():
