@@ -134,8 +134,58 @@ def main():
     state, packed = buckets.apply_rounds32_jit(state, create_b, rid, one_round, now_dev)
     sync(packed)  # warmup: compile + create all buckets + honest mode
 
-    k_iters, device_batch_us = 16, float("inf")
-    for _ in range(3):
+    # Device-batch cost via DIFFERENTIAL in-jit chaining: run K batches
+    # inside ONE dispatch (fori_loop chaining donated state) for two
+    # different K and divide the time difference — the tunnel RTT and
+    # every fixed per-dispatch cost cancel exactly, leaving pure chip
+    # time per batch.  (Round-3 finding: the old per-dispatch loop paid
+    # a multi-ms tunnel enqueue per batch, which now dominates the
+    # ~2ms kernel and would under-report the chip by >3x.)
+    import jax.numpy as jnp
+
+    def _chain(K):
+        # `packed` rides the loop carry behind an optimization_barrier:
+        # without it XLA constant-folds any masked use of the output
+        # and dead-code-eliminates the whole output-packing computation
+        # from the timed kernel (under-counting real per-batch work).
+        @jax.jit
+        def run(st, req, rid_a):
+            B = req.slot.shape[0]
+
+            def f(i, c):
+                st, _ = c
+                st, packed = buckets.apply_rounds32(
+                    st, req, rid_a, one_round, now_dev + i.astype(jnp.int64)
+                )
+                return jax.lax.optimization_barrier((st, packed))
+
+            st, packed = jax.lax.fori_loop(
+                0, K, f, (st, jnp.zeros((4, B), jnp.int32))
+            )
+            return st, packed
+
+        return run
+
+    k_lo, k_hi = 4, 20
+    chain_t = {}
+    for K in (k_lo, k_hi):
+        fn = _chain(K)
+        st2, pk = fn(state, steady_b, rid)
+        sync(pk)  # compile + drain
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            st2, pk = fn(st2, steady_b, rid)
+            sync(pk)
+            best = min(best, time.perf_counter() - t0)
+        chain_t[K] = best
+    device_batch_us = (chain_t[k_hi] - chain_t[k_lo]) / (k_hi - k_lo) * 1e6
+    device_cps = dev_batch / (device_batch_us / 1e6)
+
+    # Per-dispatch number (includes the tunnel's per-call enqueue cost;
+    # reported separately for continuity with earlier rounds).
+    k_iters, dispatch_batch_us = 16, float("inf")
+    for _ in range(2):
         state, packed = buckets.apply_rounds32_jit(state, steady_b, rid, one_round, now_dev)
         sync(packed)  # drain queue before timing
         t0 = time.perf_counter()
@@ -145,8 +195,55 @@ def main():
             )
         sync(packed)
         dt = time.perf_counter() - t0
-        device_batch_us = min(device_batch_us, dt / k_iters * 1e6)
-    device_cps = dev_batch / (device_batch_us / 1e6)
+        dispatch_batch_us = min(dispatch_batch_us, dt / k_iters * 1e6)
+
+    # Service-sized batches: measured device cost per batch at 256 /
+    # 1024 / 4096 lanes (the reference's "<1 ms most responses" bar is
+    # judged at its 1000-item request cap).  Same differential chain
+    # method; the spread across 5 samples of the K=20 chain bounds the
+    # on-chip variance (no tunnel in these numbers).
+    small_batch_us = {}
+    for sb in (256, 1024, 4096):
+        sslot = np.arange(sb, dtype=np.int32)
+        sbatch = jax.device_put(
+            buckets.make_batch32(
+                sslot,
+                np.ones(sb, dtype=bool),
+                (sslot % 2).astype(np.int32),
+                np.zeros(sb, np.int32),
+                np.ones(sb, np.int32),
+                np.full(sb, 1 << 30, np.int32),
+                np.full(sb, 3_600_000, np.int32),
+            )
+        )
+        srid = jax.device_put(np.zeros(sb, np.int32))
+        sstate = buckets.init_state(65_536)
+        screate = jax.device_put(sbatch._replace(exists=np.zeros(sb, bool)))
+        sstate, spacked = buckets.apply_rounds32_jit(
+            sstate, screate, srid, one_round, now_dev
+        )
+        sync(spacked)
+        # Small batches cost ~tens of us on chip, far below the tunnel's
+        # ms-scale jitter — so the K spread must be large enough that
+        # the differential signal (dK * per-batch cost) clears the
+        # noise: dK=512 puts a 50 us/batch kernel at ~25 ms of signal.
+        times = {}
+        k_pair = (8, 520)
+        for K in k_pair:
+            fn = _chain(K)
+            sstate2, spk = fn(sstate, sbatch, srid)
+            sync(spk)
+            samples = []
+            for _ in range(4):
+                t0 = time.perf_counter()
+                sstate2, spk = fn(sstate2, sbatch, srid)
+                sync(spk)
+                samples.append(time.perf_counter() - t0)
+            times[K] = samples
+        dk = k_pair[1] - k_pair[0]
+        per_batch = (min(times[k_pair[1]]) - min(times[k_pair[0]])) / dk
+        worst = (max(times[k_pair[1]]) - min(times[k_pair[0]])) / dk
+        small_batch_us[sb] = (per_batch * 1e6, worst * 1e6)
 
     # Single-dispatch completion latency distribution (dispatch ->
     # forced completion, minimal transfer).  On this host each sample
@@ -272,6 +369,13 @@ def main():
                 "device_batch_us": round(device_batch_us, 1),
                 "device_checks_per_sec": round(device_cps, 1),
                 "device_vs_northstar_50m": round(device_cps / 50e6, 4),
+                "dispatch_batch_us_incl_tunnel": round(dispatch_batch_us, 1),
+                "device_us_b256": round(small_batch_us[256][0], 1),
+                "device_us_b256_worst": round(small_batch_us[256][1], 1),
+                "device_us_b1024": round(small_batch_us[1024][0], 1),
+                "device_us_b1024_worst": round(small_batch_us[1024][1], 1),
+                "device_us_b4096": round(small_batch_us[4096][0], 1),
+                "device_us_b4096_worst": round(small_batch_us[4096][1], 1),
                 "dispatch_latency_ms_p50": round(dispatch_p50, 2),
                 "dispatch_latency_ms_p99": round(dispatch_p99, 2),
                 "dispatch_latency_includes_tunnel_rtt": True,

@@ -115,13 +115,15 @@ def main():
     def kern_chain(K):
         @jax.jit
         def run(st, req, rid):
-            def f(i, st):
+            def f(i, c):
+                st, _ = c
                 st, packed = buckets.apply_rounds32(
                     st, req, rid, jnp.int32(1), now0 + i.astype(jnp.int64)
                 )
-                return st._replace(hot=st.hot.at[0, 0].add(packed[0, 0] & 0))
+                return jax.lax.optimization_barrier((st, packed))
 
-            return jax.lax.fori_loop(0, K, f, st)
+            B = req.slot.shape[0]
+            return jax.lax.fori_loop(0, K, f, (st, jnp.zeros((4, B), jnp.int32)))
 
         return run
 
@@ -141,11 +143,12 @@ def main():
     def ab_chain(K):
         @jax.jit
         def run(st, req):
-            def f(i, st):
+            def f(i, c):
+                st, _ = c
                 st, out = buckets.apply_batch(st, req, now0 + i.astype(jnp.int64))
-                return st._replace(hot=st.hot.at[0, 0].add(out.status[0] & 0))
+                return jax.lax.optimization_barrier((st, out.status))
 
-            return jax.lax.fori_loop(0, K, f, st)
+            return jax.lax.fori_loop(0, K, f, (st, jnp.zeros_like(req.hits, jnp.int32)))
 
         return run
 

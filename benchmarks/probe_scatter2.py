@@ -111,15 +111,15 @@ def main():
 
     @jax.jit
     def kern_chain(st, req, rid):
-        def f(i, st):
+        def f(i, c):
+            st, _ = c
             st, packed = buckets.apply_rounds32(
                 st, req, rid, jnp.int32(1), now0 + i.astype(jnp.int64)
             )
-            # fold one packed element back in so nothing is dead
-            st = st._replace(hot=st.hot.at[0, 0].add(packed[0, 0] & 0))
-            return st
+            return jax.lax.optimization_barrier((st, packed))
 
-        return jax.lax.fori_loop(0, ITERS, f, st)
+        B = req.slot.shape[0]
+        return jax.lax.fori_loop(0, ITERS, f, (st, jnp.zeros((4, B), jnp.int32)))
 
     # create buckets first
     create = b32._replace(exists=jnp.zeros(B, bool))

@@ -28,6 +28,19 @@ _lib_err: Optional[str] = None
 _build_lock = threading.Lock()
 
 
+def _compile(lib_path: str) -> Optional[str]:
+    """Compile the runtime to lib_path via unique-tmp + rename; returns
+    an error string or None."""
+    tmp = f"{lib_path}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, lib_path)
+        return None
+    except (OSError, subprocess.SubprocessError) as e:
+        return f"native build failed: {e}"
+
+
 def _build() -> Optional[ctypes.CDLL]:
     global _lib_err
     with open(_SRC, "rb") as f:
@@ -43,19 +56,27 @@ def _build() -> Optional[ctypes.CDLL]:
         except OSError:
             pass
     else:
-        tmp = f"{lib_path}.{os.getpid()}.tmp"
-        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
-        try:
-            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-            os.replace(tmp, lib_path)
-        except (OSError, subprocess.SubprocessError) as e:
-            _lib_err = f"native build failed: {e}"
+        err = _compile(lib_path)
+        if err is not None:
+            _lib_err = err
             return None
     try:
         lib = ctypes.CDLL(lib_path)
-    except OSError as e:
-        _lib_err = f"native load failed: {e}"
-        return None
+    except OSError:
+        # TOCTOU: between our exists()/utime() and the CDLL, another
+        # process's age-based prune may have deleted an old .so.  The
+        # compile is cheap and writes via unique-tmp + rename, so retry
+        # once through the build path instead of falling back to the
+        # slow Python slot table for this process's whole lifetime.
+        err = _compile(lib_path)
+        if err is not None:
+            _lib_err = err
+            return None
+        try:
+            lib = ctypes.CDLL(lib_path)
+        except OSError as e:
+            _lib_err = f"native load failed: {e}"
+            return None
 
     # Prune superseded builds: each source edit leaves a hash-named .so
     # behind, which otherwise accumulates without bound.  Only delete

@@ -563,7 +563,13 @@ def apply_batch(
     # duration hot-change, algo switch) — steady-state batches skip it
     # entirely (the lax.cond prices it at one scalar predicate).
     writes = valid if req.write is None else (valid & req.write)
-    scat = jnp.where(writes, req.slot, C)
+    # Non-write lanes map to DISTINCT out-of-bounds indices (C + lane)
+    # rather than a shared C: mode='drop' discards them either way, but
+    # unique_indices=True promises uniqueness over the WHOLE index
+    # vector and repeated sentinels would be undefined behavior.
+    lane = jnp.arange(req.slot.shape[0], dtype=_I32)
+    oob = C + lane
+    scat = jnp.where(writes, req.slot, oob)
     drop = dict(mode="drop", unique_indices=True)
     n_flags = (n_algo & 3) | ((n_status & 1) << 2)
     new_hot = state.hot.at[scat].set(
@@ -571,7 +577,7 @@ def apply_batch(
     )
 
     cold_changed = writes & ((n_limit != g_limit) | (n_dur != g_dur))
-    scat_cold = jnp.where(cold_changed, req.slot, C)
+    scat_cold = jnp.where(cold_changed, req.slot, oob)
     cold_rows = _pack_cold(n_limit, n_dur)
 
     if cold_cond:

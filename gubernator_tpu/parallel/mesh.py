@@ -1031,22 +1031,29 @@ class MeshBucketStore(ColumnarPipeline):
             # multi-second executable load at first dispatch — so warm
             # every bucket the deployment expects (`warm_shapes`, lane
             # counts) during startup, not inside a client's deadline.
-            # DISTINCT keys per lane: identical keys would all hash to
-            # one shard, compiling pad_size(lanes) instead of the
-            # pad_size(lanes/S) bucket real traffic dispatches.  Both
-            # the dict wire and the per-lane narrow-wire fallback get
+            # Warm each shape TWICE: with DISTINCT keys (spread over all
+            # shards, compiling the pad_size(lanes/S) bucket even traffic
+            # dispatches) AND with IDENTICAL keys (everything hashes to
+            # one shard, compiling the pad_size(lanes) bucket a
+            # duplicate-heavy batch dispatches — without this, a
+            # hot-key storm's first dispatch pays a multi-second remote
+            # executable load inside a client RPC deadline).  Both the
+            # dict wire and the per-lane narrow-wire fallback get
             # compiled (the wide int64 path is rare enough to pay its
             # compile lazily).  1ms duration so the slots recycle.
             for lanes in sorted(set(warm_shapes or (1,))):
                 lanes = max(int(lanes), 1)
-                keys = [f"__warmup__:{i}" for i in range(lanes)]
-                for wire in (None, "narrow"):
-                    self.apply_columns(
-                        keys,
-                        np.zeros(lanes, np.int32), np.zeros(lanes, np.int32),
-                        np.zeros(lanes, np.int64), np.ones(lanes, np.int64),
-                        np.ones(lanes, np.int64), now_ms, force_wire=wire,
-                    )
+                for keys in (
+                    [f"__warmup__:{i}" for i in range(lanes)],
+                    ["__warmup__:0"] * lanes,
+                ):
+                    for wire in (None, "narrow"):
+                        self.apply_columns(
+                            keys,
+                            np.zeros(lanes, np.int32), np.zeros(lanes, np.int32),
+                            np.zeros(lanes, np.int64), np.ones(lanes, np.int64),
+                            np.ones(lanes, np.int64), now_ms, force_wire=wire,
+                        )
 
     def size(self) -> int:
         return sum(len(t) for t in self.tables)

@@ -425,7 +425,7 @@ class V1Service:
                         slow[i] = True
 
         self._queue_mr_fast(cols, beh, fast, hash_keys)
-        pending, fast_idx = self._dispatch_fast(cols, beh, fast, hash_keys, result)
+        pendings = self._dispatch_fast(cols, beh, fast, hash_keys, result)
 
         # Plain remote lanes: ONE forwarded GetPeerRateLimits per owner,
         # dispatched in parallel while the local fast dispatch is in
@@ -453,7 +453,7 @@ class V1Service:
             for i, r in zip(remote_groups[addr], resps):
                 result.overrides[int(i)] = r
 
-        self._resolve_fast(pending, fast_idx, hash_keys, result)
+        self._resolve_fast(pendings, hash_keys, result)
         return result
 
     # -- shared fast-lane halves of the two columnar entry points ------
@@ -499,60 +499,69 @@ class V1Service:
             self.multi_region_mgr.queue_hits(r)
 
     def _dispatch_fast(self, cols, beh, fast, hash_keys, result):
-        """Dispatch the fast lanes (Gregorian precompute included):
-        through the coalescing window normally, directly when any lane
-        opts out with NO_BATCHING (parity with the dataclass path,
-        which dispatches multi-item batches immediately).  Returns
-        (pending, fast_idx) for _resolve_fast."""
+        """Dispatch the fast lanes (Gregorian precompute included).
+        Batching behavior is per request, as in the reference
+        (proto/gubernator.proto:74-78): lanes flagged NO_BATCHING
+        dispatch immediately, the rest coalesce through the window —
+        a mixed batch splits into one direct and one windowed dispatch.
+        Returns a list of (pending, idx) pairs for _resolve_fast."""
         greg_expire, greg_duration = self._resolve_greg_fast(cols, beh, fast, result)
         fast_idx = np.nonzero(fast)[0]
         if not fast_idx.size:
-            return None, fast_idx
+            return []
         n = len(cols)
-        full = fast_idx.size == n
-        sl = slice(None) if full else fast_idx
-        keys_sel = hash_keys if full else [hash_keys[i] for i in fast_idx]
-        args = (
-            keys_sel, cols.algorithm[sl], beh[sl], cols.hits[sl],
-            cols.limit[sl], cols.duration[sl],
-            None if greg_expire is None else greg_expire[sl],
-            None if greg_duration is None else greg_duration[sl],
-        )
-        if (beh[sl] & int(Behavior.NO_BATCHING)).any():
-            handle = self.store.apply_columns_async(
-                *args[:6], self.clock.now_ms(), *args[6:]
-            )
-            return (handle, 0, fast_idx.size), fast_idx
-        return self.columnar_batcher.submit(*args), fast_idx
 
-    def _resolve_fast(self, pending, fast_idx, hash_keys, result) -> None:
-        """Block on the fast dispatch and scatter its arrays into the
+        def dispatch(idx, direct):
+            full = idx.size == n
+            sl = slice(None) if full else idx
+            keys_sel = hash_keys if full else [hash_keys[i] for i in idx]
+            args = (
+                keys_sel, cols.algorithm[sl], beh[sl], cols.hits[sl],
+                cols.limit[sl], cols.duration[sl],
+                None if greg_expire is None else greg_expire[sl],
+                None if greg_duration is None else greg_duration[sl],
+            )
+            if direct:
+                handle = self.store.apply_columns_async(
+                    *args[:6], self.clock.now_ms(), *args[6:]
+                )
+                return (handle, 0, idx.size), idx
+            return self.columnar_batcher.submit(*args), idx
+
+        nb = (beh[fast_idx] & int(Behavior.NO_BATCHING)) != 0
+        if not nb.any():
+            return [dispatch(fast_idx, False)]
+        if nb.all():
+            return [dispatch(fast_idx, True)]
+        return [dispatch(fast_idx[nb], True), dispatch(fast_idx[~nb], False)]
+
+    def _resolve_fast(self, pendings, hash_keys, result) -> None:
+        """Block on each fast dispatch and scatter its arrays into the
         result; a dispatch failure (e.g. shutdown race) converts to
         per-lane errors instead of failing lanes already computed."""
-        if pending is None:
-            return
-        try:
-            handle, lo, hi = (
-                pending.result() if isinstance(pending, Future) else pending
-            )
-            out = handle.result()
-        except Exception as e:  # noqa: BLE001
-            for i in fast_idx:
-                result.overrides[int(i)] = RateLimitResponse(
-                    error=f"while applying rate limit '{hash_keys[int(i)]}' - '{e}'"
+        for pending, fast_idx in pendings:
+            try:
+                handle, lo, hi = (
+                    pending.result() if isinstance(pending, Future) else pending
                 )
-            return
-        sl = slice(lo, hi)
-        if fast_idx.size == result.n:
-            result.status = np.asarray(out["status"][sl], dtype=np.int32)
-            result.limit = np.asarray(out["limit"][sl], dtype=np.int64)
-            result.remaining = np.asarray(out["remaining"][sl], dtype=np.int64)
-            result.reset_time = np.asarray(out["reset_time"][sl], dtype=np.int64)
-        else:
-            result.status[fast_idx] = out["status"][sl]
-            result.limit[fast_idx] = out["limit"][sl]
-            result.remaining[fast_idx] = out["remaining"][sl]
-            result.reset_time[fast_idx] = out["reset_time"][sl]
+                out = handle.result()
+            except Exception as e:  # noqa: BLE001
+                for i in fast_idx:
+                    result.overrides[int(i)] = RateLimitResponse(
+                        error=f"while applying rate limit '{hash_keys[int(i)]}' - '{e}'"
+                    )
+                continue
+            sl = slice(lo, hi)
+            if fast_idx.size == result.n:
+                result.status = np.asarray(out["status"][sl], dtype=np.int32)
+                result.limit = np.asarray(out["limit"][sl], dtype=np.int64)
+                result.remaining = np.asarray(out["remaining"][sl], dtype=np.int64)
+                result.reset_time = np.asarray(out["reset_time"][sl], dtype=np.int64)
+            else:
+                result.status[fast_idx] = out["status"][sl]
+                result.limit[fast_idx] = out["limit"][sl]
+                result.remaining[fast_idx] = out["remaining"][sl]
+                result.reset_time[fast_idx] = out["reset_time"][sl]
 
     def _route(self, requests: Sequence[RateLimitRequest]) -> GetRateLimitsResponse:
         n = len(requests)
@@ -760,7 +769,7 @@ class V1Service:
         # gubernator.go:340-341 via GetPeerRateLimits); pass an all-True
         # mask so GLOBAL+MULTI_REGION lanes queue too.
         self._queue_mr_fast(cols, beh, np.ones(n, dtype=bool), hash_keys)
-        pending, fast_idx = self._dispatch_fast(cols, beh, fast, hash_keys, result)
+        pendings = self._dispatch_fast(cols, beh, fast, hash_keys, result)
 
         slow_idx = np.nonzero(slow)[0]
         if slow_idx.size:
@@ -770,7 +779,7 @@ class V1Service:
             for i, r in zip(slow_idx, resps):
                 result.overrides[int(i)] = r
 
-        self._resolve_fast(pending, fast_idx, hash_keys, result)
+        self._resolve_fast(pendings, hash_keys, result)
         return result
 
     def update_peer_globals(self, updates: Sequence[UpdatePeerGlobal]) -> None:
