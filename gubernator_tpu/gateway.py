@@ -20,12 +20,94 @@ from typing import Optional
 
 import numpy as np
 
+from . import native as _native
 from .service import ApiError, ColumnarResult, IngressColumns, V1Service
-from .types import Algorithm, UpdatePeerGlobal, _parse_behavior
+from .types import Algorithm, RateLimitRequest, UpdatePeerGlobal, _parse_behavior
 
 _GRPC_CODES = {"InvalidArgument": 3, "OutOfRange": 11, "Internal": 13}
 
 _STATUS_NAMES = ("UNDER_LIMIT", "OVER_LIMIT")
+
+
+class LazyIngressColumns:
+    """IngressColumns twin built from the native JSON parse
+    (native.parse_json_batch): kernel-ready columns + PACKED hash keys
+    + per-lane validation codes, with name/unique_key strings
+    materialized lazily — the hot path never creates 2n string objects
+    per batch."""
+
+    __slots__ = ("_pj", "algorithm", "behavior", "hits", "limit",
+                 "duration", "_names", "_uks")
+
+    def __init__(self, pj):
+        self._pj = pj
+        self.algorithm = pj.algo
+        self.behavior = pj.behavior
+        self.hits = pj.hits
+        self.limit = pj.limit
+        self.duration = pj.duration
+        self._names = None
+        self._uks = None
+
+    def __len__(self) -> int:
+        return self._pj.n
+
+    @property
+    def prevalidated(self):
+        """(PackedKeys hash keys, err codes u8[n]: 1 empty unique_key,
+        2 empty name) — lets the service skip its per-lane validation
+        and hash-key loop (service.py _route_columns)."""
+        return self._pj.hash_keys, self._pj.err
+
+    @property
+    def names(self):
+        if self._names is None:
+            self._names = [self._pj.name_at(i) for i in range(self._pj.n)]
+        return self._names
+
+    @property
+    def unique_keys(self):
+        if self._uks is None:
+            self._uks = [
+                self._pj.unique_key_at(i) for i in range(self._pj.n)
+            ]
+        return self._uks
+
+    def request_at(self, i: int) -> RateLimitRequest:
+        return RateLimitRequest(
+            name=self._pj.name_at(i),
+            unique_key=self._pj.unique_key_at(i),
+            hits=int(self.hits[i]),
+            limit=int(self.limit[i]),
+            duration=int(self.duration[i]),
+            algorithm=int(self.algorithm[i]),
+            behavior=int(self.behavior[i]),
+        )
+
+
+def parse_body_native(raw: bytes):
+    """Native fast path for a /v1/GetRateLimits body; None falls back
+    to json.loads + parse_columns (exotic JSON, bad enum values — the
+    Python path reproduces the exact historical error behavior)."""
+    pj = _native.parse_json_batch(raw)
+    if pj is None or (pj.err >= 3).any():
+        return None
+    return LazyIngressColumns(pj)
+
+
+def render_result_native(result: ColumnarResult):
+    """Native response rendering; overrides pre-render in Python (they
+    carry metadata/errors).  None when the native runtime is absent."""
+    ov = None
+    if result.overrides:
+        ov = {
+            i: json.dumps(r.to_json(), separators=(",", ":")).encode("utf-8")
+            for i, r in result.overrides.items()
+        }
+    return _native.render_json(
+        result.status, result.limit, result.remaining, result.reset_time,
+        ov or {},
+    )
 
 
 def parse_columns(items: list) -> IngressColumns:
@@ -169,9 +251,12 @@ def _make_handler(service: V1Service):
                 return True
             return False
 
-        def _read_json(self) -> dict:
+        def _read_raw(self) -> bytes:
             length = int(self.headers.get("Content-Length", "0"))
-            raw = self.rfile.read(length) if length else b""
+            return self.rfile.read(length) if length else b""
+
+        def _read_json(self) -> dict:
+            raw = self._read_raw()
             if not raw:
                 return {}
             return json.loads(raw)
@@ -203,15 +288,28 @@ def _make_handler(service: V1Service):
             if self._refuse_if_closed():
                 return
             try:
-                body = self._read_json()
                 if self.path == "/v1/GetRateLimits":
+                    raw = self._read_raw()
                     with service.metrics.observe_rpc("/pb.gubernator.V1/GetRateLimits"):
-                        cols = parse_columns(body.get("requests", []))
-                        payload = render_columns(
-                            service.get_rate_limits_columns(cols)
-                        )
-                    self._send_json(200, payload)
-                elif self.path == "/v1/peer.GetPeerRateLimits":
+                        cols = parse_body_native(raw) if raw else None
+                        if cols is not None:
+                            result = service.get_rate_limits_columns(cols)
+                            rendered = render_result_native(result)
+                        else:
+                            body = json.loads(raw) if raw else {}
+                            result = service.get_rate_limits_columns(
+                                parse_columns(body.get("requests", []))
+                            )
+                            rendered = None
+                        if rendered is None:
+                            payload = render_columns(result)
+                    if rendered is not None:
+                        self._send_bytes(200, "application/json", rendered)
+                    else:
+                        self._send_json(200, payload)
+                    return
+                body = self._read_json()
+                if self.path == "/v1/peer.GetPeerRateLimits":
                     with service.metrics.observe_rpc(
                         "/pb.gubernator.PeersV1/GetPeerRateLimits"
                     ):

@@ -723,12 +723,16 @@ class ShardStore(ColumnarPipeline):
             dict_enc = buckets.build_config_dict(cols, now_ms)
         if dict_enc is not None:
             cfg_idx, table = dict_enc
-            batch = buckets.make_batch_dict(
-                slot_col, ex_col, wr_col, _pad(cfg_idx, padded, np.uint8),
-                occ_col, table,
-            )
-            self.state, packed = buckets.apply_rounds_dict_jit(
-                self.state, batch, rid_col.astype(np.uint8), n_rounds, now_ms
+            # Single-buffer wire: one host->device transfer per batch
+            # instead of 12 (per-call overhead dominates at service
+            # batch sizes).
+            wire = buckets.pack_dict_wire(
+                slot_col[None, :], ex_col[None, :], wr_col[None, :],
+                _pad(cfg_idx, padded, np.uint8)[None, :], occ_col[None, :],
+                rid_col[None, :], table,
+            )[0]
+            self.state, packed = buckets.apply_rounds_packed_jit(
+                self.state, wire, n_rounds, now_ms
             )
         elif narrow:
             greg_delta = np.where(

@@ -139,6 +139,20 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.gt_batch_commit_plan.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
     lib.gt_batch_free.argtypes = [c.c_void_p]
     lib.gt_fnv1_batch.argtypes = [c.c_void_p, c.c_void_p, c.c_int64, c.c_int32, c.c_void_p]
+    lib.gt_json_parse.restype = c.c_void_p
+    lib.gt_json_parse.argtypes = [c.c_char_p, c.c_int64]
+    lib.gt_json_n.restype = c.c_int64
+    lib.gt_json_n.argtypes = [c.c_void_p]
+    lib.gt_json_hk_bytes.restype = c.c_int64
+    lib.gt_json_hk_bytes.argtypes = [c.c_void_p]
+    lib.gt_json_fill.argtypes = [c.c_void_p] + [c.c_void_p] * 10
+    lib.gt_json_free.argtypes = [c.c_void_p]
+    lib.gt_json_render.restype = c.c_int64
+    lib.gt_json_render.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64,
+        c.c_void_p, c.c_int64, c.c_char_p, c.c_void_p, c.c_char_p,
+        c.c_int64,
+    ]
     return lib
 
 
@@ -168,6 +182,166 @@ def pack_keys(keys) -> Tuple[np.ndarray, np.ndarray]:
     return np.frombuffer(b"".join(bs), dtype=np.uint8), offsets
 
 
+class PackedKeys:
+    """Hash keys kept in PACKED form (one utf-8 buffer + offsets[n+1])
+    end-to-end: the C++ JSON parser emits this, the batch planner
+    consumes it, and per-lane Python strings only materialize for the
+    rare slow/error lanes — the edge never pays n string objects per
+    batch."""
+
+    __slots__ = ("buf", "offsets")
+
+    def __init__(self, buf: np.ndarray, offsets: np.ndarray):
+        self.buf = buf
+        self.offsets = offsets
+
+    def __len__(self) -> int:
+        return len(self.offsets) - 1
+
+    def __getitem__(self, i: int) -> str:
+        o = self.offsets
+        return bytes(self.buf[o[i]:o[i + 1]]).decode("utf-8")
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    @staticmethod
+    def concat(parts: "List[PackedKeys]") -> "PackedKeys":
+        """Concatenate packed key batches without materializing
+        strings (the ColumnarBatcher's multi-submission coalesce)."""
+        bufs = [p.buf for p in parts]
+        offs = [parts[0].offsets]
+        base = int(parts[0].offsets[-1])
+        for p in parts[1:]:
+            offs.append(p.offsets[1:] + base)
+            base += int(p.offsets[-1])
+        return PackedKeys(np.concatenate(bufs), np.concatenate(offs))
+
+    def subset(self, idx) -> "PackedKeys":
+        """Vectorized selection of lanes `idx` (no per-lane Python)."""
+        idx = np.asarray(idx, dtype=np.int64)
+        o = self.offsets
+        starts = o[idx]
+        lens = o[idx + 1] - starts
+        new_off = np.zeros(len(idx) + 1, dtype=np.int64)
+        np.cumsum(lens, out=new_off[1:])
+        total = int(new_off[-1])
+        pos = np.repeat(starts - new_off[:-1], lens) + np.arange(total, dtype=np.int64)
+        return PackedKeys(self.buf[pos], new_off)
+
+
+def as_packed(keys) -> Tuple[np.ndarray, np.ndarray]:
+    """(buf, offsets) for either a PackedKeys or a list of strings."""
+    if isinstance(keys, PackedKeys):
+        return keys.buf, keys.offsets
+    return pack_keys(keys)
+
+
+class ParsedJson:
+    """Result of the native GetRateLimits JSON parse (gt_json_parse):
+    kernel-ready columns + packed hash keys + validation codes +
+    (offset, len) spans of each name/unique_key in the body."""
+
+    __slots__ = ("n", "algo", "behavior", "hits", "limit", "duration",
+                 "err", "hash_keys", "nspan", "ukspan", "body")
+
+    def __init__(self, n, algo, behavior, hits, limit, duration, err,
+                 hash_keys, nspan, ukspan, body):
+        self.n = n
+        self.algo = algo
+        self.behavior = behavior
+        self.hits = hits
+        self.limit = limit
+        self.duration = duration
+        self.err = err
+        self.hash_keys = hash_keys
+        self.nspan = nspan
+        self.ukspan = ukspan
+        self.body = body
+
+    def name_at(self, i: int) -> str:
+        off, ln = self.nspan[2 * i], self.nspan[2 * i + 1]
+        return self.body[off:off + ln].decode("utf-8")
+
+    def unique_key_at(self, i: int) -> str:
+        off, ln = self.ukspan[2 * i], self.ukspan[2 * i + 1]
+        return self.body[off:off + ln].decode("utf-8")
+
+
+def parse_json_batch(body: bytes) -> Optional[ParsedJson]:
+    """Parse a /v1/GetRateLimits body natively; None means "use the
+    Python fallback" (escape sequences in keys, floats, behavior flag
+    lists, malformed JSON — anything beyond the common wire shape)."""
+    lib = _get_lib()
+    if lib is None:
+        return None
+    h = lib.gt_json_parse(body, len(body))
+    if not h:
+        return None
+    try:
+        n = int(lib.gt_json_n(h))
+        hkb = int(lib.gt_json_hk_bytes(h))
+        algo = np.empty(n, dtype=np.int32)
+        behavior = np.empty(n, dtype=np.int32)
+        hits = np.empty(n, dtype=np.int64)
+        limit = np.empty(n, dtype=np.int64)
+        duration = np.empty(n, dtype=np.int64)
+        err = np.empty(n, dtype=np.uint8)
+        hk = np.empty(hkb, dtype=np.uint8)
+        hkoff = np.empty(n + 1, dtype=np.int64)
+        nspan = np.empty(2 * n, dtype=np.int64)
+        ukspan = np.empty(2 * n, dtype=np.int64)
+        lib.gt_json_fill(
+            h, algo.ctypes.data, behavior.ctypes.data, hits.ctypes.data,
+            limit.ctypes.data, duration.ctypes.data, err.ctypes.data,
+            hk.ctypes.data, hkoff.ctypes.data, nspan.ctypes.data,
+            ukspan.ctypes.data,
+        )
+    finally:
+        lib.gt_json_free(h)
+    return ParsedJson(n, algo, behavior, hits, limit, duration, err,
+                      PackedKeys(hk, hkoff), nspan, ukspan, body)
+
+
+def render_json(status, limit, remaining, reset, overrides: dict) -> Optional[bytes]:
+    """Build the GetRateLimits response body natively; `overrides` maps
+    lane index -> pre-rendered JSON bytes (error / forwarded lanes).
+    None when the native runtime is unavailable."""
+    lib = _get_lib()
+    if lib is None:
+        return None
+    n = len(status)
+    status = np.ascontiguousarray(status, dtype=np.int32)
+    limit = np.ascontiguousarray(limit, dtype=np.int64)
+    remaining = np.ascontiguousarray(remaining, dtype=np.int64)
+    reset = np.ascontiguousarray(reset, dtype=np.int64)
+    if overrides:
+        items = sorted(overrides.items())
+        ov_idx = np.asarray([i for i, _ in items], dtype=np.int64)
+        bufs = [b for _, b in items]
+        ov_off = np.zeros(len(bufs) + 1, dtype=np.int64)
+        np.cumsum([len(b) for b in bufs], out=ov_off[1:])
+        ov_buf = b"".join(bufs)
+    else:
+        ov_idx = np.empty(0, dtype=np.int64)
+        ov_off = np.zeros(1, dtype=np.int64)
+        ov_buf = b""
+    n_ov = len(ov_idx)
+    # Single-pass render into a worst-case buffer (<=129 bytes per
+    # plain lane; see gt_json_render).
+    cap = 32 + n * 160 + len(ov_buf) + n_ov * 2
+    out = ctypes.create_string_buffer(cap)
+    size = lib.gt_json_render(
+        status.ctypes.data, limit.ctypes.data, remaining.ctypes.data,
+        reset.ctypes.data, n, ov_idx.ctypes.data, n_ov, ov_buf,
+        ov_off.ctypes.data, out, cap,
+    )
+    if size < 0:
+        return None  # cap overflow (cannot happen by construction)
+    return out.raw[:size]
+
+
 def fnv1_batch(keys, variant_1a: bool = False) -> np.ndarray:
     """Batch FNV-1/1a 64 hash (replicated_hash.go:31); pure-Python
     fallback when the native build is unavailable."""
@@ -182,7 +356,7 @@ def fnv1_batch(keys, variant_1a: bool = False) -> np.ndarray:
         for i, k in enumerate(keys):
             out[i] = fn(k.encode("utf-8") if isinstance(k, str) else k)
         return out
-    buf, offsets = pack_keys(keys)
+    buf, offsets = as_packed(keys)
     lib.gt_fnv1_batch(
         buf.ctypes.data, offsets.ctypes.data, len(keys),
         1 if variant_1a else 0, out.ctypes.data,
@@ -309,7 +483,7 @@ class NativeBatchPlanner:
         self._lib = table._lib
         self._table = table
         self.n = len(keys)
-        self._buf, self._offsets = pack_keys(keys)
+        self._buf, self._offsets = as_packed(keys)
         self._ptr = self._lib.gt_batch_begin(
             table._ptr, self._buf.ctypes.data if self.n else None,
             self._offsets.ctypes.data, self.n, now_ms,

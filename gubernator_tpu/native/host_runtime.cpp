@@ -642,3 +642,359 @@ void gt_fnv1_batch(const char* keys, const int64_t* offsets, int64_t n,
 }
 
 }  // extern "C"
+
+namespace {
+// ---------------------------------------------------------------------
+// JSON edge: GetRateLimits request parser + response renderer.
+//
+// The gateway's hot path (gateway.py parse_columns/render_columns) is
+// per-lane Python; at the reference's 1000-item request cap that costs
+// more host time than the whole device dispatch.  This parser handles
+// the gateway's actual wire shape — {"requests":[{flat objects}]} with
+// proto3-JSON conventions (int64 as string, enums as names or ints) —
+// and REFUSES anything fancier (escape sequences inside name/unique
+// key, floats, nested values in known fields) by returning NULL so the
+// Python path keeps full fidelity.  Outputs are kernel-ready columns
+// plus packed hash keys (name + '_' + unique_key), per-lane validation
+// codes (empty unique_key/name, bad enums — gubernator.go:142-152
+// semantics), and (offset,len) spans of name/unique_key in the body so
+// Python can materialize strings lazily for the rare slow lanes.
+
+struct JsonBatch {
+  std::vector<int32_t> algo, behavior;
+  std::vector<int64_t> hits, limit, duration;
+  std::vector<uint8_t> err;  // 0 ok, 1 empty uk, 2 empty name, 3 bad algo, 4 bad behavior
+  std::string hk;
+  std::vector<int64_t> hkoff;
+  std::vector<int64_t> nspan, ukspan;  // 2*n: (off,len) into body
+};
+
+struct JsonCursor {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++;
+  }
+  bool lit(char c) {
+    ws();
+    if (p < end && *p == c) { p++; return true; }
+    return false;
+  }
+  // Raw string token; fails (ok=false) on escapes/EOF.  Returns
+  // (offset, len) into the body.
+  bool str(int64_t* off, int64_t* len, const char* base) {
+    ws();
+    if (p >= end || *p != '"') return false;
+    p++;
+    const char* s = p;
+    while (p < end && *p != '"') {
+      if (*p == '\\') { ok = false; return false; }
+      p++;
+    }
+    if (p >= end) { ok = false; return false; }
+    *off = s - base;
+    *len = p - s;
+    p++;
+    return true;
+  }
+  // Integer, optionally quoted (proto3 int64-as-string).  Floats and
+  // >18-digit magnitudes poison the cursor (Python fallback).
+  bool integer(int64_t* out) {
+    ws();
+    bool quoted = p < end && *p == '"';
+    if (quoted) p++;
+    bool neg = false;
+    if (p < end && (*p == '-' || *p == '+')) { neg = *p == '-'; p++; }
+    if (p >= end || *p < '0' || *p > '9') { ok = false; return false; }
+    int64_t v = 0;
+    int digits = 0;
+    while (p < end && *p >= '0' && *p <= '9') {
+      v = v * 10 + (*p - '0');
+      if (++digits > 18) { ok = false; return false; }
+      p++;
+    }
+    if (p < end && (*p == '.' || *p == 'e' || *p == 'E')) { ok = false; return false; }
+    if (quoted) {
+      if (p >= end || *p != '"') { ok = false; return false; }
+      p++;
+    }
+    *out = neg ? -v : v;
+    return true;
+  }
+  // Skip any JSON value (for unknown fields); handles escapes fine
+  // since it never extracts content.
+  bool skip_value() {
+    ws();
+    if (p >= end) { ok = false; return false; }
+    char c = *p;
+    if (c == '"') {
+      p++;
+      while (p < end && *p != '"') {
+        if (*p == '\\') p++;
+        p++;
+      }
+      if (p >= end) { ok = false; return false; }
+      p++;
+      return true;
+    }
+    if (c == '{' || c == '[') {
+      char close = c == '{' ? '}' : ']';
+      p++;
+      int depth = 1;
+      while (p < end && depth > 0) {
+        char d = *p;
+        if (d == '"') {
+          p++;
+          while (p < end && *p != '"') {
+            if (*p == '\\') p++;
+            p++;
+          }
+          if (p >= end) { ok = false; return false; }
+        } else if (d == '{' || d == '[') depth++;
+        else if (d == '}' || d == ']') depth--;
+        p++;
+      }
+      (void)close;
+      if (depth != 0) { ok = false; return false; }
+      return true;
+    }
+    // number / true / false / null
+    while (p < end && *p != ',' && *p != '}' && *p != ']' && *p != ' ' &&
+           *p != '\t' && *p != '\n' && *p != '\r')
+      p++;
+    return true;
+  }
+};
+
+bool key_is(const char* base, int64_t off, int64_t len, const char* name) {
+  return (int64_t)strlen(name) == len && memcmp(base + off, name, len) == 0;
+}
+
+bool token_is(const char* base, int64_t off, int64_t len, const char* name) {
+  return key_is(base, off, len, name);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* gt_json_parse(const char* body, int64_t blen) {
+  JsonCursor c{body, body + blen};
+  auto* jb = new JsonBatch();
+  auto fail = [&]() -> void* { delete jb; return nullptr; };
+
+  if (!c.lit('{')) return fail();
+  bool found_requests = false;
+  if (c.lit('}')) {  // {} — still reject trailing garbage (json.loads parity)
+    c.ws();
+    if (c.p != c.end) return fail();
+    jb->hkoff.push_back(0);
+    return jb;
+  }
+  while (true) {
+    int64_t koff, klen;
+    if (!c.str(&koff, &klen, body)) return fail();
+    if (!c.lit(':')) return fail();
+    if (key_is(body, koff, klen, "requests")) {
+      // Duplicate "requests" keys: json.loads is last-wins; appending
+      // would double the batch.  Rare and weird — Python fallback.
+      if (found_requests) return fail();
+      found_requests = true;
+      if (!c.lit('[')) return fail();
+      if (!c.lit(']')) {
+        while (true) {
+          if (!c.lit('{')) return fail();
+          int32_t algo = 0, behavior = 0;
+          int64_t hits = 0, limit = 0, duration = 0;
+          int64_t noff = 0, nlen = 0, uoff = 0, ulen = 0;
+          uint8_t err = 0;
+          if (!c.lit('}')) {
+            while (true) {
+              int64_t foff, flen;
+              if (!c.str(&foff, &flen, body)) return fail();
+              if (!c.lit(':')) return fail();
+              if (key_is(body, foff, flen, "name")) {
+                if (!c.str(&noff, &nlen, body)) return fail();
+              } else if (key_is(body, foff, flen, "uniqueKey") ||
+                         key_is(body, foff, flen, "unique_key")) {
+                if (!c.str(&uoff, &ulen, body)) return fail();
+              } else if (key_is(body, foff, flen, "hits")) {
+                if (!c.integer(&hits)) return fail();
+              } else if (key_is(body, foff, flen, "limit")) {
+                if (!c.integer(&limit)) return fail();
+              } else if (key_is(body, foff, flen, "duration")) {
+                if (!c.integer(&duration)) return fail();
+              } else if (key_is(body, foff, flen, "algorithm")) {
+                c.ws();
+                if (c.p < c.end && *c.p == '"') {
+                  int64_t aoff, alen;
+                  if (!c.str(&aoff, &alen, body)) return fail();
+                  if (token_is(body, aoff, alen, "TOKEN_BUCKET")) algo = 0;
+                  else if (token_is(body, aoff, alen, "LEAKY_BUCKET")) algo = 1;
+                  else {
+                    // quoted int (proto3 tolerance) or invalid
+                    JsonCursor t{body + aoff, body + aoff + alen};
+                    int64_t v;
+                    if (t.integer(&v) && t.p == t.end && v >= 0 && v <= 1)
+                      algo = (int32_t)v;
+                    else if (err == 0) err = 3;
+                  }
+                } else {
+                  int64_t v;
+                  if (!c.integer(&v)) return fail();
+                  if (v >= 0 && v <= 1) algo = (int32_t)v;
+                  else if (err == 0) err = 3;
+                }
+              } else if (key_is(body, foff, flen, "behavior")) {
+                c.ws();
+                if (c.p < c.end && *c.p == '"') {
+                  int64_t boff, blen2;
+                  if (!c.str(&boff, &blen2, body)) return fail();
+                  if (token_is(body, boff, blen2, "BATCHING")) behavior |= 0;
+                  else if (token_is(body, boff, blen2, "NO_BATCHING")) behavior |= 1;
+                  else if (token_is(body, boff, blen2, "GLOBAL")) behavior |= 2;
+                  else if (token_is(body, boff, blen2, "DURATION_IS_GREGORIAN")) behavior |= 4;
+                  else if (token_is(body, boff, blen2, "RESET_REMAINING")) behavior |= 8;
+                  else if (token_is(body, boff, blen2, "MULTI_REGION")) behavior |= 16;
+                  else {
+                    JsonCursor t{body + boff, body + boff + blen2};
+                    int64_t v;
+                    if (t.integer(&v) && t.p == t.end) behavior = (int32_t)v;
+                    else if (err == 0) err = 4;
+                  }
+                } else if (c.p < c.end && *c.p == '[') {
+                  // list of flag names: rare — Python fallback
+                  return fail();
+                } else {
+                  int64_t v;
+                  if (!c.integer(&v)) return fail();
+                  behavior = (int32_t)v;
+                }
+              } else {
+                if (!c.skip_value()) return fail();
+              }
+              if (c.lit(',')) continue;
+              if (c.lit('}')) break;
+              return fail();
+            }
+          }
+          // validation order matches gubernator.go:142-152 (unique_key first)
+          if (err == 0 && ulen == 0) err = 1;
+          if (err == 0 && nlen == 0) err = 2;
+          jb->algo.push_back(algo);
+          jb->behavior.push_back(behavior);
+          jb->hits.push_back(hits);
+          jb->limit.push_back(limit);
+          jb->duration.push_back(duration);
+          jb->err.push_back(err);
+          jb->nspan.push_back(noff);
+          jb->nspan.push_back(nlen);
+          jb->ukspan.push_back(uoff);
+          jb->ukspan.push_back(ulen);
+          jb->hk.append(body + noff, (size_t)nlen);
+          jb->hk.push_back('_');
+          jb->hk.append(body + uoff, (size_t)ulen);
+          if (c.lit(',')) continue;
+          if (c.lit(']')) break;
+          return fail();
+        }
+      }
+    } else {
+      if (!c.skip_value()) return fail();
+    }
+    if (c.lit(',')) continue;
+    if (c.lit('}')) break;
+    return fail();
+  }
+  c.ws();
+  if (c.p != c.end || !c.ok || !found_requests) {
+    if (!found_requests && c.ok && c.p == c.end) {
+      jb->hkoff.push_back(0);
+      return jb;  // no "requests" key: empty batch (gateway .get default)
+    }
+    return fail();
+  }
+  jb->hkoff.resize(jb->algo.size() + 1);
+  int64_t acc = 0;
+  for (size_t i = 0; i < jb->algo.size(); i++) {
+    jb->hkoff[i] = acc;
+    acc += jb->nspan[2 * i + 1] + 1 + jb->ukspan[2 * i + 1];
+  }
+  jb->hkoff[jb->algo.size()] = acc;
+  return jb;
+}
+
+int64_t gt_json_n(void* j) { return (int64_t)((JsonBatch*)j)->algo.size(); }
+int64_t gt_json_hk_bytes(void* j) { return (int64_t)((JsonBatch*)j)->hk.size(); }
+
+void gt_json_fill(void* jv, int32_t* algo, int32_t* behavior, int64_t* hits,
+                  int64_t* limit, int64_t* duration, uint8_t* err, char* hk,
+                  int64_t* hkoff, int64_t* nspan, int64_t* ukspan) {
+  auto* j = (JsonBatch*)jv;
+  size_t n = j->algo.size();
+  if (n) {
+    memcpy(algo, j->algo.data(), n * sizeof(int32_t));
+    memcpy(behavior, j->behavior.data(), n * sizeof(int32_t));
+    memcpy(hits, j->hits.data(), n * sizeof(int64_t));
+    memcpy(limit, j->limit.data(), n * sizeof(int64_t));
+    memcpy(duration, j->duration.data(), n * sizeof(int64_t));
+    memcpy(err, j->err.data(), n);
+    memcpy(nspan, j->nspan.data(), 2 * n * sizeof(int64_t));
+    memcpy(ukspan, j->ukspan.data(), 2 * n * sizeof(int64_t));
+  }
+  if (!j->hk.empty()) memcpy(hk, j->hk.data(), j->hk.size());
+  memcpy(hkoff, j->hkoff.data(), (n + 1) * sizeof(int64_t));
+}
+
+void gt_json_free(void* j) { delete (JsonBatch*)j; }
+
+// Render the GetRateLimits response body from result columns.  Lanes
+// listed in ov_idx (sorted) splice in pre-rendered JSON objects
+// (validation errors / forwarded lanes — rendered by Python, which
+// keeps full metadata fidelity).  Single pass straight into the
+// caller's buffer; `cap` must hold the worst case (a per-lane object
+// is <= 129 bytes: 58 fixed + 11 status + 3x20 digits — callers
+// budget 160).  Returns bytes written, or -1 if cap would overflow.
+int64_t gt_json_render(const int32_t* status, const int64_t* limit,
+                       const int64_t* remaining, const int64_t* reset,
+                       int64_t n, const int64_t* ov_idx, int64_t n_ov,
+                       const char* ov_buf, const int64_t* ov_off,
+                       char* out, int64_t cap) {
+  static const char* kStatus[] = {"UNDER_LIMIT", "OVER_LIMIT"};
+  char* w = out;
+  char* wend = out + cap;
+  auto put = [&](const char* p, size_t len) {
+    if (w + len > wend) return false;
+    memcpy(w, p, len);
+    w += len;
+    return true;
+  };
+  auto lit = [&](const char* p) { return put(p, strlen(p)); };
+  if (!lit("{\"responses\":[")) return -1;
+  int64_t oi = 0;
+  char tmp[24];
+  for (int64_t i = 0; i < n; i++) {
+    if (i && !lit(",")) return -1;
+    if (oi < n_ov && ov_idx[oi] == i) {
+      if (!put(ov_buf + ov_off[oi], (size_t)(ov_off[oi + 1] - ov_off[oi])))
+        return -1;
+      oi++;
+      continue;
+    }
+    if (!lit("{\"status\":\"") || !lit(kStatus[status[i] & 1]) ||
+        !lit("\",\"limit\":\"") ||
+        !put(tmp, snprintf(tmp, sizeof tmp, "%lld", (long long)limit[i])) ||
+        !lit("\",\"remaining\":\"") ||
+        !put(tmp, snprintf(tmp, sizeof tmp, "%lld", (long long)remaining[i])) ||
+        !lit("\",\"resetTime\":\"") ||
+        !put(tmp, snprintf(tmp, sizeof tmp, "%lld", (long long)reset[i])) ||
+        !lit("\"}"))
+      return -1;
+  }
+  if (!lit("]}")) return -1;
+  return (int64_t)(w - out);
+}
+
+}  // extern "C"

@@ -846,27 +846,60 @@ def apply_rounds_dict(
     )
 
 
-apply_rounds_dict_jit = jax.jit(
-    apply_rounds_dict, donate_argnums=0, static_argnames=("cold_cond",)
-)
+def pack_dict_wire(slot, exists, write, cfg, occ, round_id, table) -> "jax.Array":
+    """Serialize one dict-wire batch into a SINGLE i32 buffer.
 
+    The dict wire's 12 separate arrays cost 12 host->device transfers
+    per dispatch; at service batch sizes (<=4096 lanes) the per-call
+    overhead dwarfs the bytes, so everything rides one [S, 3P + 7*256]
+    i32 array instead (host packs with numpy views, device unpacks with
+    free slices/shifts inside the jit):
 
-def make_batch_dict(slot, exists, write, cfg, occ, table, shards: int = 0) -> RequestBatchDict:
-    """Assemble the dict-wire batch (shared by ShardStore and the mesh
-    store so the encoding lives in one place).  `shards` > 0 broadcasts
-    the 7 table rows to a leading shard axis for the vmapped kernel."""
+      words [0,P)    slot (i32)
+      words [P,2P)   occ | flags<<16 | cfg<<24   (flags: bit0 exists,
+                                                  bit1 write)
+      words [2P,3P)  round_id
+      words [3P,..)  the 7 config-table rows, 256 words each
+
+    Inputs are [S, P] arrays (or [P] reshaped by the caller) plus the
+    7-row table as [rows][256] (shared across shards — the device
+    unpack broadcasts it, so the wire carries it once per shard row
+    only to keep the buffer rectangular).
+    """
     import numpy as np
 
-    rows = table
-    if shards:
-        rows = tuple(
-            np.broadcast_to(r, (shards,) + r.shape).copy() for r in table
-        )
-    return RequestBatchDict(
+    S, P = slot.shape
+    w = np.empty((S, 3 * P + 7 * DICT_TABLE_ROWS), dtype=np.int32)
+    w[:, :P] = slot
+    meta = occ.astype(np.int32) & 0xFFFF
+    meta |= (exists.astype(np.int32) | (write.astype(np.int32) << 1)) << 16
+    meta |= cfg.astype(np.int32) << 24
+    w[:, P:2 * P] = meta
+    w[:, 2 * P:3 * P] = round_id
+    for k in range(7):
+        w[:, 3 * P + k * DICT_TABLE_ROWS:3 * P + (k + 1) * DICT_TABLE_ROWS] = table[k]
+    return w
+
+
+def unpack_dict_wire(w, P: int):
+    """Device-side twin of pack_dict_wire for ONE shard row: returns
+    (RequestBatchDict, round_id) from a [3P + 7*256] i32 vector.  Pure
+    slicing/shifting — fuses into the kernel for free."""
+    slot = w[:P]
+    meta = w[P:2 * P]
+    occ = (meta & 0xFFFF).astype(jnp.uint16)
+    fl = (meta >> 16) & 0xFF
+    cfg = ((meta >> 24) & 0xFF).astype(jnp.uint8)
+    rid = w[2 * P:3 * P]
+    rows = [
+        w[3 * P + k * DICT_TABLE_ROWS:3 * P + (k + 1) * DICT_TABLE_ROWS]
+        for k in range(7)
+    ]
+    reqd = RequestBatchDict(
         slot=slot,
-        flags=exists.astype(np.uint8) | (write.astype(np.uint8) << 1),
+        flags=fl.astype(jnp.uint8),
         cfg=cfg,
-        occ=occ.astype(np.uint16),
+        occ=occ,
         t_algorithm=rows[0],
         t_behavior=rows[1],
         t_hits=rows[2],
@@ -875,6 +908,22 @@ def make_batch_dict(slot, exists, write, cfg, occ, table, shards: int = 0) -> Re
         t_greg_expire_delta=rows[5],
         t_greg_duration=rows[6],
     )
+    return reqd, rid
+
+
+def apply_rounds_packed(
+    state: BucketState, wire, n_rounds, now_ms, cold_cond: bool = True
+) -> "tuple[BucketState, jax.Array]":
+    """apply_rounds_dict behind the single-buffer wire ([3P+1792] i32
+    for one shard; see pack_dict_wire)."""
+    P = (wire.shape[0] - 7 * DICT_TABLE_ROWS) // 3
+    reqd, rid = unpack_dict_wire(wire, P)
+    return apply_rounds_dict(state, reqd, rid, n_rounds, now_ms, cold_cond=cold_cond)
+
+
+apply_rounds_packed_jit = jax.jit(
+    apply_rounds_packed, donate_argnums=0, static_argnames=("cold_cond",)
+)
 
 
 def build_config_dict(cols, now_ms: int):

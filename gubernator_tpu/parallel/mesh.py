@@ -163,14 +163,14 @@ def _rounds64_mesh_jit(state, batch, round_id, n_rounds, now):
 
 
 @partial(jax.jit, donate_argnums=0)
-def _rounds_dict_mesh_jit(state, batchd, round_id8, n_rounds, now):
-    """Config-dictionary wire across all shards (buckets.RequestBatchDict):
-    ~5x fewer host->device bytes per lane than the narrow wire."""
+def _rounds_packed_mesh_jit(state, wire, n_rounds, now):
+    """Dict-wire rounds behind the single-buffer wire ([S, 3P+1792]
+    i32, see buckets.pack_dict_wire): one sharded transfer per batch."""
 
-    def one(state_s, b_s, rid_s):
-        return buckets.apply_rounds_dict(state_s, b_s, rid_s, n_rounds, now, cold_cond=False)
+    def one(state_s, w_s):
+        return buckets.apply_rounds_packed(state_s, w_s, n_rounds, now, cold_cond=False)
 
-    return jax.vmap(one)(state, batchd, round_id8)
+    return jax.vmap(one)(state, wire)
 
 
 @partial(jax.jit, donate_argnums=0)
@@ -490,7 +490,7 @@ class MeshBucketStore(ColumnarPipeline):
         n = len(keys)
         if S == 1:
             order = None
-            shard_keys = [list(keys)]
+            shard_keys = [keys]  # planner accepts lists and PackedKeys
             shard_cols = [cols]
             counts = np.array([n])
             bounds = np.array([0, n], dtype=np.int64)
@@ -502,8 +502,15 @@ class MeshBucketStore(ColumnarPipeline):
             counts = np.bincount(sidx, minlength=S)
             bounds = np.zeros(S + 1, dtype=np.int64)
             np.cumsum(counts, out=bounds[1:])
-            sorted_keys = [keys[i] for i in order]
-            shard_keys = [sorted_keys[bounds[s]:bounds[s + 1]] for s in range(S)]
+            if isinstance(keys, _native.PackedKeys):
+                sorted_keys = keys.subset(order)
+                shard_keys = [
+                    sorted_keys.subset(np.arange(bounds[s], bounds[s + 1]))
+                    for s in range(S)
+                ]
+            else:
+                sorted_keys = [keys[i] for i in order]
+                shard_keys = [sorted_keys[bounds[s]:bounds[s + 1]] for s in range(S)]
             shard_cols = []
             for s in range(S):
                 sl = order[bounds[s]:bounds[s + 1]]
@@ -583,13 +590,15 @@ class MeshBucketStore(ColumnarPipeline):
             gd_a[s, :m] = c.greg_duration
 
         if cfg_sorted is not None and int(occ_a.max(initial=0)) <= 65535:
-            batch = buckets.make_batch_dict(
-                slot_a, ex_a, wr_a, cfg_a, occ_a, cfg_table, shards=S
+            # Single-buffer wire: ONE sharded host->device transfer per
+            # batch instead of 12 (per-call overhead dominates at
+            # service batch sizes).
+            wire = buckets.pack_dict_wire(
+                slot_a, ex_a, wr_a, cfg_a, occ_a, rid_a, cfg_table
             )
-            batch = jax.tree.map(lambda a: jax.device_put(a, self._sharding), batch)
-            rid_dev = jax.device_put(jnp.asarray(rid_a.astype(np.uint8)), self._sharding)
-            self.state, packed = _rounds_dict_mesh_jit(
-                self.state, batch, rid_dev, n_rounds, now_ms
+            wire_dev = jax.device_put(wire, self._sharding)
+            self.state, packed = _rounds_packed_mesh_jit(
+                self.state, wire_dev, n_rounds, now_ms
             )
         else:
             mk = buckets.make_batch32 if narrow else buckets.make_batch

@@ -236,9 +236,16 @@ class ColumnarBatcher:
                 keys = cols[0]
                 arrays = cols[1:]
             else:
-                keys = []
-                for (c, _) in batch:
-                    keys.extend(c[0])
+                from .native import PackedKeys
+
+                if all(isinstance(c[0], PackedKeys) for c, _ in batch):
+                    # Packed-keys coalesce: concat buffers, never decode
+                    # per-lane strings.
+                    keys = PackedKeys.concat([c[0] for c, _ in batch])
+                else:
+                    keys = []
+                    for (c, _) in batch:
+                        keys.extend(c[0])
                 arrays = tuple(
                     np.concatenate([c[i] for c, _ in batch])
                     for i in range(1, 8)
@@ -362,23 +369,38 @@ class V1Service:
         fast = np.logical_not(slow)
 
         # Validation (gubernator.go:142-152) + hash keys in one pass.
-        hash_keys: List[str] = [""] * n
-        for i in range(n):
-            uk = cols.unique_keys[i]
-            nm = cols.names[i]
-            if not uk:
+        # The native JSON edge precomputes both (gateway
+        # LazyIngressColumns.prevalidated): packed hash keys flow to
+        # the planner with zero per-lane Python.
+        pre = getattr(cols, "prevalidated", None)
+        if pre is not None:
+            hash_keys, errc = pre
+            for i in np.nonzero(errc)[0]:
+                i = int(i)
                 result.overrides[i] = RateLimitResponse(
                     error="field 'unique_key' cannot be empty"
+                    if errc[i] == 1
+                    else "field 'namespace' cannot be empty"
                 )
                 fast[i] = slow[i] = False
-                continue
-            if not nm:
-                result.overrides[i] = RateLimitResponse(
-                    error="field 'namespace' cannot be empty"
-                )
-                fast[i] = slow[i] = False
-                continue
-            hash_keys[i] = f"{nm}_{uk}"
+        else:
+            hash_keys: List[str] = [""] * n
+            for i in range(n):
+                uk = cols.unique_keys[i]
+                nm = cols.names[i]
+                if not uk:
+                    result.overrides[i] = RateLimitResponse(
+                        error="field 'unique_key' cannot be empty"
+                    )
+                    fast[i] = slow[i] = False
+                    continue
+                if not nm:
+                    result.overrides[i] = RateLimitResponse(
+                        error="field 'namespace' cannot be empty"
+                    )
+                    fast[i] = slow[i] = False
+                    continue
+                hash_keys[i] = f"{nm}_{uk}"
 
         # Ownership: the single-self-peer daemon (the common standalone
         # topology) owns everything; multi-peer rings resolve owners in
@@ -405,6 +427,14 @@ class V1Service:
                         )
                 return result
             if not single_owner and psize >= 1:
+                if pre is not None and not isinstance(hash_keys, list):
+                    # Picker routing indexes by emptiness; materialize
+                    # with "" for error lanes (rare multi-peer + native
+                    # edge combination).
+                    packed = hash_keys
+                    hash_keys = [
+                        "" if errc[i] else packed[i] for i in range(n)
+                    ]
                 owners = self.local_picker.get_batch(
                     [k for k in hash_keys if k]
                 )
@@ -514,7 +544,12 @@ class V1Service:
         def dispatch(idx, direct):
             full = idx.size == n
             sl = slice(None) if full else idx
-            keys_sel = hash_keys if full else [hash_keys[i] for i in idx]
+            if full:
+                keys_sel = hash_keys
+            elif isinstance(hash_keys, list):
+                keys_sel = [hash_keys[i] for i in idx]
+            else:
+                keys_sel = hash_keys.subset(idx)  # PackedKeys, no per-lane Python
             args = (
                 keys_sel, cols.algorithm[sl], beh[sl], cols.hits[sl],
                 cols.limit[sl], cols.duration[sl],
