@@ -357,7 +357,130 @@ def config5():
         cl.stop()
 
 
-CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+def config6():
+    """GLOBAL convergence across 2 real daemons at DEPLOYMENT cadence
+    (auto-tuned GlobalSyncWait): sustained GLOBAL throughput through the
+    non-owner plus the time for an owner-side OVER_LIMIT to become
+    visible in the non-owner's replica cache — the measured twin of the
+    reference's TestGlobalRateLimits (functional_test.go:478-546)."""
+
+    from gubernator_tpu.client import V1Client
+    from gubernator_tpu.config import DaemonConfig
+    from gubernator_tpu.daemon import Daemon
+    from gubernator_tpu.types import (
+        Algorithm,
+        Behavior,
+        GetRateLimitsRequest,
+        RateLimitRequest,
+    )
+
+    daemons = []
+    for _ in range(2):
+        daemons.append(
+            Daemon(
+                DaemonConfig(
+                    listen_address="127.0.0.1:0",
+                    grpc_listen_address="127.0.0.1:0",
+                    cache_size=8192,
+                    global_cache_size=512,
+                    peer_discovery_type="static",
+                )
+            ).start()
+        )
+    try:
+        peers = [d.peer_info for d in daemons]
+        for d in daemons:
+            d.set_peers(peers)
+        clients = [V1Client(d.gateway.address, timeout_s=120.0) for d in daemons]
+
+        def owner_of(key):
+            for i, d in enumerate(daemons):
+                peer = d.service.get_peer(f"g6_{key}")
+                if peer.info.is_owner:
+                    return i
+            return 0
+
+        # a key owned by daemon 0; traffic goes through daemon 1
+        key = next(
+            f"conv-{k * 7919}" for k in range(256)
+            if owner_of(f"conv-{k * 7919}") == 0
+        )
+
+        def req(k, hits=1, limit=100_000_000):
+            return RateLimitRequest(
+                name="g6", unique_key=k, hits=hits, limit=limit,
+                duration=3_600_000, algorithm=Algorithm.TOKEN_BUCKET,
+                behavior=Behavior.GLOBAL,
+            )
+
+        # --- throughput: sustained GLOBAL batches via the NON-owner
+        # (answered from the replica cache; hits forward + broadcast on
+        # the auto-tuned window) ---
+        batch = GetRateLimitsRequest(requests=[req(key) for _ in range(_sz(512))])
+        clients[1].get_rate_limits(batch)  # warm
+        iters = 20
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            clients[1].get_rate_limits(batch)
+        dt = time.perf_counter() - t0
+        cps = len(batch.requests) * iters / dt
+
+        # --- convergence lag: drive a key to sticky OVER_LIMIT through
+        # the OWNER (drain to 0, then one more hit — the sticky-status
+        # path, algorithms.go:112-117), then poll the NON-owner with
+        # hits=0 status reads until the owner's broadcast lands in its
+        # replica cache.  All mutation goes through the owner so the
+        # non-owner's answer-local bucket cannot mask the broadcast ---
+        lags = []
+        for trial in range(5):
+            t = trial
+            k = f"{key}-t{t * 104729}"
+            while owner_of(k) != 0:
+                t += 7
+                k = f"{key}-t{t * 104729}"
+            drain = GetRateLimitsRequest(requests=[req(k, hits=5, limit=5)])
+            clients[0].get_rate_limits(drain)
+            over = GetRateLimitsRequest(requests=[req(k, hits=1, limit=5)])
+            t0 = time.perf_counter()
+            r = clients[0].get_rate_limits(over).responses[0]
+            assert r.status == 1, r  # owner is now sticky OVER_LIMIT
+            probe = GetRateLimitsRequest(requests=[req(k, hits=0, limit=5)])
+            while True:
+                r = clients[1].get_rate_limits(probe).responses[0]
+                if r.status == 1:
+                    lags.append(time.perf_counter() - t0)
+                    break
+                if time.perf_counter() - t0 > 30:
+                    lags.append(None)  # timed out: excluded from stats
+                    break
+                time.sleep(0.005)
+        ok_ms = sorted(x * 1e3 for x in lags if x is not None)
+        timeouts = sum(1 for x in lags if x is None)
+        print(
+            json.dumps(
+                {
+                    "metric": "cfg6_global_checks_per_sec",
+                    "value": round(cps, 1),
+                    "unit": "checks/s",
+                    "vs_baseline": round(cps / BASELINE_RPS, 2),
+                    "daemons": 2,
+                    "convergence_ms_p50": round(ok_ms[len(ok_ms) // 2], 1) if ok_ms else -1,
+                    "convergence_ms_max": round(ok_ms[-1], 1) if ok_ms else -1,
+                    "convergence_timeouts": timeouts,
+                    "sync_window": "auto",
+                }
+            ),
+            flush=True,
+        )
+    finally:
+        for c in clients:
+            getattr(c, "close", lambda: None)()
+        for d in daemons:
+            d.close()
+
+
+CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
+           6: config6}
 
 
 def main():

@@ -12,7 +12,17 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="gubernator-tpu rate-limit daemon")
     parser.add_argument("-config", dest="config", default="", help="env config file")
     parser.add_argument("-debug", dest="debug", action="store_true", help="debug logging")
+    parser.add_argument(
+        "-version", "--version", dest="version", action="store_true",
+        help="print version and exit",
+    )
     args = parser.parse_args(argv)
+
+    if args.version:
+        from .. import __version__
+
+        print(f"gubernator-tpu {__version__}")
+        return 0
 
     from . import apply_jax_platform_env
 

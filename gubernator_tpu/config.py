@@ -59,6 +59,9 @@ class DaemonConfig:
 
     listen_address: str = "127.0.0.1:1050"
     grpc_listen_address: str = ""
+    # Rotate long-lived gRPC client connections (daemon.go:91-96,
+    # GUBER_GRPC_MAX_CONN_AGE_SEC); 0 disables.
+    grpc_max_conn_age_s: int = 0
     advertise_address: str = ""
     cache_size: int = 50_000
     global_cache_size: int = 4096
@@ -79,6 +82,14 @@ class DaemonConfig:
     etcd_endpoints: List[str] = field(default_factory=lambda: ["localhost:2379"])
     etcd_key_prefix: str = "/gubernator/peers/"
     etcd_advertise_address: str = ""  # defaults to the daemon advertise address
+    # etcd auth + TLS (config.go:309-310, setupEtcdTLS config.go:390-433)
+    etcd_user: str = ""
+    etcd_password: str = ""
+    etcd_tls_enable: bool = False
+    etcd_tls_cert: str = ""
+    etcd_tls_key: str = ""
+    etcd_tls_ca: str = ""
+    etcd_tls_skip_verify: bool = False
     # k8s discovery knobs (reference K8sPoolConfig, kubernetes.go:63-72 /
     # config.go:320-328).
     k8s_namespace: str = "default"
@@ -103,6 +114,15 @@ class DaemonConfig:
 
     def resolved_advertise(self) -> str:
         return self.advertise_address or self.listen_address
+
+
+def _env_bool(merged: "Dict[str, str]", key: str, default: bool) -> bool:
+    """Reference getEnvBool semantics: any truthy string enables
+    (config.go:444-489); absent keeps the default."""
+    v = merged.get(key, "")
+    if v == "":
+        return default
+    return v.lower() in ("true", "1", "yes")
 
 
 def _env_int(env: Dict[str, str], name: str, default: int) -> int:
@@ -182,6 +202,7 @@ def setup_daemon_config(
     conf = DaemonConfig()
     conf.listen_address = merged.get("GUBER_HTTP_ADDRESS") or conf.listen_address
     conf.grpc_listen_address = merged.get("GUBER_GRPC_ADDRESS", "")
+    conf.grpc_max_conn_age_s = _env_int(merged, "GUBER_GRPC_MAX_CONN_AGE_SEC", 0)
     conf.advertise_address = merged.get(
         "GUBER_ADVERTISE_ADDRESS", merged.get("GUBER_GRPC_ADVERTISE_ADDRESS", "")
     )
@@ -214,6 +235,15 @@ def setup_daemon_config(
         conf.etcd_endpoints = [e.strip() for e in etcd_endpoints.split(",") if e.strip()]
     conf.etcd_key_prefix = merged.get("GUBER_ETCD_KEY_PREFIX", conf.etcd_key_prefix)
     conf.etcd_advertise_address = merged.get("GUBER_ETCD_ADVERTISE_ADDRESS", "")
+    conf.etcd_user = merged.get("GUBER_ETCD_USER", conf.etcd_user)
+    conf.etcd_password = merged.get("GUBER_ETCD_PASSWORD", conf.etcd_password)
+    conf.etcd_tls_enable = _env_bool(merged, "GUBER_ETCD_TLS_ENABLE", conf.etcd_tls_enable)
+    conf.etcd_tls_cert = merged.get("GUBER_ETCD_TLS_CERT", conf.etcd_tls_cert)
+    conf.etcd_tls_key = merged.get("GUBER_ETCD_TLS_KEY", conf.etcd_tls_key)
+    conf.etcd_tls_ca = merged.get("GUBER_ETCD_TLS_CA", conf.etcd_tls_ca)
+    conf.etcd_tls_skip_verify = _env_bool(
+        merged, "GUBER_ETCD_TLS_SKIP_VERIFY", conf.etcd_tls_skip_verify
+    )
     conf.k8s_namespace = merged.get("GUBER_K8S_NAMESPACE", conf.k8s_namespace)
     conf.k8s_pod_ip = merged.get("GUBER_K8S_POD_IP", "")
     conf.k8s_pod_port = merged.get("GUBER_K8S_POD_PORT", "") or conf.k8s_pod_port

@@ -79,7 +79,10 @@ class Daemon:
         if not grpc_listen:
             host, _, _ = self.conf.listen_address.partition(":")
             grpc_listen = f"{host or '127.0.0.1'}:0"
-        self.grpc = GrpcServer(self.service, grpc_listen, tls_conf=tls_conf).start()
+        self.grpc = GrpcServer(
+            self.service, grpc_listen, tls_conf=tls_conf,
+            max_conn_age_s=getattr(self.conf, "grpc_max_conn_age_s", 0),
+        ).start()
         self.gateway = GatewayServer(
             self.service, self.conf.listen_address, tls_context=server_tls
         )

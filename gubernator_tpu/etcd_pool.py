@@ -56,12 +56,17 @@ class EtcdClient:
         endpoints: Sequence[str],
         credentials: Optional[grpc.ChannelCredentials] = None,
         timeout_s: float = ETCD_TIMEOUT_S,
+        username: str = "",
+        password: str = "",
     ):
         if not endpoints:
             raise ValueError("at least one etcd endpoint is required")
         self.endpoints = list(endpoints)
         self.timeout_s = timeout_s
         self._credentials = credentials
+        self._username = username
+        self._password = password
+        self._metadata: "Optional[list]" = None
         self._endpoint_idx = 0
         self._rotate_lock = threading.Lock()
         self._retired_channels: list = []
@@ -118,6 +123,36 @@ class EtcdClient:
             request_serializer=rpc.WatchRequest.SerializeToString,
             response_deserializer=rpc.WatchResponse.FromString,
         )
+        self._authenticate = u(
+            "/etcdserverpb.Auth/Authenticate",
+            request_serializer=rpc.AuthenticateRequest.SerializeToString,
+            response_deserializer=rpc.AuthenticateResponse.FromString,
+        )
+        # GUBER_ETCD_USER/PASSWORD (config.go:309-310): etcd v3 auth is
+        # token-based — Authenticate once per connection, then send the
+        # token as `token` metadata on every call.  Re-connecting (the
+        # rotate() failover path) re-authenticates, which also renews an
+        # expired token: callers' retry loops rotate on auth errors the
+        # same as on transport errors.
+        if self._username:
+            try:
+                resp = self._authenticate(
+                    rpc.AuthenticateRequest(
+                        name=self._username, password=self._password
+                    ),
+                    timeout=self.timeout_s,
+                )
+                self._metadata = [("token", resp.token)]
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                # Wrong credentials must fail pool construction (the
+                # reference's client refuses too); a TRANSPORT failure
+                # must not kill the retry loops that call rotate() from
+                # their own except-handlers — leave the stale/absent
+                # token, let the next RPC fail, and back off again.
+                if self._metadata is None and code == grpc.StatusCode.INVALID_ARGUMENT:
+                    raise
+                log.warning("etcd re-authentication failed (will retry): %s", e)
 
     def rotate(self, observed_index: Optional[int] = None) -> None:
         """Fail over to the next configured endpoint.
@@ -127,11 +162,20 @@ class EtcdClient:
         advance the index ONCE, not past the fresh endpoint.  The old
         channel is retired, not closed — the other thread's healthy
         stream on it keeps running; retirees close at client close()."""
-        if len(self.endpoints) <= 1:
-            return
         with self._rotate_lock:
             if observed_index is not None and observed_index != self._endpoint_idx:
                 return  # another thread already rotated away
+            if len(self.endpoints) <= 1:
+                # Single endpoint: nothing to fail over to, but rebuild
+                # the channel anyway — with auth enabled this is the
+                # only place an expired token gets renewed (etcd simple
+                # tokens expire server-side; every caller reaches here
+                # via its failure-retry loop).
+                self._retired_channels.append(self._channel)
+                while len(self._retired_channels) > 2:
+                    self._retired_channels.pop(0).close()
+                self._connect()
+                return
             self._retired_channels.append(self._channel)
             # Bound the retirement list: only the most recent retirees
             # can still carry another thread's live stream; older ones
@@ -149,7 +193,7 @@ class EtcdClient:
         p = prefix.encode()
         resp = self._range(
             rpc.RangeRequest(key=p, range_end=prefix_range_end(p)),
-            timeout=self.timeout_s,
+            timeout=self.timeout_s, metadata=self._metadata,
         )
         kvs = [(kv.key.decode(), kv.value) for kv in resp.kvs]
         return kvs, resp.header.revision
@@ -157,20 +201,29 @@ class EtcdClient:
     def put(self, key: str, value: bytes, lease_id: int = 0) -> None:
         self._put(
             rpc.PutRequest(key=key.encode(), value=value, lease=lease_id),
-            timeout=self.timeout_s,
+            timeout=self.timeout_s, metadata=self._metadata,
         )
 
     def delete(self, key: str) -> None:
-        self._delete(rpc.DeleteRangeRequest(key=key.encode()), timeout=self.timeout_s)
+        self._delete(
+            rpc.DeleteRangeRequest(key=key.encode()),
+            timeout=self.timeout_s, metadata=self._metadata,
+        )
 
     def lease_grant(self, ttl_s: int) -> int:
-        resp = self._grant(rpc.LeaseGrantRequest(TTL=ttl_s), timeout=self.timeout_s)
+        resp = self._grant(
+            rpc.LeaseGrantRequest(TTL=ttl_s),
+            timeout=self.timeout_s, metadata=self._metadata,
+        )
         if resp.error:
             raise RuntimeError(f"lease grant failed: {resp.error}")
         return resp.ID
 
     def lease_revoke(self, lease_id: int) -> None:
-        self._revoke(rpc.LeaseRevokeRequest(ID=lease_id), timeout=self.timeout_s)
+        self._revoke(
+            rpc.LeaseRevokeRequest(ID=lease_id),
+            timeout=self.timeout_s, metadata=self._metadata,
+        )
 
     def lease_keepalive(self, lease_id: int, interval_s: float, stop: threading.Event):
         """Generator of keepalive responses, sending a ping every
@@ -182,7 +235,7 @@ class EtcdClient:
                 yield rpc.LeaseKeepAliveRequest(ID=lease_id)
                 stop.wait(interval_s)
 
-        return self._keepalive(requests())
+        return self._keepalive(requests(), metadata=self._metadata)
 
     def watch_prefix(self, prefix: str, start_revision: int, stop: threading.Event):
         """Returns (response_iterator, done_event) for a prefix watch
@@ -205,7 +258,7 @@ class EtcdClient:
             while not stop.is_set() and not done.is_set():
                 done.wait(0.5)
 
-        return self._watch(requests()), done
+        return self._watch(requests(), metadata=self._metadata), done
 
     def close(self) -> None:
         with self._rotate_lock:
@@ -213,6 +266,59 @@ class EtcdClient:
                 ch.close()
             self._retired_channels.clear()
             self._channel.close()
+
+
+def credentials_from_config(conf) -> Optional[grpc.ChannelCredentials]:
+    """setupEtcdTLS equivalent (config.go:390-433): build channel
+    credentials from the GUBER_ETCD_TLS_* surface.
+
+      * GUBER_ETCD_TLS_CA           — verify against this CA
+      * GUBER_ETCD_TLS_CERT/KEY     — client certificate (mTLS)
+      * GUBER_ETCD_TLS_ENABLE       — TLS with system roots
+      * GUBER_ETCD_TLS_SKIP_VERIFY  — TLS pinning each endpoint's own
+        certificate fetched at startup (Python gRPC cannot disable
+        verification outright; trust-on-first-use is the closest
+        faithful semantic to the reference's InsecureSkipVerify)
+
+    Returns None when no TLS knob is set (plaintext)."""
+    ca = getattr(conf, "etcd_tls_ca", "")
+    cert = getattr(conf, "etcd_tls_cert", "")
+    key = getattr(conf, "etcd_tls_key", "")
+    enable = getattr(conf, "etcd_tls_enable", False)
+    skip = getattr(conf, "etcd_tls_skip_verify", False)
+    if not (ca or (cert and key) or enable or skip):
+        return None
+    root_pem = None
+    if ca:
+        with open(ca, "rb") as f:
+            root_pem = f.read()
+    elif skip:
+        import ssl as _ssl
+
+        pins = []
+        for ep in getattr(conf, "etcd_endpoints", []):
+            host, _, port = ep.partition(":")
+            try:
+                pins.append(
+                    _ssl.get_server_certificate(
+                        (host, int(port or 2379)), timeout=ETCD_TIMEOUT_S
+                    )
+                )
+            except OSError as e:  # endpoint down: pin the others
+                log.warning("etcd skip-verify pin failed for %s: %s", ep, e)
+        if pins:
+            root_pem = "".join(pins).encode()
+    key_pem = chain_pem = None
+    if cert and key:
+        with open(key, "rb") as f:
+            key_pem = f.read()
+        with open(cert, "rb") as f:
+            chain_pem = f.read()
+    return grpc.ssl_channel_credentials(
+        root_certificates=root_pem,
+        private_key=key_pem,
+        certificate_chain=chain_pem,
+    )
 
 
 class EtcdPool:
@@ -228,6 +334,8 @@ class EtcdPool:
         credentials: Optional[grpc.ChannelCredentials] = None,
         lease_ttl_s: int = LEASE_TTL_S,
         backoff_s: float = BACKOFF_TIMEOUT_S,
+        username: str = "",
+        password: str = "",
     ):
         if not advertise.grpc_address:
             raise ValueError("Advertise.GRPCAddress is required")  # etcd.go:78
@@ -236,7 +344,10 @@ class EtcdPool:
         self.key_prefix = key_prefix
         self.lease_ttl_s = lease_ttl_s
         self.backoff_s = backoff_s
-        self.client = client or EtcdClient(endpoints, credentials=credentials)
+        self.client = client or EtcdClient(
+            endpoints, credentials=credentials,
+            username=username, password=password,
+        )
         self._instance_key = key_prefix + advertise.grpc_address
         self._peers: dict = {}
         self._peers_lock = threading.Lock()

@@ -73,14 +73,22 @@ class GrpcServer:
         listen_address: str = "127.0.0.1:0",
         tls_conf=None,  # Optional[tls.TLSConfig] (file paths already resolved)
         max_workers: int = 32,
+        max_conn_age_s: int = 0,
     ):
         self.service = service
+        options = [
+            ("grpc.max_receive_message_length", MAX_RECV_BYTES),
+            ("grpc.so_reuseport", 0),
+        ]
+        if max_conn_age_s > 0:
+            # GUBER_GRPC_MAX_CONN_AGE_SEC (daemon.go:91-96): rotate
+            # long-lived client connections so load rebalances across a
+            # changing cluster; same 30s grace the reference sets.
+            options.append(("grpc.max_connection_age_ms", max_conn_age_s * 1000))
+            options.append(("grpc.max_connection_age_grace_ms", 30 * 1000))
         self._server = grpc.server(
             ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="grpc"),
-            options=[
-                ("grpc.max_receive_message_length", MAX_RECV_BYTES),
-                ("grpc.so_reuseport", 0),
-            ],
+            options=options,
             interceptors=(MetricsInterceptor(service.metrics),),
         )
         self._server.add_generic_rpc_handlers(

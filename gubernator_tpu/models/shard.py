@@ -718,8 +718,11 @@ class ShardStore(ColumnarPipeline):
         # pass-through lanes.
         passthrough_exp = self.table.get_expire_bulk(slots) if narrow else None
         dict_enc = None
-        if (narrow and force_wire is None and n_rounds <= 255
+        if (force_wire is None and n_rounds <= 255
                 and int(occ_col.max(initial=0)) <= 65535):
+            # The dict wire carries values in its 256-row i64 table, so
+            # it works at ANY magnitude — wide batches (monthly/yearly
+            # Gregorian, big limits) only switch the OUTPUT width.
             dict_enc = buckets.build_config_dict(cols, now_ms)
         if dict_enc is not None:
             cfg_idx, table = dict_enc
@@ -731,9 +734,12 @@ class ShardStore(ColumnarPipeline):
                 _pad(cfg_idx, padded, np.uint8)[None, :], occ_col[None, :],
                 rid_col[None, :], table,
             )[0]
-            self.state, packed = buckets.apply_rounds_packed_jit(
-                self.state, wire, n_rounds, now_ms
+            kern = (
+                buckets.apply_rounds_packed_jit
+                if narrow
+                else buckets.apply_rounds_packed_wide_jit
             )
+            self.state, packed = kern(self.state, wire, n_rounds, now_ms)
         elif narrow:
             greg_delta = np.where(
                 cols.greg_duration != 0, cols.greg_expire - now_ms, 0

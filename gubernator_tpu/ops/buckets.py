@@ -846,55 +846,91 @@ def apply_rounds_dict(
     )
 
 
+DICT_WIRE_TABLE_WORDS = 2 * DICT_TABLE_ROWS + 5 * 2 * DICT_TABLE_ROWS
+
+
 def pack_dict_wire(slot, exists, write, cfg, occ, round_id, table) -> "jax.Array":
     """Serialize one dict-wire batch into a SINGLE i32 buffer.
 
     The dict wire's 12 separate arrays cost 12 host->device transfers
     per dispatch; at service batch sizes (<=4096 lanes) the per-call
-    overhead dwarfs the bytes, so everything rides one [S, 3P + 7*256]
-    i32 array instead (host packs with numpy views, device unpacks with
-    free slices/shifts inside the jit):
+    overhead dwarfs the bytes, so everything rides one
+    [S, 3P + DICT_WIRE_TABLE_WORDS] i32 array instead (host packs with
+    numpy views, device unpacks with free slices/shifts inside the
+    jit):
 
       words [0,P)    slot (i32)
       words [P,2P)   occ | flags<<16 | cfg<<24   (flags: bit0 exists,
                                                   bit1 write)
       words [2P,3P)  round_id
-      words [3P,..)  the 7 config-table rows, 256 words each
+      words [3P,..)  config-table rows: algo(256), behavior(256), then
+                     hits/limit/duration/greg_expire_delta/
+                     greg_duration as i64 lo/hi word pairs (512 each)
 
-    Inputs are [S, P] arrays (or [P] reshaped by the caller) plus the
-    7-row table as [rows][256] (shared across shards — the device
-    unpack broadcasts it, so the wire carries it once per shard row
+    The value rows are 64-bit so ANY magnitude (monthly Gregorian
+    expiries, >2^31 limits) rides the dict wire — per-lane bytes are
+    unchanged because values live in the 256-row table.
+
+    Inputs are [S, P] arrays plus the 7-row table as [rows][256]
+    (shared across shards — the wire carries it once per shard row
     only to keep the buffer rectangular).
     """
     import numpy as np
 
     S, P = slot.shape
-    w = np.empty((S, 3 * P + 7 * DICT_TABLE_ROWS), dtype=np.int32)
+    w = np.empty((S, 3 * P + DICT_WIRE_TABLE_WORDS), dtype=np.int32)
     w[:, :P] = slot
     meta = occ.astype(np.int32) & 0xFFFF
     meta |= (exists.astype(np.int32) | (write.astype(np.int32) << 1)) << 16
     meta |= cfg.astype(np.int32) << 24
     w[:, P:2 * P] = meta
     w[:, 2 * P:3 * P] = round_id
-    for k in range(7):
-        w[:, 3 * P + k * DICT_TABLE_ROWS:3 * P + (k + 1) * DICT_TABLE_ROWS] = table[k]
+    pos = 3 * P
+    for k in range(2):  # algo, behavior: i32
+        w[:, pos:pos + DICT_TABLE_ROWS] = table[k].astype(np.int32)
+        pos += DICT_TABLE_ROWS
+    for k in range(2, 7):  # value rows: i64 as lo/hi
+        v = table[k].astype(np.int64)
+        w[:, pos:pos + DICT_TABLE_ROWS] = (v & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+        pos += DICT_TABLE_ROWS
+        w[:, pos:pos + DICT_TABLE_ROWS] = (v >> 32).astype(np.int32)
+        pos += DICT_TABLE_ROWS
     return w
 
 
 def unpack_dict_wire(w, P: int):
     """Device-side twin of pack_dict_wire for ONE shard row: returns
-    (RequestBatchDict, round_id) from a [3P + 7*256] i32 vector.  Pure
-    slicing/shifting — fuses into the kernel for free."""
+    (slot, flags, cfg u8, occ, rid, [7 table value arrays — value rows
+    composed to i64]).  Pure slicing/shifting — fuses into the kernel
+    for free."""
     slot = w[:P]
     meta = w[P:2 * P]
     occ = (meta & 0xFFFF).astype(jnp.uint16)
     fl = (meta >> 16) & 0xFF
     cfg = ((meta >> 24) & 0xFF).astype(jnp.uint8)
     rid = w[2 * P:3 * P]
-    rows = [
-        w[3 * P + k * DICT_TABLE_ROWS:3 * P + (k + 1) * DICT_TABLE_ROWS]
-        for k in range(7)
-    ]
+    pos = 3 * P
+    rows = []
+    for k in range(2):
+        rows.append(w[pos:pos + DICT_TABLE_ROWS])
+        pos += DICT_TABLE_ROWS
+    for k in range(5):
+        lo = w[pos:pos + DICT_TABLE_ROWS]
+        pos += DICT_TABLE_ROWS
+        hi = w[pos:pos + DICT_TABLE_ROWS]
+        pos += DICT_TABLE_ROWS
+        rows.append(_compose64(lo, hi))
+    return slot, fl, cfg, occ, rid, rows
+
+
+def apply_rounds_packed(
+    state: BucketState, wire, n_rounds, now_ms, cold_cond: bool = True
+) -> "tuple[BucketState, jax.Array]":
+    """Narrow-output dict kernel behind the single-buffer wire.  Host
+    precondition (narrow_ok): every value and every time the kernel
+    computes fits the i32 output deltas."""
+    P = (wire.shape[0] - DICT_WIRE_TABLE_WORDS) // 3
+    slot, fl, cfg, occ, rid, rows = unpack_dict_wire(wire, P)
     reqd = RequestBatchDict(
         slot=slot,
         flags=fl.astype(jnp.uint8),
@@ -902,27 +938,54 @@ def unpack_dict_wire(w, P: int):
         occ=occ,
         t_algorithm=rows[0],
         t_behavior=rows[1],
-        t_hits=rows[2],
-        t_limit=rows[3],
-        t_duration=rows[4],
-        t_greg_expire_delta=rows[5],
-        t_greg_duration=rows[6],
+        t_hits=rows[2].astype(_I32),
+        t_limit=rows[3].astype(_I32),
+        t_duration=rows[4].astype(_I32),
+        t_greg_expire_delta=rows[5].astype(_I32),
+        t_greg_duration=rows[6].astype(_I32),
     )
-    return reqd, rid
-
-
-def apply_rounds_packed(
-    state: BucketState, wire, n_rounds, now_ms, cold_cond: bool = True
-) -> "tuple[BucketState, jax.Array]":
-    """apply_rounds_dict behind the single-buffer wire ([3P+1792] i32
-    for one shard; see pack_dict_wire)."""
-    P = (wire.shape[0] - 7 * DICT_TABLE_ROWS) // 3
-    reqd, rid = unpack_dict_wire(wire, P)
     return apply_rounds_dict(state, reqd, rid, n_rounds, now_ms, cold_cond=cold_cond)
 
 
 apply_rounds_packed_jit = jax.jit(
     apply_rounds_packed, donate_argnums=0, static_argnames=("cold_cond",)
+)
+
+
+def apply_rounds_packed_wide(
+    state: BucketState, wire, n_rounds, now_ms, cold_cond: bool = True
+) -> "tuple[BucketState, jax.Array]":
+    """Wide-output twin of apply_rounds_packed: same single-buffer wire,
+    int64 compute and a packed i64[4, B] result (decode with
+    unpack_output).  This is what keeps monthly/yearly Gregorian
+    batches on the dict wire: their far-future expiries exceed the
+    narrow output's i32 deltas, but per-lane bytes are identical —
+    only the readback doubles.  Matches interval.go:82-146 being
+    first-class in the reference."""
+    now = jnp.asarray(now_ms, _I64)
+    P = (wire.shape[0] - DICT_WIRE_TABLE_WORDS) // 3
+    slot, fl, cfg, occ, rid, rows = unpack_dict_wire(wire, P)
+    cfg = cfg.astype(_I32)
+    delta = rows[5][cfg]
+    greg_dur = rows[6][cfg]
+    req = RequestBatch(
+        slot=slot,
+        exists=(fl & 1) != 0,
+        algorithm=rows[0][cfg],
+        behavior=rows[1][cfg],
+        hits=rows[2][cfg],
+        limit=rows[3][cfg],
+        duration=rows[4][cfg],
+        greg_expire=jnp.where(greg_dur != 0, now + delta, 0),
+        greg_duration=greg_dur,
+        occ=occ.astype(_I32),
+        write=(fl & 2) != 0,
+    )
+    return apply_rounds(state, req, rid, n_rounds, now_ms, cold_cond=cold_cond)
+
+
+apply_rounds_packed_wide_jit = jax.jit(
+    apply_rounds_packed_wide, donate_argnums=0, static_argnames=("cold_cond",)
 )
 
 
@@ -959,7 +1022,10 @@ def build_config_dict(cols, now_ms: int):
             return None  # collision: correctness over compactness
     table = []
     for c in arrays:
-        row = np.zeros(DICT_TABLE_ROWS, np.int32)
+        # i64 rows: the table is 256 entries, so wide values (monthly/
+        # yearly Gregorian expiries, >2^31 limits) cost nothing per
+        # lane — the whole batch stays on the dict wire.
+        row = np.zeros(DICT_TABLE_ROWS, np.int64)
         row[: len(uq)] = c[idx_first]
         table.append(row)
     return inv.astype(np.uint8), tuple(table)

@@ -174,6 +174,19 @@ def _rounds_packed_mesh_jit(state, wire, n_rounds, now):
 
 
 @partial(jax.jit, donate_argnums=0)
+def _rounds_packed_wide_mesh_jit(state, wire, n_rounds, now):
+    """Wide-output packed dict wire (values beyond int32 — monthly/
+    yearly Gregorian expiries; i64[S, 4, B] result)."""
+
+    def one(state_s, w_s):
+        return buckets.apply_rounds_packed_wide(
+            state_s, w_s, n_rounds, now, cold_cond=False
+        )
+
+    return jax.vmap(one)(state, wire)
+
+
+@partial(jax.jit, donate_argnums=0)
 def _set_replica_jit(gcols, gslots, status, limit, remaining, reset):
     return jax.vmap(
         global_ops.set_replica, in_axes=(0, None, None, None, None, None)
@@ -541,7 +554,10 @@ class MeshBucketStore(ColumnarPipeline):
         padded = pad_size(maxb)
         narrow = narrow_ok(cols, now_ms) and force_wire != "wide"
         dict_enc = None
-        if narrow and force_wire is None and n_rounds <= 255:
+        if force_wire is None and n_rounds <= 255:
+            # Values live in the dict wire's 256-row i64 table, so wide
+            # batches (monthly/yearly Gregorian) stay on it too — only
+            # the output width switches (apply_rounds_packed_wide).
             dict_enc = buckets.build_config_dict(cols, now_ms)
         cfg_sorted = None
         if dict_enc is not None:
@@ -597,7 +613,10 @@ class MeshBucketStore(ColumnarPipeline):
                 slot_a, ex_a, wr_a, cfg_a, occ_a, rid_a, cfg_table
             )
             wire_dev = jax.device_put(wire, self._sharding)
-            self.state, packed = _rounds_packed_mesh_jit(
+            fn_packed = (
+                _rounds_packed_mesh_jit if narrow else _rounds_packed_wide_mesh_jit
+            )
+            self.state, packed = fn_packed(
                 self.state, wire_dev, n_rounds, now_ms
             )
         else:

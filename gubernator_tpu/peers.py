@@ -121,11 +121,16 @@ def make_pool(kind: str, conf, on_update: OnUpdate, advertise: Optional[PeerInfo
                 http_address=advertise.http_address,
                 data_center=advertise.data_center,
             )
+        from .etcd_pool import credentials_from_config
+
         return EtcdPool(
             advertise=advertise,
             on_update=on_update,
             endpoints=conf.etcd_endpoints,
             key_prefix=conf.etcd_key_prefix,
+            credentials=credentials_from_config(conf),
+            username=getattr(conf, "etcd_user", ""),
+            password=getattr(conf, "etcd_password", ""),
         )
     if kind == "member-list":
         from .gossip import GossipPool

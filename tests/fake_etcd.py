@@ -33,10 +33,15 @@ class _KV:
 
 
 class FakeEtcd:
-    def __init__(self, lease_scale: float = 1.0):
+    def __init__(self, lease_scale: float = 1.0, tls_creds=None,
+                 auth_users: "Dict[str, str] | None" = None):
         """lease_scale shrinks granted TTLs (a 30s lease with
-        lease_scale=0.01 expires in 0.3s) so expiry paths are testable."""
+        lease_scale=0.01 expires in 0.3s) so expiry paths are testable.
+        `tls_creds` (grpc.ServerCredentials) serves TLS; `auth_users`
+        (name -> password) enforces etcd v3 token auth on every RPC."""
         self.lease_scale = lease_scale
+        self.auth_users = dict(auth_users or {})
+        self._tokens: set = set()
         self._lock = threading.RLock()
         self._kv: Dict[bytes, _KV] = {}
         self._revision = 0
@@ -51,25 +56,53 @@ class FakeEtcd:
 
         self._server = grpc.server(ThreadPoolExecutor(max_workers=16))
         self._server.add_generic_rpc_handlers((self._handlers(),))
-        self.port = self._server.add_insecure_port("127.0.0.1:0")
+        if tls_creds is not None:
+            self.port = self._server.add_secure_port("127.0.0.1:0", tls_creds)
+        else:
+            self.port = self._server.add_insecure_port("127.0.0.1:0")
         self.address = f"127.0.0.1:{self.port}"
         self._server.start()
 
     # ------------------------------------------------------------------
     def _handlers(self):
+        def guard(fn):
+            # etcd v3 auth: every RPC must carry a live token in the
+            # `token` metadata once auth is enabled.
+            def inner(req, ctx):
+                if self.auth_users:
+                    md = dict(ctx.invocation_metadata())
+                    if md.get("token") not in self._tokens:
+                        ctx.abort(
+                            grpc.StatusCode.INVALID_ARGUMENT,
+                            "etcdserver: invalid auth token",
+                        )
+                return fn(req, ctx)
+
+            return inner
+
         def uu(fn, req_cls):
             return grpc.unary_unary_rpc_method_handler(
-                fn,
+                guard(fn),
                 request_deserializer=req_cls.FromString,
                 response_serializer=lambda m: m.SerializeToString(),
             )
 
         def ss(fn, req_cls):
             return grpc.stream_stream_rpc_method_handler(
-                fn,
+                guard(fn),
                 request_deserializer=req_cls.FromString,
                 response_serializer=lambda m: m.SerializeToString(),
             )
+
+        def do_auth(req: rpc.AuthenticateRequest, ctx) -> rpc.AuthenticateResponse:
+            if self.auth_users.get(req.name) != req.password:
+                ctx.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    "etcdserver: authentication failed, invalid user ID or password",
+                )
+            tok = f"tok-{req.name}-{len(self._tokens)}"
+            self._tokens.add(tok)
+            return rpc.AuthenticateResponse(header=self._header(), token=tok)
 
         method_map = {
             "/etcdserverpb.KV/Range": uu(self._do_range, rpc.RangeRequest),
@@ -79,6 +112,11 @@ class FakeEtcd:
             "/etcdserverpb.Lease/LeaseRevoke": uu(self._do_revoke, rpc.LeaseRevokeRequest),
             "/etcdserverpb.Lease/LeaseKeepAlive": ss(self._do_keepalive, rpc.LeaseKeepAliveRequest),
             "/etcdserverpb.Watch/Watch": ss(self._do_watch, rpc.WatchRequest),
+            "/etcdserverpb.Auth/Authenticate": grpc.unary_unary_rpc_method_handler(
+                do_auth,
+                request_deserializer=rpc.AuthenticateRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            ),
         }
 
         class Handler(grpc.GenericRpcHandler):

@@ -205,3 +205,105 @@ def test_etcd_env_parsing():
     assert conf.etcd_endpoints == ["e1:2379", "e2:2379"]
     assert conf.etcd_key_prefix == "/my-peers"
     assert conf.etcd_advertise_address == "10.1.1.1:81"
+
+
+# ---------------------------------------------------------------------
+# TLS + username/password auth (config.go:309-310, setupEtcdTLS
+# config.go:390-433): a secured etcd cluster must be usable for
+# discovery.
+# ---------------------------------------------------------------------
+
+
+@pytest.fixture
+def tls_server(tmp_path):
+    import grpc
+
+    from gubernator_tpu import tls as gtls
+
+    ca_crt, ca_key = gtls.self_ca(str(tmp_path))
+    crt, key = gtls.self_cert(str(tmp_path), ca_crt, ca_key, name="etcd")
+    with open(key, "rb") as f:
+        key_pem = f.read()
+    with open(crt, "rb") as f:
+        crt_pem = f.read()
+    creds = grpc.ssl_server_credentials([(key_pem, crt_pem)])
+    s = FakeEtcd(tls_creds=creds, auth_users={"guber": "s3cret"})
+    s.ca_file = ca_crt
+    yield s
+    s.stop()
+
+
+class _EtcdConf:
+    """The GUBER_ETCD_* surface as credentials_from_config consumes it."""
+
+    def __init__(self, server, **kw):
+        self.etcd_endpoints = [f"localhost:{server.port}"]
+        self.etcd_tls_ca = kw.get("ca", "")
+        self.etcd_tls_cert = kw.get("cert", "")
+        self.etcd_tls_key = kw.get("key", "")
+        self.etcd_tls_enable = kw.get("enable", False)
+        self.etcd_tls_skip_verify = kw.get("skip", False)
+
+
+def test_tls_auth_register_and_discover(tls_server):
+    from gubernator_tpu.etcd_pool import credentials_from_config
+
+    creds = credentials_from_config(_EtcdConf(tls_server, ca=tls_server.ca_file))
+    assert creds is not None
+    updates = []
+    pool = EtcdPool(
+        advertise=PeerInfo(grpc_address="10.1.0.1:81"),
+        on_update=updates.append,
+        endpoints=[f"localhost:{tls_server.port}"],
+        credentials=creds,
+        username="guber",
+        password="s3cret",
+    )
+    try:
+        wait_until(lambda: updates and len(updates[-1]) == 1, msg="peer update")
+        assert updates[-1][0].grpc_address == "10.1.0.1:81"
+    finally:
+        pool.close()
+
+
+def test_auth_rejects_bad_password(tls_server):
+    from gubernator_tpu.etcd_pool import credentials_from_config
+
+    creds = credentials_from_config(_EtcdConf(tls_server, ca=tls_server.ca_file))
+    with pytest.raises(Exception):
+        EtcdPool(
+            advertise=PeerInfo(grpc_address="10.1.0.2:81"),
+            on_update=lambda *_: None,
+            endpoints=[f"localhost:{tls_server.port}"],
+            credentials=creds,
+            username="guber",
+            password="wrong",
+        )
+
+
+def test_auth_required_without_token(tls_server):
+    from gubernator_tpu.etcd_pool import credentials_from_config
+
+    creds = credentials_from_config(_EtcdConf(tls_server, ca=tls_server.ca_file))
+    with pytest.raises(Exception):
+        EtcdPool(
+            advertise=PeerInfo(grpc_address="10.1.0.3:81"),
+            on_update=lambda *_: None,
+            endpoints=[f"localhost:{tls_server.port}"],
+            credentials=creds,
+        )
+
+
+def test_etcd_env_surface(monkeypatch, tmp_path):
+    """GUBER_ETCD_USER/PASSWORD/TLS_* parse into DaemonConfig."""
+    monkeypatch.setenv("GUBER_ETCD_USER", "u1")
+    monkeypatch.setenv("GUBER_ETCD_PASSWORD", "p1")
+    monkeypatch.setenv("GUBER_ETCD_TLS_ENABLE", "true")
+    ca = tmp_path / "ca.pem"
+    ca.write_text("x")
+    monkeypatch.setenv("GUBER_ETCD_TLS_CA", str(ca))
+    conf = setup_daemon_config()
+    assert conf.etcd_user == "u1"
+    assert conf.etcd_password == "p1"
+    assert conf.etcd_tls_enable is True
+    assert conf.etcd_tls_ca == str(ca)

@@ -243,3 +243,35 @@ def _peer_rpc_count(daemon) -> float:
                 if s.labels.get("method") == "/pb.gubernator.PeersV1/GetPeerRateLimits":
                     return s.value
     return 0.0
+
+
+def test_max_conn_age_option(monkeypatch):
+    """GUBER_GRPC_MAX_CONN_AGE_SEC -> grpc.max_connection_age_ms server
+    option (daemon.go:91-96)."""
+    from gubernator_tpu.config import setup_daemon_config
+
+    monkeypatch.setenv("GUBER_GRPC_MAX_CONN_AGE_SEC", "7")
+    conf = setup_daemon_config()
+    assert conf.grpc_max_conn_age_s == 7
+
+    captured = {}
+    import grpc as _grpc
+
+    real_server = _grpc.server
+
+    def spy(executor, options=None, **kw):
+        captured["options"] = dict(options or [])
+        return real_server(executor, options=options, **kw)
+
+    monkeypatch.setattr(_grpc, "server", spy)
+    from gubernator_tpu.grpc_server import GrpcServer
+    from gubernator_tpu.service import ServiceConfig, V1Service
+
+    svc = V1Service(ServiceConfig(cache_size=64))
+    try:
+        srv = GrpcServer(svc, "127.0.0.1:0", max_conn_age_s=7)
+        srv.start().close()
+        assert captured["options"]["grpc.max_connection_age_ms"] == 7000
+        assert captured["options"]["grpc.max_connection_age_grace_ms"] == 30000
+    finally:
+        svc.close()
