@@ -749,10 +749,13 @@ def apply_rounds32(
         write=req32.write,
     )
     # Pre-batch expiry per lane, read BEFORE the rounds mutate state:
-    # the pass-through detector for the -2 sentinel.
+    # the pass-through detector for the -2 sentinel.  ROW gather, not
+    # two scalar-column gathers — XLA lowers `hot[si, k]` per element
+    # (~ms at 131k lanes) but `hot[si]` as one vectorized row gather.
     C = state.hot.shape[0]
     si = jnp.clip(req32.slot, 0, C - 1)
-    pre_exp = _compose64(state.hot[si, _H_EXP_LO], state.hot[si, _H_EXP_HI])
+    pre = state.hot[si]
+    pre_exp = _compose64(pre[:, _H_EXP_LO], pre[:, _H_EXP_HI])
 
     state, packed64 = apply_rounds(
         state, req, round_id, n_rounds, now_ms, cold_cond=cold_cond
