@@ -253,3 +253,56 @@ def test_columnar_fallback_without_native():
             assert r.response_at(i).remaining == 9
     finally:
         svc.close()
+
+
+def test_wide_gregorian_stays_on_dict_wire_and_matches_wide():
+    """Yearly Gregorian expiries exceed the narrow wire's i32 deltas;
+    the dict wire must still carry them (int64 table rows + wide-output
+    kernel) and produce results identical to the forced per-lane wide
+    wire (interval.go:82-146 is first-class in the reference)."""
+    import numpy as np
+
+    from gubernator_tpu.models.shard import GregResolver, ShardStore
+    from gubernator_tpu.types import Behavior
+    from gubernator_tpu.utils import gregorian
+
+    NOW = 1_700_000_000_000
+    n = 96
+    greg = GregResolver(NOW)
+    ge_y, gd_y = greg.resolve(gregorian.GREGORIAN_YEARS)
+    ge_d, gd_d = greg.resolve(gregorian.GREGORIAN_DAYS)
+    yearly = (np.arange(n) % 2).astype(bool)
+    kw = dict(
+        algorithm=(np.arange(n) % 2).astype(np.int32),
+        behavior=np.full(n, int(Behavior.DURATION_IS_GREGORIAN), np.int32),
+        hits=np.ones(n, np.int64),
+        limit=np.full(n, 1000, np.int64),
+        duration=np.where(
+            yearly, gregorian.GREGORIAN_YEARS, gregorian.GREGORIAN_DAYS
+        ).astype(np.int64),
+        greg_expire=np.where(yearly, ge_y, ge_d).astype(np.int64),
+        greg_duration=np.where(yearly, gd_y, gd_d).astype(np.int64),
+    )
+    keys = [f"wg:{k % 24}" for k in range(n)]  # duplicates too
+
+    # Guard against a vacuous pass: this batch must be dict-encodable
+    # (otherwise both stores would silently take the same wide per-lane
+    # wire and the comparison proves nothing).
+    from gubernator_tpu.models.shard import make_columns
+    from gubernator_tpu.ops import buckets
+
+    cols = make_columns(
+        kw["algorithm"], kw["behavior"], kw["hits"], kw["limit"],
+        kw["duration"], n, kw["greg_expire"], kw["greg_duration"],
+    )
+    assert buckets.build_config_dict(cols, NOW) is not None
+
+    a = ShardStore(capacity=256)
+    b = ShardStore(capacity=256)
+    for step in range(3):
+        ra = a.apply_columns(keys, now_ms=NOW + step, **kw)
+        rb = b.apply_columns(keys, now_ms=NOW + step, force_wire="wide", **kw)
+        for f in ("status", "remaining", "reset_time", "limit"):
+            np.testing.assert_array_equal(ra[f], rb[f], err_msg=f"{f} step {step}")
+    # yearly lanes really do exceed the narrow delta (the point of the test)
+    assert int((kw["greg_expire"] - NOW).max()) > (1 << 31) - 1

@@ -1,0 +1,180 @@
+"""Native JSON edge tests: the C++ parser/renderer (host_runtime.cpp
+gt_json_parse / gt_json_render) against the Python path's behavior.
+
+The parser must either produce EXACTLY what parse_columns would, or
+return None so the gateway falls back — these tests pin both sides of
+that contract, including the fallback triggers found in review
+(duplicate "requests" keys, trailing garbage, escapes, floats).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from gubernator_tpu import native
+from gubernator_tpu.native import PackedKeys
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native runtime unavailable"
+)
+
+
+def parse(obj_or_bytes):
+    raw = (
+        obj_or_bytes
+        if isinstance(obj_or_bytes, bytes)
+        else json.dumps(obj_or_bytes).encode()
+    )
+    return native.parse_json_batch(raw)
+
+
+def test_basic_batch():
+    pj = parse(
+        {
+            "requests": [
+                {"name": "a", "uniqueKey": "k1", "hits": 2, "limit": 10,
+                 "duration": 60000},
+                {"name": "b", "unique_key": "k2", "hits": "3", "limit": "20",
+                 "duration": "1000", "algorithm": "LEAKY_BUCKET",
+                 "behavior": "NO_BATCHING"},
+            ]
+        }
+    )
+    assert pj is not None and pj.n == 2
+    assert pj.algo.tolist() == [0, 1]
+    assert pj.behavior.tolist() == [0, 1]
+    assert pj.hits.tolist() == [2, 3]
+    assert pj.limit.tolist() == [10, 20]
+    assert pj.duration.tolist() == [60000, 1000]
+    assert pj.err.tolist() == [0, 0]
+    assert list(pj.hash_keys) == ["a_k1", "b_k2"]
+    assert pj.name_at(1) == "b" and pj.unique_key_at(0) == "k1"
+
+
+def test_validation_codes_match_reference_order():
+    pj = parse(
+        {
+            "requests": [
+                {"name": "a", "uniqueKey": ""},  # empty unique_key first
+                {"name": "", "uniqueKey": ""},   # both empty: unique_key wins
+                {"name": "", "uniqueKey": "k"},
+                {"name": "a", "uniqueKey": "k"},
+            ]
+        }
+    )
+    assert pj.err.tolist() == [1, 1, 2, 0]
+
+
+def test_behavior_numeric_and_enum_int():
+    pj = parse({"requests": [{"name": "a", "uniqueKey": "k", "behavior": 18,
+                              "algorithm": 1}]})
+    assert pj.behavior.tolist() == [18]
+    assert pj.algo.tolist() == [1]
+
+
+def test_unknown_fields_skipped():
+    pj = parse(
+        {
+            "requests": [
+                {"name": "a", "uniqueKey": "k", "metadata": {"x": [1, {"y": 2}]},
+                 "weird": None, "flag": True, "hits": 1}
+            ]
+        }
+    )
+    assert pj is not None and pj.n == 1 and pj.hits.tolist() == [1]
+
+
+@pytest.mark.parametrize(
+    "raw",
+    [
+        b'{"requests": [{"name": "a\\n", "uniqueKey": "k"}]}',  # escape in name
+        b'{"requests": [{"name": "a", "uniqueKey": "k", "hits": 1.5}]}',  # float
+        b'{"requests": [{"name": "a", "uniqueKey": "k", "behavior": ["GLOBAL"]}]}',  # list
+        b'{"requests": []} junk',  # trailing garbage
+        b'{} xx',  # trailing garbage on empty object
+        b'{"requests": [{"name": "a", "uniqueKey": "x"}], "requests": [{"name": "b", "uniqueKey": "y"}]}',  # dup key
+        b'{"requests": [{"name": "a" "uniqueKey": "k"}]}',  # malformed
+        b'{"requests": [{"name": "a", "uniqueKey": "k", "hits": 99999999999999999999}]}',  # >18 digits
+    ],
+)
+def test_fallback_triggers(raw):
+    assert native.parse_json_batch(raw) is None
+
+
+def test_bad_enum_token_reports_err_code():
+    pj = parse({"requests": [{"name": "a", "uniqueKey": "k",
+                              "algorithm": "NOT_A_BUCKET"}]})
+    assert pj is not None and pj.err.tolist() == [3]
+    pj = parse({"requests": [{"name": "a", "uniqueKey": "k",
+                              "behavior": "NOT_A_FLAG"}]})
+    assert pj is not None and pj.err.tolist() == [4]
+
+
+def test_empty_shapes():
+    assert parse({"requests": []}).n == 0
+    assert parse({}).n == 0
+    pj = parse({"other": 1})
+    assert pj is not None and pj.n == 0
+
+
+def test_render_matches_python_renderer():
+    """Differential: the native render must serialize exactly what the
+    Python renderer (gateway.render_columns) would."""
+    from gubernator_tpu.gateway import render_columns
+    from gubernator_tpu.service import ColumnarResult
+
+    status = np.array([0, 1, 0], np.int32)
+    limit = np.array([10, 20, 30], np.int64)
+    remaining = np.array([9, 0, 3], np.int64)
+    reset = np.array([111, 222, 1 << 40], np.int64)
+    out = native.render_json(status, limit, remaining, reset, {})
+    expected = render_columns(
+        ColumnarResult(n=3, status=status, limit=limit,
+                       remaining=remaining, reset_time=reset)
+    )
+    assert json.loads(out) == expected
+
+
+def test_render_with_overrides():
+    status = np.zeros(3, np.int32)
+    z = np.zeros(3, np.int64)
+    ov = {1: json.dumps({"error": "boom"}, separators=(",", ":")).encode()}
+    out = native.render_json(status, z, z, z, ov)
+    decoded = json.loads(out)
+    assert decoded["responses"][1] == {"error": "boom"}
+    assert decoded["responses"][0]["status"] == "UNDER_LIMIT"
+
+
+def test_packed_keys_subset_concat():
+    pk = PackedKeys(*native.pack_keys(["alpha", "b", "", "gamma"]))
+    assert len(pk) == 4 and pk[2] == "" and pk[3] == "gamma"
+    sub = pk.subset(np.array([3, 0]))
+    assert list(sub) == ["gamma", "alpha"]
+    cat = PackedKeys.concat([pk, sub])
+    assert list(cat) == ["alpha", "b", "", "gamma", "gamma", "alpha"]
+
+
+def test_parser_roundtrip_against_python_parse():
+    """Differential: for a supported body the native columns must equal
+    parse_columns' output exactly."""
+    from gubernator_tpu.gateway import parse_columns
+
+    items = [
+        {"name": f"n{i}", "uniqueKey": f"k{i}", "hits": i, "limit": 100 + i,
+         "duration": 1000 * i, "algorithm": "TOKEN_BUCKET" if i % 2 else 1,
+         "behavior": i % 32}
+        for i in range(1, 50)
+    ]
+    raw = json.dumps({"requests": items}).encode()
+    pj = native.parse_json_batch(raw)
+    cols = parse_columns(items)
+    assert pj.n == len(cols)
+    np.testing.assert_array_equal(pj.algo, cols.algorithm)
+    np.testing.assert_array_equal(pj.behavior, cols.behavior)
+    np.testing.assert_array_equal(pj.hits, cols.hits)
+    np.testing.assert_array_equal(pj.limit, cols.limit)
+    np.testing.assert_array_equal(pj.duration, cols.duration)
+    assert list(pj.hash_keys) == [
+        f"{n}_{u}" for n, u in zip(cols.names, cols.unique_keys)
+    ]
