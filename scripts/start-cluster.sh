@@ -1,0 +1,9 @@
+#!/bin/sh
+# Start a local multi-node gubernator-tpu cluster for development
+# (the scripts/start-cluster.sh equivalent: the reference launches N
+# server binaries with per-instance env; here the in-process cluster
+# binary spawns N real daemons sharing the device and prints their
+# addresses, Ctrl-C to stop).
+set -eu
+NODES="${NODES:-6}"
+exec python -m gubernator_tpu.cmd.cluster_main --nodes "$NODES" "$@"
