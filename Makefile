@@ -6,9 +6,12 @@
 test:
 	python -m pytest tests/ -q -p no:cacheprovider
 
-# One JSON line: {"metric", "value", "unit", "vs_baseline", ...}
+# One JSON line: {"metric", "value", "unit", "vs_baseline", ...},
+# then the failing regression gate on the stable device rows
+# (benchmarks/gate_thresholds.json).
 bench:
 	python bench.py
+	python bench.py --gate
 
 # The five BASELINE.json configs (one JSON line each); --smoke for CI
 bench-full:

@@ -260,11 +260,12 @@ def config5():
         # warm every daemon's path
         for c in clients:
             c.get_rate_limits(batches[0])
-        # Concurrent storm clients (the reference's ThunderingHeard is
-        # a 100-way fanout, benchmark_test.go:110-138): one thread per
-        # batch, round-robin across daemons.
+        # Concurrent storm clients at the reference's ThunderingHeard
+        # fanout — 100 concurrent callers (benchmark_test.go:110-138) —
+        # round-robin across daemons.
         import threading as _th
 
+        N_STORM = 100
         totals = [0, 0]
         lock = _th.Lock()
 
@@ -275,13 +276,13 @@ def config5():
                 totals[0] += len(resp.responses)
                 totals[1] += o
 
-        # Untimed concurrent warm epoch: 24-way coalescing produces
+        # Untimed concurrent warm epoch: 100-way coalescing produces
         # pad shapes the serial warm loop never dispatches, and a cold
         # shape's first dispatch pays a multi-second remote executable
         # load that would dominate the timed epoch.
         warm_ts = [
-            _th.Thread(target=_storm, args=(i, b))
-            for i, b in enumerate(batches * 3)
+            _th.Thread(target=_storm, args=(i, batches[i % len(batches)]))
+            for i in range(N_STORM)
         ]
         for t in warm_ts:
             t.start()
@@ -290,8 +291,8 @@ def config5():
         totals[0] = totals[1] = 0
         t0 = time.perf_counter()
         ts = [
-            _th.Thread(target=_storm, args=(i, b))
-            for i, b in enumerate(batches * 3)
+            _th.Thread(target=_storm, args=(i, batches[i % len(batches)]))
+            for i in range(N_STORM)
         ]
         for t in ts:
             t.start()
@@ -323,9 +324,12 @@ def config5():
             for _ in range(plain_iters)
         ]
         clients[0].get_rate_limits(plain_batches[0])  # warm the batch shape
-        # 6 concurrent clients through ONE gateway (coalescing window
-        # merges them into shared dispatches); untimed warm epoch first
-        # so coalesced pad shapes don't compile inside the timing.
+        # 100 concurrent clients through ONE gateway (ThunderingHeard
+        # fanout parity; the coalescing window merges them into shared
+        # dispatches); untimed warm epoch first so coalesced pad shapes
+        # don't compile inside the timing.
+        N_PLAIN = 100
+
         def _plain(tid, iters, out=None):
             c = 0
             for i in range(iters):
@@ -335,15 +339,15 @@ def config5():
                 with lock:
                     out[0] += c
 
-        warm_ts = [_th.Thread(target=_plain, args=(t, 2)) for t in range(6)]
+        warm_ts = [_th.Thread(target=_plain, args=(t, 2)) for t in range(N_PLAIN)]
         for t in warm_ts:
             t.start()
         for t in warm_ts:
             t.join()
         totals = [0]
         ts = [
-            _th.Thread(target=_plain, args=(t, plain_iters, totals))
-            for t in range(6)
+            _th.Thread(target=_plain, args=(t, 3, totals))
+            for t in range(N_PLAIN)
         ]
         t0 = time.perf_counter()
         for t in ts:
@@ -351,7 +355,7 @@ def config5():
         for t in ts:
             t.join()
         dt = time.perf_counter() - t0
-        _emit("5_plain", totals[0], dt, daemons=1, clients=6,
+        _emit("5_plain", totals[0], dt, daemons=1, clients=N_PLAIN,
               batch=len(plain_batches[0].requests))
     finally:
         cl.stop()
@@ -468,6 +472,17 @@ def config6():
                     "convergence_ms_max": round(ok_ms[-1], 1) if ok_ms else -1,
                     "convergence_timeouts": timeouts,
                     "sync_window": "auto",
+                    # Diagnostics: where each daemon's auto window
+                    # actually landed (10x the measured sync cost,
+                    # clamped [5ms, 1s]).
+                    "sync_window_ms": [
+                        round(d.service.global_mgr.sync_wait_s * 1e3, 1)
+                        for d in daemons
+                    ],
+                    "sync_cost_ms": [
+                        round((d.service.global_mgr.measured_sync_cost_s or 0) * 1e3, 2)
+                        for d in daemons
+                    ],
                 }
             ),
             flush=True,
@@ -489,6 +504,10 @@ def main():
                         help="run one config (default: all)")
     parser.add_argument("--smoke", action="store_true",
                         help="shrink every config ~1000x (correctness/CI)")
+    parser.add_argument("--cpu", action="store_true",
+                        help="force the CPU backend: tunnel-free host-cost "
+                             "and convergence measurements (the TPU rows "
+                             "come from the default backend)")
     args = parser.parse_args()
     if args.smoke:
         global SCALE
@@ -496,7 +515,11 @@ def main():
 
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache_cpu")
+    else:
+        jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     for n in sorted(CONFIGS) if args.config == 0 else [args.config]:

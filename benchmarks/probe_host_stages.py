@@ -1,0 +1,104 @@
+"""Round-4 probe #2: where does the service-ingress host time go?
+
+Runs the full V1Service columnar ingress (get_rate_limits_columns) on
+the CPU backend (tunnel-free) and prices each stage:
+
+  parse     (native JSON -> columns; only in the HTTP twin)
+  route     validation + hash keys + ownership
+  plan      shard-bucket + C++ round planning
+  pack      padded array fill + wire pack
+  dispatch  device_put + jit call enqueue
+  readback  the blocking device->host transfer
+  decode    narrow decode + slot-table commit
+  render    result scatter (+ JSON render in the HTTP twin)
+
+Usage: python benchmarks/probe_host_stages.py [n_threads]
+"""
+
+import cProfile
+import io
+import os
+import pstats
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache_cpu")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import numpy as np
+
+from gubernator_tpu.service import IngressColumns, ServiceConfig, V1Service
+from gubernator_tpu.types import PeerInfo
+
+N_KEYS = 100_000
+BATCH = 1000
+ITERS = 30
+
+
+def svc_cols(tid, i):
+    ids = (np.arange(BATCH) * 2654435761 + tid * 97 + i) % N_KEYS
+    return IngressColumns(
+        names=["bench"] * BATCH,
+        unique_keys=[f"s{tid}:{k}" for k in ids],
+        algorithm=(ids % 2).astype(np.int32),
+        behavior=np.zeros(BATCH, np.int32),
+        hits=np.ones(BATCH, np.int64),
+        limit=np.full(BATCH, 1_000_000, np.int64),
+        duration=np.full(BATCH, 3_600_000, np.int64),
+    )
+
+
+def main():
+    n_threads = int(sys.argv[1]) if len(sys.argv) > 1 else 1
+    svc = V1Service(ServiceConfig(cache_size=131_072))
+    svc.set_peers([PeerInfo(grpc_address="127.0.0.1:1", is_owner=True)])
+    # Warm every pad bucket + jit
+    for i in range(3):
+        svc.get_rate_limits_columns(svc_cols(0, 1000 + i))
+
+    # Throughput without profiler
+    def worker(tid, iters):
+        for i in range(iters):
+            svc.get_rate_limits_columns(svc_cols(tid, i))
+
+    def epoch():
+        t0 = time.perf_counter()
+        if n_threads == 1:
+            worker(0, ITERS)
+        else:
+            ts = [
+                threading.Thread(target=worker, args=(t, ITERS))
+                for t in range(n_threads)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        return time.perf_counter() - t0
+
+    epoch()  # warm coalesced pad buckets (multi-thread merges hit new shapes)
+    dt = min(epoch() for _ in range(2))
+    cps = BATCH * ITERS * n_threads / dt
+    print(f"threads={n_threads} ingress={cps:,.0f} checks/s "
+          f"({dt/ITERS/n_threads*1e3:.2f} ms/batch serial-equiv)")
+
+    # Profile single-threaded
+    pr = cProfile.Profile()
+    pr.enable()
+    worker(1, ITERS)
+    pr.disable()
+    s = io.StringIO()
+    ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
+    ps.print_stats(35)
+    print(s.getvalue())
+    svc.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,211 @@
+"""Round-4 probe #1: bisect the apply_rounds32 cost stack.
+
+Round 3 left ~2-3ms of a 5-8ms 131k batch unattributed ("narrowing
+wrapper overhead").  This probe prices each layer of the kernel stack
+with the differential chained-K method (K batches inside ONE jit via
+fori_loop + optimization_barrier, two K values, divide the difference —
+tunnel RTT and fixed dispatch costs cancel):
+
+  A  apply_rounds32 (narrow wire, the production kernel)    full stack
+  B  apply_rounds   (wide 64-bit wire)                      A - B = narrowing
+  C  apply_batch    (single application, no while_loop)     B - C = rounds loop
+  D  apply_batch, scatter skipped (state passthrough)       C - D = hot scatter
+  E  pre-gather + delta packing alone (the narrow pieces)   direct price
+  F  rmw row scatter alone                                  scatter floor
+  G  apply_batch, leaky block fed constants (no division)   C - G = leak divs
+
+Each at capacity 262k and 2M (the cfg2 / cfg3 scales).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import gubernator_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from gubernator_tpu.ops import buckets
+
+B = 131_072
+K_LO, K_HI = 4, 20
+NOW = 1_700_000_000_000
+
+rng = np.random.RandomState(7)
+_ = np.asarray(jnp.zeros((1,), jnp.int32))  # honest mode
+
+
+def measure(name, make_fn, state, *args):
+    """Differential chained-K timing of fn(state, *args) -> (state, out)."""
+    ts = {}
+    for K in (K_LO, K_HI):
+        fn = make_fn(K)
+        st, out = fn(state, *args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+        best = float("inf")
+        for _ in range(4):
+            t0 = time.perf_counter()
+            st, out = fn(st, *args)
+            np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+            best = min(best, time.perf_counter() - t0)
+        ts[K] = best
+        del st, out
+    us = (ts[K_HI] - ts[K_LO]) / (K_HI - K_LO) * 1e6
+    print(f"{name:58s} {us:9.1f} us/batch", flush=True)
+    return us
+
+
+def chain(body):
+    """K-batch chain: body(state, i) -> (state, out)."""
+
+    def make(K):
+        @jax.jit
+        def run(state, *args):
+            def f(i, c):
+                st, _ = c
+                st, out = body(st, i, *args)
+                return jax.lax.optimization_barrier((st, out))
+
+            st0, out0 = body(state, jnp.asarray(0, jnp.int32), *args)
+            return jax.lax.fori_loop(1, K, f, (st0, out0))
+
+        return run
+
+    return make
+
+
+def mk_batch64(slot):
+    n = len(slot)
+    return buckets.make_batch(
+        slot,
+        np.ones(n, bool),
+        (slot % 2).astype(np.int32),
+        np.zeros(n, np.int32),
+        np.ones(n, np.int64),
+        np.full(n, 1 << 30, np.int64),
+        np.full(n, 3_600_000, np.int64),
+    )
+
+
+def mk_batch32(slot):
+    n = len(slot)
+    return buckets.make_batch32(
+        slot,
+        np.ones(n, bool),
+        (slot % 2).astype(np.int32),
+        np.zeros(n, np.int32),
+        np.ones(n, np.int32),
+        np.full(n, 1 << 30, np.int32),
+        np.full(n, 3_600_000, np.int32),
+    )
+
+
+def apply_batch_noscatter(state, req, now):
+    """apply_batch with the state commit cut out: same gathers + compute
+    + output packing, state rides through untouched."""
+    st, out = buckets.apply_batch(state, req, now, cold_cond=True)
+    del st
+    return state, buckets._pack_output(out)
+
+
+def main():
+    one = jnp.asarray(1, jnp.int32)
+
+    caps = [int(a) for a in sys.argv[1:]] or [262_144, 2_097_152]
+    for C in caps:
+        print(f"--- capacity {C} ---", flush=True)
+        slot = rng.permutation(C)[:B].astype(np.int32)
+        b64 = jax.device_put(mk_batch64(slot))
+        b32 = jax.device_put(mk_batch32(slot))
+        rid = jax.device_put(np.zeros(B, np.int32))
+
+        # Seed state: create all buckets once.
+        state = buckets.init_state(C)
+        create = jax.device_put(mk_batch64(slot)._replace(exists=jnp.zeros(B, bool)))
+        state, _p = buckets.apply_rounds_jit(state, create, rid, one, NOW)
+        np.asarray(_p[:1, :1])
+
+        now_dev = jnp.asarray(NOW, jnp.int64)
+
+        # A: production narrow kernel
+        def a_body(st, i, b, r):
+            return buckets.apply_rounds32(st, b, r, one, now_dev + i.astype(jnp.int64))
+
+        measure("A apply_rounds32 (narrow, rounds loop)", chain(a_body), state, b32, rid)
+
+        # B: wide kernel with rounds loop
+        def b_body(st, i, b, r):
+            return buckets.apply_rounds(st, b, r, one, now_dev + i.astype(jnp.int64))
+
+        measure("B apply_rounds (wide, rounds loop)", chain(b_body), state, b64, rid)
+
+        # C: single apply_batch, no while_loop
+        def c_body(st, i, b):
+            st, out = buckets.apply_batch(st, b, now_dev + i.astype(jnp.int64))
+            return st, buckets._pack_output(out)
+
+        measure("C apply_batch (wide, single, packed out)", chain(c_body), state, b64)
+
+        # D: apply_batch minus the scatter (compute only)
+        def d_body(st, i, b):
+            return apply_batch_noscatter(st, b, now_dev + i.astype(jnp.int64))
+
+        measure("D apply_batch compute only (no scatter)", chain(d_body), state, b64)
+
+        # E: the narrowing pieces alone: pre-gather + delta/select pack
+        def e_body(st, i, b):
+            si = jnp.clip(b.slot, 0, C - 1)
+            pre = st.hot[si]
+            pre_exp = buckets._compose64(pre[:, 5], pre[:, 6])
+            v = pre_exp + i.astype(jnp.int64)
+            now = now_dev + i.astype(jnp.int64)
+            hi = jnp.asarray((1 << 31) - 1, jnp.int64)
+            d = v - now
+            fits = (d >= 0) & (d <= hi)
+            out = jnp.where(
+                v == 0, -1,
+                jnp.where(fits, d, jnp.where(v == pre_exp, -2, jnp.clip(d, 0, hi))),
+            )
+            packed = jnp.stack((out, out, out, out)).astype(jnp.int32)
+            return st, packed
+
+        measure("E pre-gather + delta pack alone", chain(e_body), state, b32)
+
+        # F: row-scatter floor (gather rows, +1, scatter)
+        def f_body(st, i, ix):
+            g = st.hot[ix]
+            return st._replace(
+                hot=st.hot.at[ix].set(g + 1, mode="drop", unique_indices=True)
+            ), g[:1]
+
+        measure("F rmw hot-row scatter alone", chain(f_body), state, jnp.asarray(slot))
+
+        # G: apply_batch with the leaky divisions replaced by constants
+        orig = buckets._leak_amounts
+        try:
+            buckets._leak_amounts = lambda el, lim, rn: (
+                jnp.zeros_like(el), jnp.zeros_like(el)
+            )
+
+            def g_body(st, i, b):
+                st, out = buckets.apply_batch(st, b, now_dev + i.astype(jnp.int64))
+                return st, buckets._pack_output(out)
+
+            measure("G apply_batch, leak divisions stubbed", chain(g_body), state, b64)
+        finally:
+            buckets._leak_amounts = orig
+
+        # H: apply_batch with occ_rem divisions active but reset selects
+        # (sanity: G vs C isolates _leak_amounts only; the remaining divs
+        # are rate_num//lim, dur_eff//lim, //hs, rem//SCALE shifts)
+        del state, b64, b32, create
+
+
+if __name__ == "__main__":
+    main()

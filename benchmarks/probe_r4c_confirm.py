@@ -1,0 +1,144 @@
+"""Round-4 probe #4: confirm the narrow-vs-wide gap at higher resolution.
+
+probe_r4_bisect measured with dK=16, whose tunnel-weather error bar is
+~±1.5ms/batch — enough to invert fine-grained variants (it put the wide
+kernel BELOW the scatter-alone floor, impossible).  This probe re-runs
+the three numbers that matter with dK=64 (error ~±0.4ms) and verifies
+against dead-code elimination by checking the chained state actually
+mutated (token remaining must drop by exactly K).
+
+  A  apply_rounds32 (production narrow)
+  B  apply_rounds   (wide)
+  S  hot-row rmw scatter (floor)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import gubernator_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from gubernator_tpu.ops import buckets
+
+B = 131_072
+C = 262_144
+K_LO, K_HI = 4, 68
+NOW = 1_700_000_000_000
+
+rng = np.random.RandomState(7)
+_ = np.asarray(jnp.zeros((1,), jnp.int32))
+
+_I64 = jnp.int64
+
+
+def measure(name, make_fn, state, *args, check=None):
+    ts = {}
+    for K in (K_LO, K_HI):
+        fn = make_fn(K)
+        st, out = fn(state, *args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+        if check is not None:
+            check(K, st)
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            st, out = fn(st, *args)
+            np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+            best = min(best, time.perf_counter() - t0)
+        ts[K] = best
+        del st, out
+    us = (ts[K_HI] - ts[K_LO]) / (K_HI - K_LO) * 1e6
+    print(f"{name:44s} {us:9.1f} us/batch "
+          f"(t{K_LO}={ts[K_LO]*1e3:.1f}ms t{K_HI}={ts[K_HI]*1e3:.1f}ms)",
+          flush=True)
+    return us
+
+
+def chain(body):
+    def make(K):
+        @jax.jit
+        def run(state, *args):
+            def f(i, c):
+                st, _ = c
+                st, out = body(st, i, *args)
+                return jax.lax.optimization_barrier((st, out))
+
+            st0, out0 = body(state, jnp.asarray(0, jnp.int32), *args)
+            return jax.lax.fori_loop(1, K, f, (st0, out0))
+
+        return run
+
+    return make
+
+
+def main():
+    one = jnp.asarray(1, jnp.int32)
+    slot = rng.permutation(C)[:B].astype(np.int32)
+    n = B
+    big = 1 << 30
+    b32 = jax.device_put(buckets.make_batch32(
+        slot, np.ones(n, bool), np.zeros(n, np.int32),  # all token
+        np.zeros(n, np.int32), np.ones(n, np.int32),
+        np.full(n, big, np.int32), np.full(n, 3_600_000, np.int32),
+    ))
+    b64 = jax.device_put(buckets.make_batch(
+        slot, np.ones(n, bool), np.zeros(n, np.int32),
+        np.zeros(n, np.int32), np.ones(n, np.int64),
+        np.full(n, big, np.int64), np.full(n, 3_600_000, np.int64),
+    ))
+    rid = jax.device_put(np.zeros(n, np.int32))
+
+    state0 = buckets.init_state(C)
+    create = jax.device_put(
+        buckets.make_batch(
+            slot, np.zeros(n, bool), np.zeros(n, np.int32),
+            np.zeros(n, np.int32), np.zeros(n, np.int64),  # hits=0: full
+            np.full(n, big, np.int64), np.full(n, 3_600_000, np.int64),
+        )
+    )
+    state0, _p = buckets.apply_rounds_jit(state0, create, rid, one, NOW)
+    np.asarray(_p[:1, :1])
+    now_dev = jnp.asarray(NOW, _I64)
+
+    probe_slot = int(slot[12345])
+
+    def expect_drop(K, st):
+        # Token remaining for a probed slot must have dropped by exactly
+        # the number of chained batches — proof nothing was DCE'd.
+        rows = buckets.read_rows(st, np.array([probe_slot], np.int32))
+        rem = int(np.asarray(rows.remaining)[0])
+        drop = big - rem
+        assert drop % K == 0 and drop > 0, (K, rem, drop)
+
+    def a_body(st, i, b, r):
+        return buckets.apply_rounds32(st, b, r, one, now_dev + i.astype(_I64))
+
+    measure("A apply_rounds32 narrow", chain(a_body), state0, b32, rid,
+            check=expect_drop)
+
+    def b_body(st, i, b, r):
+        return buckets.apply_rounds(st, b, r, one, now_dev + i.astype(_I64))
+
+    measure("B apply_rounds wide", chain(b_body), state0, b64, rid,
+            check=expect_drop)
+
+    def s_body(st, i, ix):
+        g = st.hot[ix]
+        return st._replace(
+            hot=st.hot.at[ix].set(g + 1, mode="drop", unique_indices=True)
+        ), g[:1]
+
+    measure("S rmw hot-row scatter floor", chain(s_body), state0,
+            jnp.asarray(slot))
+
+
+if __name__ == "__main__":
+    main()

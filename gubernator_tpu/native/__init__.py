@@ -138,6 +138,31 @@ def _build() -> Optional[ctypes.CDLL]:
     ]
     lib.gt_batch_commit_plan.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
     lib.gt_batch_free.argtypes = [c.c_void_p]
+    lib.gt_mesh_begin.restype = c.c_void_p
+    lib.gt_mesh_begin.argtypes = [
+        c.c_void_p, c.c_int64,  # tables[S], S
+        c.c_void_p, c.c_void_p, c.c_int64, c.c_int64,  # keys, offsets, n, now
+        c.c_void_p,  # counts[S] out
+    ]
+    lib.gt_mesh_plan_grouped.restype = c.c_int64
+    lib.gt_mesh_plan_grouped.argtypes = [
+        c.c_void_p,  # mesh plan
+        c.c_void_p, c.c_void_p,  # algo, behavior
+        c.c_void_p, c.c_void_p, c.c_void_p,  # hits, limit, duration
+        c.c_void_p, c.c_void_p,  # greg_expire, greg_duration
+        c.c_int32, c.c_int64,  # reset mask, P
+        c.c_void_p, c.c_void_p, c.c_void_p,  # slot, rid, exists
+        c.c_void_p, c.c_void_p, c.c_void_p,  # occ, write, pos
+    ]
+    lib.gt_mesh_finish_narrow.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_int64,
+        c.c_void_p, c.c_void_p, c.c_void_p,
+    ]
+    lib.gt_mesh_finish_wide.argtypes = [
+        c.c_void_p, c.c_void_p,
+        c.c_void_p, c.c_void_p, c.c_void_p,
+    ]
+    lib.gt_mesh_free.argtypes = [c.c_void_p]
     lib.gt_fnv1_batch.argtypes = [c.c_void_p, c.c_void_p, c.c_int64, c.c_int32, c.c_void_p]
     lib.gt_json_parse.restype = c.c_void_p
     lib.gt_json_parse.argtypes = [c.c_char_p, c.c_int64]
@@ -566,3 +591,92 @@ class NativeBatchPlanner:
         expire = np.ascontiguousarray(new_expire_ms, dtype=np.int64)
         rm = np.ascontiguousarray(removed, dtype=np.uint8)
         self._lib.gt_batch_commit_plan(self._ptr, expire.ctypes.data, rm.ctypes.data)
+
+
+class NativeMeshPlanner:
+    """Whole-mesh columnar planning in single C++ calls: shard-bucket
+    (fnv1a % S), per-shard grouped round planning into padded [S, P]
+    arrays, and post-dispatch decode + slot-table commit + original-
+    order response scatter (gt_mesh_*).  Replaces the round-3 Python
+    loop over shards in parallel/mesh.py::_dispatch_columns.
+
+    Lifecycle (all calls under the owning store's lock):
+        mp = NativeMeshPlanner(tables, keys, now_ms)   # begin: counts
+        plan = mp.plan_grouped(cols, reset_mask)       # padded arrays
+        ... device dispatch ...
+        status, remaining, reset = mp.finish_narrow(packed_np, now_ms)
+    """
+
+    __slots__ = ("_lib", "_tables", "_ptr", "n", "counts", "padded",
+                 "pos", "slot", "rid", "exists", "occ", "write",
+                 "_keepalive")
+
+    def __init__(self, tables, keys, now_ms: int):
+        self._lib = tables[0]._lib
+        self._tables = tables  # keep tables (and their C ptrs) alive
+        S = len(tables)
+        buf, offsets = as_packed(keys)
+        self.n = len(offsets) - 1
+        self.counts = np.zeros(S, dtype=np.int64)
+        ptrs = (ctypes.c_void_p * S)(*[t._ptr for t in tables])
+        self._keepalive = (buf, offsets, ptrs)
+        self._ptr = self._lib.gt_mesh_begin(
+            ptrs, S, buf.ctypes.data if self.n else None,
+            offsets.ctypes.data, self.n, now_ms, self.counts.ctypes.data,
+        )
+
+    def __del__(self):
+        ptr = getattr(self, "_ptr", None)
+        if ptr:
+            self._lib.gt_mesh_free(ptr)
+            self._ptr = None
+
+    def plan_grouped(self, cols, reset_mask: int, padded: int):
+        """Plan every shard into padded [S, P] row-major arrays; returns
+        n_rounds.  Padding lanes keep slot=-1 / zeros."""
+        S = len(self.counts)
+        self.padded = padded
+        self.slot = np.full((S, padded), -1, dtype=np.int32)
+        self.rid = np.zeros((S, padded), dtype=np.int32)
+        self.exists = np.zeros((S, padded), dtype=np.uint8)
+        self.occ = np.zeros((S, padded), dtype=np.int32)
+        self.write = np.zeros((S, padded), dtype=np.uint8)
+        self.pos = np.zeros(max(self.n, 1), dtype=np.int64)
+        n_rounds = self._lib.gt_mesh_plan_grouped(
+            self._ptr,
+            cols.algo.ctypes.data, cols.behavior.ctypes.data,
+            cols.hits.ctypes.data, cols.limit.ctypes.data,
+            cols.duration.ctypes.data,
+            cols.greg_expire.ctypes.data, cols.greg_duration.ctypes.data,
+            reset_mask, padded,
+            self.slot.ctypes.data, self.rid.ctypes.data,
+            self.exists.ctypes.data, self.occ.ctypes.data,
+            self.write.ctypes.data, self.pos.ctypes.data,
+        )
+        return int(n_rounds)
+
+    def finish_narrow(self, packed_np, now_ms: int):
+        """Decode + commit a narrow i32[S, 4, P] result; returns
+        (status i32[n], remaining i64[n], reset_time i64[n]) in
+        ORIGINAL lane order."""
+        packed_np = np.ascontiguousarray(packed_np, dtype=np.int32)
+        status = np.empty(max(self.n, 1), dtype=np.int32)
+        remaining = np.empty(max(self.n, 1), dtype=np.int64)
+        reset = np.empty(max(self.n, 1), dtype=np.int64)
+        self._lib.gt_mesh_finish_narrow(
+            self._ptr, packed_np.ctypes.data, now_ms,
+            status.ctypes.data, remaining.ctypes.data, reset.ctypes.data,
+        )
+        return status[: self.n], remaining[: self.n], reset[: self.n]
+
+    def finish_wide(self, packed_np):
+        """Decode + commit a wide i64[S, 4, P] result (absolute values)."""
+        packed_np = np.ascontiguousarray(packed_np, dtype=np.int64)
+        status = np.empty(max(self.n, 1), dtype=np.int32)
+        remaining = np.empty(max(self.n, 1), dtype=np.int64)
+        reset = np.empty(max(self.n, 1), dtype=np.int64)
+        self._lib.gt_mesh_finish_wide(
+            self._ptr, packed_np.ctypes.data,
+            status.ctypes.data, remaining.ctypes.data, reset.ctypes.data,
+        )
+        return status[: self.n], remaining[: self.n], reset[: self.n]

@@ -301,6 +301,11 @@ class BatchOutput(NamedTuple):
     reset_time: jax.Array  # i64[B]
     new_expire: jax.Array  # i64[B]  slot expire_at after this request
     removed: jax.Array  # bool[B] token RESET_REMAINING freed the slot
+    # The slot's stored expiry as this lane's round GATHERED it (free:
+    # the kernel reads it anyway).  The narrow wire's -2 keep-sentinel
+    # detector; replaces a separate whole-batch pre-gather that round 4
+    # measured at ~1ms/131k batch on TPU (probe_r4b_narrow).
+    pre_expire: jax.Array  # i64[B]
 
 
 def init_state(capacity: int) -> BucketState:
@@ -603,6 +608,7 @@ def apply_batch(
         reset_time=jnp.where(valid, resp_reset, z64),
         new_expire=jnp.where(valid, n_exp, z64),
         removed=removed,
+        pre_expire=jnp.where(valid, g_exp, z64),
     )
     return new_state, out
 
@@ -610,15 +616,20 @@ def apply_batch(
 apply_batch_jit = jax.jit(apply_batch, donate_argnums=0)
 
 
-def _pack_output(out: BatchOutput) -> jax.Array:
+def _pack_output(out: BatchOutput, with_pre: bool = False) -> jax.Array:
     """Fuse the per-lane outputs into ONE i64[4, B] array so the host
     pays a single device->host transfer per batch instead of five (each
     blocking readback is a full RTT — the dominant cost when the device
     sits behind a network tunnel).  Row 0 packs status (bit 0) and
     removed (bit 1); rows 1-3 are remaining / reset_time / new_expire.
-    `limit` is an echo of the request and never leaves the device."""
+    `limit` is an echo of the request and never leaves the device.
+    `with_pre` appends pre_expire as row 4 (narrow-wire sentinel input,
+    consumed on device — it never reaches the host wire)."""
     row0 = out.status.astype(_I64) | (out.removed.astype(_I64) << 1)
-    return jnp.stack((row0, out.remaining, out.reset_time, out.new_expire))
+    rows = (row0, out.remaining, out.reset_time, out.new_expire)
+    if with_pre:
+        rows = rows + (out.pre_expire,)
+    return jnp.stack(rows)
 
 
 def unpack_output(packed):
@@ -651,8 +662,18 @@ def apply_rounds(
     Returns (new_state, packed_output i64[4, B]); decode with
     unpack_output.
     """
+    return _apply_rounds_impl(
+        state, req, round_id, n_rounds, now_ms, cold_cond, with_pre=False
+    )
+
+
+def _apply_rounds_impl(
+    state, req, round_id, n_rounds, now_ms, cold_cond, with_pre
+):
+    """Shared rounds loop; with_pre=True carries pre_expire as row 4
+    (the narrow wire's on-device sentinel input)."""
     B = req.slot.shape[0]
-    packed0 = jnp.zeros((4, B), _I64)
+    packed0 = jnp.zeros((5 if with_pre else 4, B), _I64)
 
     def cond(c):
         return c[0] < n_rounds
@@ -662,7 +683,9 @@ def apply_rounds(
         active = round_id == r
         req_r = req._replace(slot=jnp.where(active, req.slot, -1))
         st, out = apply_batch(st, req_r, now_ms, cold_cond=cold_cond)
-        packed = jnp.where(active[None, :], _pack_output(out), packed)
+        packed = jnp.where(
+            active[None, :], _pack_output(out, with_pre=with_pre), packed
+        )
         return r + 1, st, packed
 
     _, state, packed = jax.lax.while_loop(
@@ -748,18 +771,17 @@ def apply_rounds32(
         occ=req32.occ,
         write=req32.write,
     )
-    # Pre-batch expiry per lane, read BEFORE the rounds mutate state:
-    # the pass-through detector for the -2 sentinel.  ROW gather, not
-    # two scalar-column gathers — XLA lowers `hot[si, k]` per element
-    # (~ms at 131k lanes) but `hot[si]` as one vectorized row gather.
-    C = state.hot.shape[0]
-    si = jnp.clip(req32.slot, 0, C - 1)
-    pre = state.hot[si]
-    pre_exp = _compose64(pre[:, _H_EXP_LO], pre[:, _H_EXP_HI])
-
-    state, packed64 = apply_rounds(
-        state, req, round_id, n_rounds, now_ms, cold_cond=cold_cond
+    # The -2 pass-through detector rides the packed output as row 4:
+    # each lane's stored expiry as its OWN round gathered it.  (Round 4
+    # replaced a separate whole-batch pre-gather measured at ~1ms per
+    # 131k batch; the per-round value is equivalent for the sentinel
+    # because -2 fires only for values unrepresentable on this wire,
+    # which no round of a narrow batch can have WRITTEN — any such
+    # value predates the batch, so pre-round == pre-batch.)
+    state, packed64 = _apply_rounds_impl(
+        state, req, round_id, n_rounds, now_ms, cold_cond, with_pre=True
     )
+    pre_exp = packed64[4]
     hi = jnp.asarray((1 << 31) - 1, _I64)
 
     def delta(v):

@@ -191,6 +191,7 @@ def answer_batch(
         reset_time=reset_time,
         new_expire=out.new_expire,
         removed=out.removed,
+        pre_expire=out.pre_expire,
     )
     return new_state, new_gcols, out, cached
 

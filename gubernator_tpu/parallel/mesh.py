@@ -496,62 +496,27 @@ class MeshBucketStore(ColumnarPipeline):
                           force_wire: Optional[str] = None):
         """Shard-bucket + plan + enqueue one columnar batch without
         blocking; returns the resolve() closure (caller holds the store
-        lock for this dispatch phase, ColumnarPipeline discipline)."""
+        lock for this dispatch phase, ColumnarPipeline discipline).
+
+        The whole host side runs in TWO C++ calls (gt_mesh_begin +
+        gt_mesh_plan_grouped: hash/bucket every key, per-shard grouped
+        round planning, padded [S, P] fill) plus vectorized numpy for
+        the value/cfg columns via the lane->padded-position map; the
+        commit side is ONE C++ call (gt_mesh_finish_*: decode,
+        slot-table commit, original-order scatter).  Round 3 ran this
+        as a serial Python loop over shards — the reference serves its
+        whole edge in compiled code (gubernator.go:116-227)."""
         from .. import native as _native
 
         S = self.n_shards
         n = len(keys)
-        if S == 1:
-            order = None
-            shard_keys = [keys]  # planner accepts lists and PackedKeys
-            shard_cols = [cols]
-            counts = np.array([n])
-            bounds = np.array([0, n], dtype=np.int64)
-        else:
-            sidx = (
-                _native.fnv1_batch(keys, variant_1a=True) % np.uint64(S)
-            ).astype(np.int64)
-            order = np.argsort(sidx, kind="stable")
-            counts = np.bincount(sidx, minlength=S)
-            bounds = np.zeros(S + 1, dtype=np.int64)
-            np.cumsum(counts, out=bounds[1:])
-            if isinstance(keys, _native.PackedKeys):
-                sorted_keys = keys.subset(order)
-                shard_keys = [
-                    sorted_keys.subset(np.arange(bounds[s], bounds[s + 1]))
-                    for s in range(S)
-                ]
-            else:
-                sorted_keys = [keys[i] for i in order]
-                shard_keys = [sorted_keys[bounds[s]:bounds[s + 1]] for s in range(S)]
-            shard_cols = []
-            for s in range(S):
-                sl = order[bounds[s]:bounds[s + 1]]
-                shard_cols.append(make_columns(
-                    cols.algo[sl], cols.behavior[sl], cols.hits[sl],
-                    cols.limit[sl], cols.duration[sl], len(sl),
-                    cols.greg_expire[sl], cols.greg_duration[sl],
-                ))
+        mp = _native.NativeMeshPlanner(self.tables, keys, now_ms)
+        padded = pad_size(max(int(mp.counts.max()) if n else 1, 1))
+        n_rounds = mp.plan_grouped(
+            cols, int(Behavior.RESET_REMAINING), padded
+        )
+        pos = mp.pos[:n]
 
-        planners: List[object] = [None] * S
-        plans: List[object] = [None] * S
-        n_rounds = 1
-        maxb = 1
-        reset_mask = int(Behavior.RESET_REMAINING)
-        for s in range(S):
-            m = int(counts[s])
-            if m == 0:
-                continue
-            pl = _native.NativeBatchPlanner(self.tables[s], shard_keys[s], now_ms)
-            rid, slots, exists, occ, write, nr = pl.plan_grouped(
-                shard_cols[s], reset_mask
-            )
-            planners[s] = pl
-            plans[s] = (rid, slots, exists, occ, write)
-            n_rounds = max(n_rounds, nr)
-            maxb = max(maxb, m)
-
-        padded = pad_size(maxb)
         narrow = narrow_ok(cols, now_ms) and force_wire != "wide"
         dict_enc = None
         if force_wire is None and n_rounds <= 255:
@@ -559,58 +524,16 @@ class MeshBucketStore(ColumnarPipeline):
             # batches (monthly/yearly Gregorian) stay on it too — only
             # the output width switches (apply_rounds_packed_wide).
             dict_enc = buckets.build_config_dict(cols, now_ms)
-        cfg_sorted = None
-        if dict_enc is not None:
-            cfg_full, cfg_table = dict_enc
-            cfg_sorted = cfg_full if order is None else cfg_full[order]
-            cfg_a = np.zeros((S, padded), dtype=np.uint8)
-        slot_a = np.full((S, padded), -1, dtype=np.int32)
-        rid_a = np.zeros((S, padded), dtype=np.int32)
-        ex_a = np.zeros((S, padded), dtype=bool)
-        occ_a = np.zeros((S, padded), dtype=np.int32)
-        wr_a = np.zeros((S, padded), dtype=bool)
-        vdt = np.int32 if narrow else np.int64
-        algo_a = np.zeros((S, padded), dtype=np.int32)
-        beh_a = np.zeros((S, padded), dtype=np.int32)
-        hits_a = np.zeros((S, padded), dtype=vdt)
-        lim_a = np.zeros((S, padded), dtype=vdt)
-        dur_a = np.zeros((S, padded), dtype=vdt)
-        ge_a = np.zeros((S, padded), dtype=vdt)
-        gd_a = np.zeros((S, padded), dtype=vdt)
-        passthrough = [None] * S
-        for s in range(S):
-            m = int(counts[s])
-            if m == 0:
-                continue
-            rid, slots, exists, occ, write = plans[s]
-            c = shard_cols[s]
-            if cfg_sorted is not None:
-                cfg_a[s, :m] = cfg_sorted[bounds[s]:bounds[s + 1]]
-            slot_a[s, :m] = slots
-            rid_a[s, :m] = rid
-            ex_a[s, :m] = exists
-            occ_a[s, :m] = occ
-            wr_a[s, :m] = write
-            algo_a[s, :m] = c.algo
-            beh_a[s, :m] = c.behavior
-            hits_a[s, :m] = c.hits
-            lim_a[s, :m] = c.limit
-            dur_a[s, :m] = c.duration
-            if narrow:
-                ge_a[s, :m] = np.where(
-                    c.greg_duration != 0, c.greg_expire - now_ms, 0
-                )
-                passthrough[s] = self.tables[s].get_expire_bulk(slots)
-            else:
-                ge_a[s, :m] = c.greg_expire
-            gd_a[s, :m] = c.greg_duration
 
-        if cfg_sorted is not None and int(occ_a.max(initial=0)) <= 65535:
+        if dict_enc is not None and int(mp.occ.max()) <= 65535:
+            cfg_full, cfg_table = dict_enc
+            cfg_a = np.zeros((S, padded), dtype=np.uint8)
+            cfg_a.reshape(-1)[pos] = cfg_full
             # Single-buffer wire: ONE sharded host->device transfer per
             # batch instead of 12 (per-call overhead dominates at
             # service batch sizes).
             wire = buckets.pack_dict_wire(
-                slot_a, ex_a, wr_a, cfg_a, occ_a, rid_a, cfg_table
+                mp.slot, mp.exists, mp.write, cfg_a, mp.occ, mp.rid, cfg_table
             )
             wire_dev = jax.device_put(wire, self._sharding)
             fn_packed = (
@@ -620,13 +543,29 @@ class MeshBucketStore(ColumnarPipeline):
                 self.state, wire_dev, n_rounds, now_ms
             )
         else:
+            vdt = np.int32 if narrow else np.int64
+
+            def scatter(col, dtype):
+                a = np.zeros((S, padded), dtype=dtype)
+                a.reshape(-1)[pos] = col
+                return a
+
+            if narrow:
+                ge = np.where(
+                    cols.greg_duration != 0, cols.greg_expire - now_ms, 0
+                )
+            else:
+                ge = cols.greg_expire
             mk = buckets.make_batch32 if narrow else buckets.make_batch
             batch = mk(
-                slot_a, ex_a, algo_a, beh_a, hits_a, lim_a, dur_a, ge_a, gd_a,
-                occ=occ_a, write=wr_a,
+                mp.slot, mp.exists.astype(bool), scatter(cols.algo, np.int32),
+                scatter(cols.behavior, np.int32), scatter(cols.hits, vdt),
+                scatter(cols.limit, vdt), scatter(cols.duration, vdt),
+                scatter(ge, vdt), scatter(cols.greg_duration, vdt),
+                occ=mp.occ, write=mp.write.astype(bool),
             )
             batch = jax.tree.map(lambda a: jax.device_put(a, self._sharding), batch)
-            rid_dev = jax.device_put(jnp.asarray(rid_a), self._sharding)
+            rid_dev = jax.device_put(jnp.asarray(mp.rid), self._sharding)
             fn = _rounds32_mesh_jit if narrow else _rounds64_mesh_jit
             self.state, packed = fn(self.state, batch, rid_dev, n_rounds, now_ms)
 
@@ -636,38 +575,19 @@ class MeshBucketStore(ColumnarPipeline):
             return np.asarray(packed)  # [S, 4, padded]
 
         def commit(packed_np):
-            status_f = np.empty(n, dtype=np.int32)
-            rem_f = np.empty(n, dtype=np.int64)
-            reset_f = np.empty(n, dtype=np.int64)
             with self._lock:
-                pos = 0
-                for s in range(S):
-                    m = int(counts[s])
-                    if m == 0:
-                        continue
-                    _, slots, _, _, _ = plans[s]
-                    pn = packed_np[s][:, :m]
-                    if narrow:
-                        st, rm, remaining, reset, new_exp = decode_narrow(
-                            self.tables[s], shard_keys[s], slots, pn, now_ms,
-                            passthrough[s],
-                        )
-                    else:
-                        st, rm, remaining, reset, new_exp = buckets.unpack_output(pn)
-                    planners[s].commit_plan(new_exp, rm)
-                    self.algo_mirror[s][slots] = shard_cols[s].algo
-                    status_f[pos:pos + m] = st
-                    rem_f[pos:pos + m] = remaining
-                    reset_f[pos:pos + m] = reset
-                    pos += m
-            if order is None:
-                return status_f, rem_f, reset_f
-            status = np.empty(n, dtype=np.int32)
-            rem = np.empty(n, dtype=np.int64)
-            reset = np.empty(n, dtype=np.int64)
-            status[order] = status_f
-            rem[order] = rem_f
-            reset[order] = reset_f
+                if narrow:
+                    status, rem, reset = mp.finish_narrow(packed_np, now_ms)
+                else:
+                    status, rem, reset = mp.finish_wide(packed_np)
+                if n:
+                    # Host algo mirror (Store-SPI bookkeeping parity).
+                    lane_slot = mp.slot.reshape(-1)[pos]
+                    lane_shard = pos // padded
+                    for s in range(S):
+                        sel = lane_shard == s
+                        if sel.any():
+                            self.algo_mirror[s][lane_slot[sel]] = cols.algo[sel]
             return status, rem, reset
 
         return fetch, commit
@@ -883,7 +803,25 @@ class MeshBucketStore(ColumnarPipeline):
         The SyncResult carries what the HOST tier must fan out over the
         peer transport: authoritative statuses for keys this daemon owns
         (UpdatePeerGlobals broadcast) and aggregated hit totals for keys
-        owned by remote daemons (GetPeerRateLimits forward)."""
+        owned by remote daemons (GetPeerRateLimits forward).
+
+        Sets `last_sync_cost_s` to the time spent INSIDE the lock
+        (collective dispatch + readback + decode/commit) — the real
+        recurring cost of a sync pass.  The GlobalManager's window
+        tuner reads this instead of its own wall clock: the
+        drain-then-lock wait ahead of it is serving-pipeline
+        backpressure, and folding that into the window would inflate
+        GlobalSyncWait ~10x under load (observed on the contended CPU
+        host: wall-time syncs pinned the auto window at its 1s cap)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            return self._sync_globals_locked(now_ms)
+        finally:
+            self.last_sync_cost_s = _time.perf_counter() - t0
+
+    def _sync_globals_locked(self, now_ms: int) -> "SyncResult":
         active = self.gtable.active_gslots()
         if not active and not self.dirty.any():
             return SyncResult()
@@ -995,15 +933,43 @@ class MeshBucketStore(ColumnarPipeline):
         Do NOT call on a store serving GLOBAL traffic: the timed raw
         syncs drain device-side hit accumulations without the
         host-side commit/broadcast legs (the serving tuner instead
-        times its real sync passes in situ, service.GlobalManager)."""
+        times its real sync passes in situ, service.GlobalManager).
+        Refuses (RuntimeError) if the store already tracks GLOBAL keys
+        beyond its own calibration key — losing their accumulated hits
+        would silently corrupt live traffic.  The authoritative check
+        runs under the store lock (after the pipeline drain) so a key
+        registered by a racing serving thread cannot slip past it."""
+
         req = RateLimitRequest(
             name="__synccal__", unique_key="__synccal__", hits=1,
             limit=1_000_000, duration=60_000, behavior=Behavior.GLOBAL,
         )
+        cal_key = req.hash_key()
+
+        def _guard():
+            live = [
+                k
+                for k in (
+                    self.gtable.key_of(g) for g in self.gtable.active_gslots()
+                )
+                if k is not None and k != cal_key
+            ]
+            if live:
+                raise RuntimeError(
+                    "measure_sync_cost_s would drain device-side GLOBAL hit "
+                    "accumulations without the host commit/broadcast legs; "
+                    f"refusing with {len(live)} live GLOBAL key(s), e.g. {live[:3]}"
+                )
+
+        _guard()  # fast fail before any device work
         self.apply([req], now_ms)
-        self.sync_globals(now_ms)  # resolve owner slots + compile
         self._drain_then_lock()
         try:
+            _guard()  # authoritative: under the lock, pipeline drained
+            # Resolve owner slots + compile the collective, under the
+            # same lock (only the calibration key can exist here, so
+            # discarding the SyncResult's host legs loses nothing).
+            self._sync_globals_locked(now_ms)
             import time as _time
 
             cfg = global_ops.SyncConfig(

@@ -198,7 +198,16 @@ def test_measure_sync_cost_and_autotune():
         assert mgr._interval.duration_s == pytest.approx(expected)
         # still AUTO: the window keeps adapting as sync cost changes
         assert mgr._auto
-        mgr._observe_sync_cost(10.0)  # clamped at the max
+        # The estimator is min-of-recent (best-of-N): ONE contaminated
+        # outlier must NOT move the window (round 4: a single ~300ms
+        # startup sample had locked the EMA at the 1s clamp)...
+        before = mgr.sync_wait_s
+        mgr._observe_sync_cost(10.0)
+        assert mgr.sync_wait_s == pytest.approx(before)
+        # ...but a SUSTAINED cost rise lifts every sample in the deque
+        # and the window follows, clamped at the max.
+        for _ in range(GlobalManager.SYNC_COST_SAMPLES):
+            mgr._observe_sync_cost(10.0)
         assert mgr.sync_wait_s == GlobalManager.SYNC_WAIT_MAX_S
     finally:
         svc.close()

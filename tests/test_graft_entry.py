@@ -43,4 +43,4 @@ def test_dryrun_multichip_self_provisions_subprocess():
         timeout=300,
     )
     assert proc.returncode == 0, proc.stderr
-    assert "sharded apply + GLOBAL sync collectives OK" in proc.stdout
+    assert "columnar dict-wire + GLOBAL sync collectives OK" in proc.stdout

@@ -8,6 +8,7 @@ peer-forwarded call is verified by scraping the owner's /metrics for
 the peer data-plane request count (tls_test.go:206-260).
 """
 
+import os
 import shutil
 import ssl
 
@@ -25,22 +26,31 @@ from gubernator_tpu.types import (
     SECOND,
 )
 
-pytestmark = pytest.mark.skipif(
+# Checked-in long-lived test certs (certs/, reference parity with the
+# reference repo's certs/ + cli-tls.conf) so the file-cert paths run
+# without the openssl binary; only AutoTLS (which self-signs at
+# runtime) still needs it.
+_CERT_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "certs")
+
+needs_openssl = pytest.mark.skipif(
     shutil.which("openssl") is None, reason="openssl binary required"
 )
 
 
 @pytest.fixture(scope="module")
-def certs(tmp_path_factory):
-    d = str(tmp_path_factory.mktemp("certs"))
-    ca_crt, ca_key = tlsmod.self_ca(d)
-    srv_crt, srv_key = tlsmod.self_cert(d, ca_crt, ca_key, "server")
-    cli_crt, cli_key = tlsmod.self_cert(d, ca_crt, ca_key, "client", client=True)
-    return {
-        "ca": ca_crt, "ca_key": ca_key,
-        "crt": srv_crt, "key": srv_key,
-        "cli_crt": cli_crt, "cli_key": cli_key,
+def certs():
+    d = _CERT_DIR
+    fixture = {
+        "ca": os.path.join(d, "ca.pem"),
+        "ca_key": os.path.join(d, "ca.key"),
+        "crt": os.path.join(d, "gubernator.pem"),
+        "key": os.path.join(d, "gubernator.key"),
+        "cli_crt": os.path.join(d, "client-auth.pem"),
+        "cli_key": os.path.join(d, "client-auth.key"),
     }
+    missing = [p for p in fixture.values() if not os.path.exists(p)]
+    assert not missing, f"committed cert fixtures missing: {missing}"
+    return fixture
 
 
 def spawn(tls_conf, dc=""):
@@ -80,6 +90,7 @@ def test_server_tls_with_file_certs(certs):
         d.close()
 
 
+@needs_openssl
 def test_auto_tls(certs):
     """tls_test.go:57-76: no cert files at all; AutoTLS self-signs."""
     d = spawn(tlsmod.TLSConfig(auto_tls=True))
@@ -163,3 +174,13 @@ def test_tls_env_config(certs):
     assert conf.tls is not None
     assert conf.tls.client_auth == "require-and-verify"
     assert setup_daemon_config(env={}).tls is None
+
+
+def test_cli_tls_conf_fixture_parses():
+    """The checked-in cli-tls.conf (reference cli-tls.conf:1-6 twin)
+    must wire the committed certs/ fixtures into a TLS DaemonConfig."""
+    root = os.path.dirname(_CERT_DIR)
+    conf = setup_daemon_config(config_file=os.path.join(root, "cli-tls.conf"), env={})
+    assert conf.tls is not None
+    assert conf.tls.ca_file.endswith("certs/ca.pem")
+    assert conf.tls.cert_file.endswith("certs/gubernator.pem")
