@@ -211,6 +211,121 @@ def measure_device(jax, now, samples: int = 5):
     }
 
 
+def measure_device_zipf(jax, now, samples: int = 5):
+    """Device cost of the PRODUCTION-SHAPED Zipf batch at 2M total
+    capacity (two-tier table: 262,144-slot front + 1,835,008-slot back
+    resident in HBM).
+
+    The synthetic rows in measure_device scatter all 131,072 lanes into
+    unique slots; real Zipf traffic repeats keys, and the grouped
+    planner (gt_batch_plan_grouped) collapses each uniform duplicate
+    group to ONE scattering lane — so the production dispatch writes
+    only ~unique-key rows.  This row measures exactly what
+    apply_columns dispatches for the headline workload: the C++
+    planner's actual plan (slots/rounds/occ/write) for the Zipf batch,
+    chained K batches in-jit (same differential method).  The front
+    table prices the scatter; the back tier holds the capacity (zero
+    moves in steady state — the working set is front-resident, which
+    is the design's whole point; churn costs ride the amortized move
+    program, exercised by bench_full cfg3)."""
+    import jax.numpy as jnp
+
+    from gubernator_tpu import native
+    from gubernator_tpu.models.shard import make_columns
+    from gubernator_tpu.ops import buckets
+
+    front_cap, back_cap = 262_144, 2_097_152 - 262_144
+    batch = 131_072
+    rng = np.random.RandomState(42)
+    n_keys = 100_000
+    hot = rng.randint(0, n_keys // 10, size=batch)
+    cold = rng.randint(0, n_keys, size=batch)
+    key_ids = np.where(rng.random(batch) < 0.8, hot, cold)
+    keys = [f"bench_account:{k}" for k in key_ids]
+    cols = make_columns(
+        (key_ids % 2).astype(np.int32), np.zeros(batch, np.int32),
+        np.ones(batch, np.int64), np.full(batch, 1 << 30, np.int64),
+        np.full(batch, 3_600_000, np.int64), batch,
+    )
+
+    table = native.NativeSlotTable(front_cap)
+    table.enable_back(back_cap)
+    pl = native.NativeBatchPlanner(table, keys, now)
+    from gubernator_tpu.types import Behavior
+
+    rid, slots, exists, occ, write, n_rounds = pl.plan_grouped(
+        cols, int(Behavior.RESET_REMAINING)
+    )
+    write_frac = float(write.mean())
+
+    state = buckets.init_state(front_cap)
+    back = buckets.init_back(back_cap)  # resident: the capacity is real
+    back = jax.device_put(back)
+    mk = lambda ex: jax.device_put(  # noqa: E731
+        buckets.make_batch32(
+            slots, ex, cols.algo.astype(np.int32),
+            np.zeros(batch, np.int32), np.ones(batch, np.int32),
+            np.full(batch, 1 << 30, np.int32),
+            np.full(batch, 3_600_000, np.int32),
+            occ=occ, write=write,
+        )
+    )
+    rid_dev = jax.device_put(rid)
+    nr = jax.device_put(np.int32(n_rounds))
+    now_dev = jax.device_put(np.int64(now))
+
+    def sync(arr):
+        return np.asarray(arr[0, :1])
+
+    state, packed = buckets.apply_rounds32_jit(
+        state, mk(exists), rid_dev, nr, now_dev
+    )
+    sync(packed)
+    steady = mk(np.ones(batch, bool))
+
+    def _chain(K):
+        @jax.jit
+        def run(st, req, rid_a):
+            B = req.slot.shape[0]
+
+            def f(i, c):
+                st, _ = c
+                st, packed = buckets.apply_rounds32(
+                    st, req, rid_a, nr, now_dev + i.astype(jnp.int64)
+                )
+                return jax.lax.optimization_barrier((st, packed))
+
+            st, packed = jax.lax.fori_loop(
+                0, K, f, (st, jnp.zeros((4, B), jnp.int32))
+            )
+            return st, packed
+
+        return run
+
+    k_lo, k_hi = 4, 20
+    chain_t = {}
+    for K in (k_lo, k_hi):
+        fn = _chain(K)
+        st2, pk = fn(state, steady, rid_dev)
+        sync(pk)
+        best = float("inf")
+        for _ in range(samples):
+            t0 = time.perf_counter()
+            st2, pk = fn(st2, steady, rid_dev)
+            sync(pk)
+            best = min(best, time.perf_counter() - t0)
+        chain_t[K] = best
+    del back
+    us = (chain_t[k_hi] - chain_t[k_lo]) / (k_hi - k_lo) * 1e6
+    return {
+        "device_zipf_batch_us": us,
+        "device_zipf_cps": batch / (us / 1e6),
+        "zipf_write_fraction": write_frac,
+        "zipf_n_rounds": int(n_rounds),
+        "total_capacity": front_cap + back_cap,
+    }
+
+
 GATE_THRESHOLDS = "benchmarks/gate_thresholds.json"
 LAST_DEVICE_ROWS = "benchmarks/last_device_rows.json"
 
@@ -345,6 +460,7 @@ def main():
     # ---- device-only kernel timing -----------------------------------
     dev = measure_device(jax, now)
     _save_device_rows(dev)
+    zipf = measure_device_zipf(jax, now)
     device_batch_us = dev["device_batch_us"]
     device_cps = dev["device_cps"]
     dispatch_batch_us = dev["dispatch_batch_us"]
@@ -466,6 +582,12 @@ def main():
                 "device_batch_us": round(device_batch_us, 1),
                 "device_checks_per_sec": round(device_cps, 1),
                 "device_vs_northstar_50m": round(device_cps / 50e6, 4),
+                "device_zipf_batch_us": round(zipf["device_zipf_batch_us"], 1),
+                "device_zipf_checks_per_sec": round(zipf["device_zipf_cps"], 1),
+                "device_zipf_vs_northstar_50m": round(zipf["device_zipf_cps"] / 50e6, 4),
+                "device_zipf_total_capacity": zipf["total_capacity"],
+                "device_zipf_write_fraction": round(zipf["zipf_write_fraction"], 4),
+                "device_zipf_n_rounds": zipf["zipf_n_rounds"],
                 "dispatch_batch_us_incl_tunnel": round(dispatch_batch_us, 1),
                 "device_us_b256": round(small_batch_us[256][0], 1),
                 "device_us_b256_worst": round(small_batch_us[256][1], 1),

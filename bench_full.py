@@ -145,6 +145,52 @@ def config3():
     dt = _pump(store, keys, cols, iters)
     _emit(3, batch * iters, dt, keyspace=n_keys, table_capacity=cap)
 
+    # 3b: the same churny workload on the TWO-TIER mesh store (small
+    # front prices every scatter; the 10M keyspace churns rows through
+    # the demote/promote move program into the device-resident back
+    # tier).  Front sized to hold a batch's unique keys with headroom.
+    import jax
+
+    from gubernator_tpu.parallel.mesh import MeshBucketStore, make_mesh
+
+    front = _sz(262_144)
+    back = max(cap - front, 0)
+    two = MeshBucketStore(
+        capacity_per_shard=front,
+        back_capacity_per_shard=back,
+        mesh=make_mesh(jax.devices()[:1]),
+    )
+    # Rotating key windows: unlike _pump's single replayed batch, each
+    # dispatch brings a fresh slice of the 10M keyspace, so front
+    # evictions demote continuously — the churn path is the point.
+    n_windows = 4
+    window_batches = []
+    for w in range(n_windows):
+        ids_w = (key_ids + w * (n_keys // n_windows)) % n_keys
+        window_batches.append(([f"c3:{k}" for k in ids_w], cols))
+
+    def dispatch(i):
+        ks, c = window_batches[i % n_windows]
+        return two.apply_columns_async(ks, now_ms=NOW + i, **c)
+
+    for i in range(n_windows):
+        dispatch(i).result()  # compile + first-fill every window
+    t0 = time.perf_counter()
+    pending = None
+    for i in range(iters):
+        h = dispatch(i)
+        if pending is not None:
+            pending.result()
+        pending = h
+    pending.result()
+    dt = time.perf_counter() - t0
+    stats = [t.tier_stats for t in two.tables]
+    _emit("3b_two_tier", batch * iters, dt, keyspace=n_keys,
+          front_capacity=front, back_capacity=back,
+          demotions=sum(s[2] for s in stats),
+          promotions=sum(s[3] for s in stats),
+          back_evictions=sum(s[4] for s in stats))
+
 
 def config4():
     """GLOBAL behavior on the device mesh: hot-key skew answered from

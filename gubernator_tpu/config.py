@@ -64,6 +64,7 @@ class DaemonConfig:
     grpc_max_conn_age_s: int = 0
     advertise_address: str = ""
     cache_size: int = 50_000
+    back_cache_size: int = 0  # two-tier back tier (0 = single-tier)
     global_cache_size: int = 4096
     data_center: str = ""
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
@@ -207,6 +208,9 @@ def setup_daemon_config(
         "GUBER_ADVERTISE_ADDRESS", merged.get("GUBER_GRPC_ADVERTISE_ADDRESS", "")
     )
     conf.cache_size = _env_int(merged, "GUBER_CACHE_SIZE", conf.cache_size)
+    conf.back_cache_size = _env_int(
+        merged, "GUBER_BACK_CACHE_SIZE", conf.back_cache_size
+    )
     conf.global_cache_size = _env_int(
         merged, "GUBER_GLOBAL_CACHE_SIZE", conf.global_cache_size
     )

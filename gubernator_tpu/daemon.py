@@ -57,6 +57,7 @@ class Daemon:
         metrics = Metrics()
         svc_conf = ServiceConfig(
             cache_size=self.conf.cache_size,
+            back_cache_size=self.conf.back_cache_size,
             global_cache_size=self.conf.global_cache_size,
             behaviors=self.conf.behaviors,
             data_center=self.conf.data_center,

@@ -163,6 +163,16 @@ def _build() -> Optional[ctypes.CDLL]:
         c.c_void_p, c.c_void_p, c.c_void_p,
     ]
     lib.gt_mesh_free.argtypes = [c.c_void_p]
+    lib.gt_table_enable_back.argtypes = [c.c_void_p, c.c_int64]
+    lib.gt_table_tier_stats.argtypes = [c.c_void_p, c.c_void_p]
+    lib.gt_table_move_counts.argtypes = [
+        c.c_void_p, c.POINTER(c.c_int64), c.POINTER(c.c_int64),
+    ]
+    lib.gt_table_take_moves.argtypes = [c.c_void_p] + [c.c_void_p] * 5
+    lib.gt_table_back_size.argtypes = [
+        c.c_void_p, c.POINTER(c.c_int64), c.POINTER(c.c_int64),
+    ]
+    lib.gt_table_back_keys.argtypes = [c.c_void_p] + [c.c_void_p] * 4
     lib.gt_fnv1_batch.argtypes = [c.c_void_p, c.c_void_p, c.c_int64, c.c_int32, c.c_void_p]
     lib.gt_json_parse.restype = c.c_void_p
     lib.gt_json_parse.argtypes = [c.c_char_p, c.c_int64]
@@ -483,6 +493,69 @@ class NativeSlotTable:
         self._lib.gt_table_commit(
             self._ptr, slots.ctypes.data, expire.ctypes.data, rm.ctypes.data, len(slots)
         )
+
+    # -- two-tier back tier (front/back split, Table two-tier mode) ----
+    def enable_back(self, back_capacity: int) -> None:
+        """Turn on the back tier: front LRU evictions demote rows to a
+        FIFO back table instead of dropping them; lookups promote them
+        back.  Device moves queue in the table until take_moves."""
+        self._lib.gt_table_enable_back(self._ptr, back_capacity)
+
+    @property
+    def tier_stats(self):
+        """(total_keys, back_keys, demotions, promotions, back_evictions)."""
+        out = (ctypes.c_int64 * 5)()
+        self._lib.gt_table_tier_stats(self._ptr, out)
+        return tuple(int(x) for x in out)
+
+    def move_counts(self):
+        np_, nd = ctypes.c_int64(), ctypes.c_int64()
+        self._lib.gt_table_move_counts(
+            self._ptr, ctypes.byref(np_), ctypes.byref(nd)
+        )
+        return int(np_.value), int(nd.value)
+
+    def take_moves(self):
+        """Drain the queued device moves: (promo_kind, promo_src,
+        promo_dst, demo_src, demo_dst) i32 arrays.  The caller MUST
+        apply them (ops/buckets.apply_moves) before any other device
+        program touches the front rows."""
+        n_promo, n_demo = self.move_counts()
+        pk = np.empty(max(n_promo, 1), dtype=np.int32)
+        ps = np.empty(max(n_promo, 1), dtype=np.int32)
+        pd = np.empty(max(n_promo, 1), dtype=np.int32)
+        ds = np.empty(max(n_demo, 1), dtype=np.int32)
+        dd = np.empty(max(n_demo, 1), dtype=np.int32)
+        self._lib.gt_table_take_moves(
+            self._ptr, pk.ctypes.data, ps.ctypes.data, pd.ctypes.data,
+            ds.ctypes.data, dd.ctypes.data,
+        )
+        return (pk[:n_promo], ps[:n_promo], pd[:n_promo],
+                ds[:n_demo], dd[:n_demo])
+
+    def back_entries(self):
+        """(keys, back_slots i32, expire i64) of every back-tier row."""
+        count = ctypes.c_int64()
+        total = ctypes.c_int64()
+        self._lib.gt_table_back_size(
+            self._ptr, ctypes.byref(count), ctypes.byref(total)
+        )
+        n, nb = int(count.value), int(total.value)
+        if n == 0:
+            return [], np.empty(0, np.int32), np.empty(0, np.int64)
+        slots = np.empty(n, dtype=np.int32)
+        expire = np.empty(n, dtype=np.int64)
+        offsets = np.empty(n + 1, dtype=np.int64)
+        buf = ctypes.create_string_buffer(max(nb, 1))
+        self._lib.gt_table_back_keys(
+            self._ptr, slots.ctypes.data, expire.ctypes.data,
+            offsets.ctypes.data, buf,
+        )
+        raw = buf.raw[:nb]
+        keys = [
+            raw[offsets[i]:offsets[i + 1]].decode("utf-8") for i in range(n)
+        ]
+        return keys, slots, expire
 
     def keys(self) -> List[str]:
         count = ctypes.c_int64()

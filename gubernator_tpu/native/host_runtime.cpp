@@ -65,6 +65,38 @@ struct Table {
   std::unordered_map<std::string, int32_t> key_to_slot;
   int64_t hits = 0, misses = 0, evictions = 0;
 
+  // ---- two-tier mode (back_capacity > 0) ----------------------------
+  // The device keeps a small FRONT table (every kernel lane addresses
+  // it — random-row scatter cost scales with table size, measured
+  // ~2.4ns/slot on TPU v5e) plus a big BACK table written only by
+  // batched demotion scatters.  Front LRU eviction DEMOTES the row
+  // (device move, state preserved) instead of dropping it; a later
+  // lookup PROMOTES it back (cheap device gather).  The host tracks
+  // key locations and queues the device moves; dispatchers drain them
+  // (gt_table_take_moves -> ops/buckets.apply_moves) before any
+  // program that reads front rows.  The back tier evicts FIFO (ring
+  // cursor) — only then is bucket state truly lost, matching the
+  // reference's plain LRU loss semantics at total capacity.
+  int64_t back_capacity = 0;
+  std::unordered_map<std::string, int32_t> key_to_back;
+  std::vector<std::string> back_key;  // back slot -> key
+  std::vector<uint8_t> back_mapped;
+  std::vector<int64_t> back_expire;
+  int64_t back_clock = 0;  // FIFO allocation cursor
+  int64_t back_size = 0, back_evictions = 0, demotions = 0, promotions = 0;
+  // Pending device moves.  promo kind: 0 = gather from back slot, 1 =
+  // gather from FRONT slot (a key demoted and re-promoted inside one
+  // drain window — its row never reached the back table, so the
+  // device copies front->front; the demo record still parks the stale
+  // copy in the back slot, which the host no longer maps).
+  std::vector<int32_t> mv_promo_kind, mv_promo_src, mv_promo_dst;
+  std::vector<int32_t> mv_demo_src, mv_demo_dst;
+  // back slot -> index into mv_demo (this window) for cycle rewrite
+  std::unordered_map<int32_t, int32_t> pending_demo_by_back;
+  // front slots whose promo move is queued but not yet drained (their
+  // device row is not there yet; eviction must skip them)
+  std::vector<uint8_t> pending_promo;
+
   explicit Table(int64_t cap)
       : capacity(cap),
         slot_key(cap),
@@ -72,7 +104,8 @@ struct Table {
         expire_ms(cap, 0),
         pending_write(cap, 0),
         lru_prev(cap, -1),
-        lru_next(cap, -1) {
+        lru_next(cap, -1),
+        pending_promo(cap, 0) {
     free_slots.reserve(cap);
     for (int64_t i = cap - 1; i >= 0; --i) free_slots.push_back((int32_t)i);
     key_to_slot.reserve((size_t)cap * 2);
@@ -107,6 +140,92 @@ struct Table {
     expire_ms[s] = 0;
     lru_unlink(s);
     free_slots.push_back(s);
+  }
+
+  void enable_back(int64_t cap) {
+    back_capacity = cap;
+    back_key.resize(cap);
+    back_mapped.assign(cap, 0);
+    back_expire.assign(cap, 0);
+    key_to_back.reserve((size_t)cap * 2);
+  }
+
+  void unmap_back(int32_t b) {
+    if (!back_mapped[b]) return;
+    key_to_back.erase(back_key[b]);
+    back_key[b].clear();
+    back_mapped[b] = 0;
+    back_expire[b] = 0;
+    --back_size;
+  }
+
+  // Neutralize a queued demo targeting back slot b (src=-1 device
+  // no-op): required whenever b is freed or reused mid-window, or the
+  // move program could scatter two rows onto one destination.
+  void cancel_pending_demo(int32_t b) {
+    auto pd = pending_demo_by_back.find(b);
+    if (pd != pending_demo_by_back.end()) {
+      mv_demo_src[(size_t)pd->second] = -1;
+      pending_demo_by_back.erase(pd);
+    }
+  }
+
+  // A back slot mid-promotion: lookup_or_assign resolves the promo
+  // source BEFORE allocating the front slot, and that allocation's
+  // eviction can demote another key — alloc_back must not wrap the
+  // FIFO cursor onto the in-flight source, or the promoted key would
+  // adopt the victim's row (found by round-4 review, repro'd with
+  // front=1/back=1).
+  int32_t promo_in_flight = -1;
+
+  // FIFO ring allocation; wrapping onto a live entry drops it (the
+  // two-tier design's only true state loss).  Returns -1 when no slot
+  // is usable (back_capacity==1 and that slot is mid-promotion): the
+  // caller drops the row instead of demoting.
+  int32_t alloc_back(const std::string& key) {
+    int32_t b = (int32_t)(back_clock % back_capacity);
+    ++back_clock;
+    if (b == promo_in_flight) {
+      if (back_capacity == 1) return -1;
+      b = (int32_t)(back_clock % back_capacity);
+      ++back_clock;
+    }
+    if (back_mapped[b]) {
+      unmap_back(b);
+      ++back_evictions;
+      ++evictions;
+    }
+    cancel_pending_demo(b);
+    back_key[b] = key;
+    back_mapped[b] = 1;
+    key_to_back.emplace(key, b);
+    ++back_size;
+    return b;
+  }
+
+  // Demote the (still-live) key occupying front slot s: queue the
+  // device row move front[s] -> back[b] and move the host mapping.
+  // Expired occupants are simply dropped — dead state is not worth a
+  // back slot.
+  void evict_front(int32_t s, int64_t now_ms) {
+    lru_unlink(s);
+    const std::string k = std::move(slot_key[s]);
+    key_to_slot.erase(k);
+    slot_mapped[s] = 0;
+    if (back_capacity > 0 && expire_ms[s] >= now_ms) {
+      int32_t b = alloc_back(k);
+      if (b >= 0) {
+        back_expire[b] = expire_ms[s];
+        pending_demo_by_back[b] = (int32_t)mv_demo_src.size();
+        mv_demo_src.push_back(s);
+        mv_demo_dst.push_back(b);
+        ++demotions;
+      } else {
+        ++back_evictions;  // degenerate: nowhere to park the row
+      }
+    }
+    expire_ms[s] = 0;
+    ++evictions;
   }
 
   // Re-map an unmapped slot to `key` (the remove-then-recreate chain:
@@ -155,7 +274,23 @@ struct Table {
       ++misses;  // expired: recycle same slot in place
       return {s, false};
     }
-    ++misses;
+    // Two-tier: a live row demoted to the back tier promotes (a
+    // logical cache hit — the state survives the round trip).
+    int32_t promo_b = -1;
+    if (back_capacity > 0) {
+      auto itb = key_to_back.find(k);
+      if (itb != key_to_back.end()) {
+        int32_t b = itb->second;
+        if (back_expire[b] >= now_ms) {
+          promo_b = b;
+        } else {
+          cancel_pending_demo(b);
+          unmap_back(b);  // expired in back: plain miss-create
+        }
+      }
+    }
+    if (promo_b >= 0) ++hits; else ++misses;
+    promo_in_flight = promo_b;  // shield the source from FIFO reuse
     int32_t s;
     if (!free_slots.empty()) {
       s = free_slots.back();
@@ -164,24 +299,52 @@ struct Table {
       // Evict LRU (cache.go:115-130), skipping slots whose device write
       // from an earlier pipelined batch is still in flight — stealing
       // one drops that batch's device state mid-air and invalidates its
-      // plan-time chaining assumptions.  Walk from the cold end; under
-      // pipelining the pending slots are the recently-touched ones, so
-      // the head is normally clean.  Fall back to the raw head only
-      // when every slot is pending (capacity fully in flight).
+      // plan-time chaining assumptions — and slots awaiting a queued
+      // promotion this drain window (their device row lands with the
+      // NEXT move program; demoting one would copy a pre-promotion
+      // row).  Walk from the cold end; under pipelining the pending
+      // slots are the recently-touched ones, so the head is normally
+      // clean.  Fall back to the raw head only when every slot is
+      // pending (capacity fully in flight).
       s = lru_head;
       for (int32_t cand = lru_head; cand >= 0; cand = lru_next[cand]) {
-        if (pending_write[cand] == 0) { s = cand; break; }
+        if (pending_write[cand] == 0 && pending_promo[cand] == 0) {
+          s = cand;
+          break;
+        }
       }
-      lru_unlink(s);
-      key_to_slot.erase(slot_key[s]);
-      slot_mapped[s] = 0;
-      ++evictions;
+      evict_front(s, now_ms);
     }
     key_to_slot.emplace(std::move(k), s);
     slot_key[s].assign(key, len);
     slot_mapped[s] = 1;
-    expire_ms[s] = 0;
     lru_push_back(s);
+    if (promo_b >= 0) {
+      expire_ms[s] = back_expire[promo_b];
+      // Queue the device move.  A demo still pending for this back
+      // slot (same drain window) means the row never left the front
+      // table — copy front->front (kind 1) instead of reading the
+      // not-yet-written back slot, and cancel the parked demo copy
+      // (its destination is now free for same-window reuse).
+      auto pd = pending_demo_by_back.find(promo_b);
+      if (pd != pending_demo_by_back.end()) {
+        mv_promo_kind.push_back(1);
+        mv_promo_src.push_back(mv_demo_src[(size_t)pd->second]);
+        mv_demo_src[(size_t)pd->second] = -1;
+        pending_demo_by_back.erase(pd);
+      } else {
+        mv_promo_kind.push_back(0);
+        mv_promo_src.push_back(promo_b);
+      }
+      mv_promo_dst.push_back(s);
+      pending_promo[s] = 1;
+      unmap_back(promo_b);
+      promo_in_flight = -1;
+      ++promotions;
+      return {s, true};
+    }
+    promo_in_flight = -1;
+    expire_ms[s] = 0;
     return {s, false};
   }
 };
@@ -252,8 +415,93 @@ void gt_table_lookup_or_assign(void* tv, const char* key, int64_t len,
 
 void gt_table_remove(void* tv, const char* key, int64_t len) {
   Table* t = (Table*)tv;
-  auto it = t->key_to_slot.find(std::string(key, (size_t)len));
+  std::string k(key, (size_t)len);
+  auto it = t->key_to_slot.find(k);
   if (it != t->key_to_slot.end()) t->unmap_slot(it->second);
+  if (t->back_capacity > 0) {
+    auto itb = t->key_to_back.find(k);
+    if (itb != t->key_to_back.end()) {
+      t->cancel_pending_demo(itb->second);
+      t->unmap_back(itb->second);
+    }
+  }
+}
+
+// ---- two-tier back tier -----------------------------------------------
+
+void gt_table_enable_back(void* tv, int64_t back_capacity) {
+  ((Table*)tv)->enable_back(back_capacity);
+}
+
+// out: total keys (front+back), back keys, demotions, promotions,
+// back evictions (true state loss)
+void gt_table_tier_stats(void* tv, int64_t* out) {
+  Table* t = (Table*)tv;
+  out[0] = (int64_t)t->key_to_slot.size() + t->back_size;
+  out[1] = t->back_size;
+  out[2] = t->demotions;
+  out[3] = t->promotions;
+  out[4] = t->back_evictions;
+}
+
+void gt_table_move_counts(void* tv, int64_t* n_promo, int64_t* n_demo) {
+  Table* t = (Table*)tv;
+  *n_promo = (int64_t)t->mv_promo_src.size();
+  *n_demo = (int64_t)t->mv_demo_src.size();
+}
+
+// Drain the queued device moves into caller arrays (sized from
+// gt_table_move_counts) and close the drain window: after this call
+// the rows are considered ON DEVICE in their new homes, so the
+// dispatcher MUST run the move program (ops/buckets.apply_moves)
+// with exactly these records before any other device program.
+void gt_table_take_moves(void* tv, int32_t* promo_kind, int32_t* promo_src,
+                         int32_t* promo_dst, int32_t* demo_src,
+                         int32_t* demo_dst) {
+  Table* t = (Table*)tv;
+  std::memcpy(promo_kind, t->mv_promo_kind.data(),
+              t->mv_promo_kind.size() * sizeof(int32_t));
+  std::memcpy(promo_src, t->mv_promo_src.data(),
+              t->mv_promo_src.size() * sizeof(int32_t));
+  std::memcpy(promo_dst, t->mv_promo_dst.data(),
+              t->mv_promo_dst.size() * sizeof(int32_t));
+  std::memcpy(demo_src, t->mv_demo_src.data(),
+              t->mv_demo_src.size() * sizeof(int32_t));
+  std::memcpy(demo_dst, t->mv_demo_dst.data(),
+              t->mv_demo_dst.size() * sizeof(int32_t));
+  for (int32_t s : t->mv_promo_dst) t->pending_promo[s] = 0;
+  t->mv_promo_kind.clear();
+  t->mv_promo_src.clear();
+  t->mv_promo_dst.clear();
+  t->mv_demo_src.clear();
+  t->mv_demo_dst.clear();
+  t->pending_demo_by_back.clear();
+}
+
+// Snapshot protocol for the back tier (Loader.Save needs every live
+// item): gt_table_back_size for buffer sizing, then gt_table_back_keys
+// fills (back_slots, expire, offsets[count+1], key bytes).
+void gt_table_back_size(void* tv, int64_t* count, int64_t* total_bytes) {
+  Table* t = (Table*)tv;
+  *count = t->back_size;
+  int64_t bytes = 0;
+  for (auto& kv : t->key_to_back) bytes += (int64_t)kv.first.size();
+  *total_bytes = bytes;
+}
+
+void gt_table_back_keys(void* tv, int32_t* slots, int64_t* expire,
+                        int64_t* offsets, char* bytes) {
+  Table* t = (Table*)tv;
+  int64_t i = 0, off = 0;
+  for (auto& kv : t->key_to_back) {
+    slots[i] = kv.second;
+    expire[i] = t->back_expire[kv.second];
+    offsets[i] = off;
+    std::memcpy(bytes + off, kv.first.data(), kv.first.size());
+    off += (int64_t)kv.first.size();
+    ++i;
+  }
+  offsets[i] = off;
 }
 
 void gt_table_set_expire(void* tv, int32_t slot, int64_t expire) {

@@ -41,7 +41,6 @@ from ..models.shard import (
     RoundPlanner,
     _rows_to_items,
     build_round_arrays,
-    decode_narrow,
     item_to_rows,
     make_columns,
     make_store_resolver,
@@ -198,6 +197,13 @@ def _clear_jit(gcols, idx):
     return jax.vmap(global_ops.clear_gslots, in_axes=(0, None))(gcols, idx)
 
 
+@partial(jax.jit, donate_argnums=(0, 1))
+def _moves_mesh_jit(state, back, pk, ps, pd, ds, dd):
+    """Apply one drain window of tier moves on every shard (see
+    buckets.apply_moves; padded [S, Pm] move arrays, src=-1 no-ops)."""
+    return jax.vmap(buckets.apply_moves)(state, back, pk, ps, pd, ds, dd)
+
+
 @partial(jax.jit, donate_argnums=0)
 def _write_row_jit(state, s, slot, rows):
     # Donated single-row scatter: store-miss injection / loader placement
@@ -326,7 +332,26 @@ class MeshBucketStore(ColumnarPipeline):
         devices: Optional[Sequence[jax.Device]] = None,
         store=None,
         use_native: bool = True,
+        back_capacity_per_shard: int = 0,
     ):
+        """back_capacity_per_shard > 0 enables the two-tier table: a
+        small FRONT table (capacity_per_shard) absorbs every kernel
+        scatter — whose cost scales with the table it targets — while
+        front LRU evictions DEMOTE rows to a big device-resident back
+        tier (FIFO) instead of dropping them, and later lookups PROMOTE
+        them back.  Total capacity = front + back per shard; state is
+        lost only when the back tier itself wraps.  Requires the native
+        runtime; incompatible with the Store SPI (whose resolver
+        injects rows synchronously mid-round).
+
+        Sizing contract: the front must hold one BATCH's per-shard
+        working set (unique keys) with room to spare — a single batch
+        whose unique keys exceed the front capacity exhausts the
+        pending-write eviction guard and degrades to the planner's
+        all-pending fallback (reference-grade state loss, exactly as a
+        single-tier table at that capacity would).  The tiering wins
+        when the churn is ACROSS batches: each batch's keys fit the
+        front, while the long-tail keyspace lives in the back."""
         self.store = store
         # One mutation lock: apply/sync/inject swap donated device
         # buffers, so concurrent callers (gateway handler threads, the
@@ -348,14 +373,29 @@ class MeshBucketStore(ColumnarPipeline):
         self._init_pipeline()  # FIFO of in-flight columnar batches
         _table = _native.NativeSlotTable if self._native else SlotTable
         self.tables = [_table(capacity_per_shard) for _ in range(self.n_shards)]
-        self.algo_mirror = [
-            np.zeros(capacity_per_shard, dtype=np.int32) for _ in range(self.n_shards)
-        ]
+        self.back_capacity_per_shard = back_capacity_per_shard
+        if back_capacity_per_shard > 0:
+            if not self._native:
+                raise RuntimeError("two-tier table requires the native runtime")
+            if store is not None:
+                raise ValueError("two-tier table is incompatible with a Store SPI")
+            for t in self.tables:
+                t.enable_back(back_capacity_per_shard)
+        # One [S, C] array: per-shard views via algo_mirror[s], and the
+        # columnar commit updates it with ONE vectorized scatter.
+        self.algo_mirror = np.zeros(
+            (self.n_shards, capacity_per_shard), dtype=np.int32
+        )
         self.gtable = GlobalKeyTable(g_capacity)
         self.dirty = np.zeros((self.n_shards, g_capacity), dtype=bool)
 
         self._sharding = NamedSharding(self.mesh, P(self.axis))
         self.state = self._stack_and_shard(buckets.init_state(capacity_per_shard))
+        self.back = (
+            self._stack_and_shard(buckets.init_back(back_capacity_per_shard))
+            if back_capacity_per_shard > 0
+            else None
+        )
         self.gcols = self._stack_and_shard(global_ops.init_global_columns(g_capacity))
 
         # Jitted programs are MODULE-level (or cached per mesh) so every
@@ -373,6 +413,50 @@ class MeshBucketStore(ColumnarPipeline):
             lambda c: np.broadcast_to(np.asarray(c), (self.n_shards,) + c.shape).copy(), single
         )
         return jax.tree.map(lambda c: jax.device_put(c, self._sharding), stacked)
+
+    def _drain_moves(self) -> None:
+        """Apply every queued tier move (caller holds the store lock).
+
+        Planning queues promotions/demotions in the C++ tables; this
+        dispatches ONE small move program for the whole mesh so the
+        rows are in their new homes before any program that reads
+        front rows.  No-op (no dispatch) when nothing is queued — the
+        steady state for front-resident traffic."""
+        if self.back is None:
+            return
+        counts = [t.move_counts() for t in self.tables]
+        max_p = max(p for p, _ in counts)
+        max_d = max(d for _, d in counts)
+        if max_p == 0 and max_d == 0:
+            return
+        S = self.n_shards
+
+        def _pad(n):  # own pow2 buckets (>=8) to bound recompiles
+            m = 8
+            while m < n:
+                m <<= 1
+            return m
+
+        pp, dp = _pad(max_p), _pad(max_d)
+        pk = np.zeros((S, pp), dtype=np.int32)
+        ps = np.full((S, pp), -1, dtype=np.int32)
+        pd = np.zeros((S, pp), dtype=np.int32)
+        ds = np.full((S, dp), -1, dtype=np.int32)
+        dd = np.zeros((S, dp), dtype=np.int32)
+        for s, t in enumerate(self.tables):
+            n_p, n_d = counts[s]
+            if n_p == 0 and n_d == 0:
+                continue
+            tpk, tps, tpd, tds, tdd = t.take_moves()
+            pk[s, :n_p] = tpk
+            ps[s, :n_p] = tps
+            pd[s, :n_p] = tpd
+            ds[s, :n_d] = tds
+            dd[s, :n_d] = tdd
+        put = lambda a: jax.device_put(a, self._sharding)  # noqa: E731
+        self.state, self.back = _moves_mesh_jit(
+            self.state, self.back, put(pk), put(ps), put(pd), put(ds), put(dd)
+        )
 
     # ------------------------------------------------------------------
     @_drained_locked
@@ -516,6 +600,9 @@ class MeshBucketStore(ColumnarPipeline):
             cols, int(Behavior.RESET_REMAINING), padded
         )
         pos = mp.pos[:n]
+        # Tier moves queued by this plan (and any stale window) must
+        # land before the batch program reads front rows.
+        self._drain_moves()
 
         narrow = narrow_ok(cols, now_ms) and force_wire != "wide"
         dict_enc = None
@@ -581,13 +668,11 @@ class MeshBucketStore(ColumnarPipeline):
                 else:
                     status, rem, reset = mp.finish_wide(packed_np)
                 if n:
-                    # Host algo mirror (Store-SPI bookkeeping parity).
-                    lane_slot = mp.slot.reshape(-1)[pos]
-                    lane_shard = pos // padded
-                    for s in range(S):
-                        sel = lane_shard == s
-                        if sel.any():
-                            self.algo_mirror[s][lane_slot[sel]] = cols.algo[sel]
+                    # Host algo mirror (Store-SPI bookkeeping parity):
+                    # one vectorized 2-D scatter, no per-shard masks.
+                    self.algo_mirror[
+                        pos // padded, mp.slot.reshape(-1)[pos]
+                    ] = cols.algo
             return status, rem, reset
 
         return fetch, commit
@@ -609,6 +694,7 @@ class MeshBucketStore(ColumnarPipeline):
             plans.append((rid, occ, wr))
             n_rounds = max(n_rounds, nr)
             maxb = max(maxb, len(by_shard[s]))
+        self._drain_moves()  # tier moves queued by plan_grouped_python
         padded = pad_size(maxb)
         cols = [build_round_arrays(by_shard[s], padded) for s in range(S)]
         stacked = [np.stack([c[f] for c in cols]) for f in range(9)]
@@ -685,6 +771,7 @@ class MeshBucketStore(ColumnarPipeline):
 
     # ------------------------------------------------------------------
     def _run_round(self, chunks, now_ms: int, responses) -> None:
+        self._drain_moves()  # tier moves queued while planning the round
         padded = pad_size(max(max((len(c) for c in chunks), default=1), 1))
         cols = [build_round_arrays(c, padded) for c in chunks]
         stacked = [np.stack([col[f] for col in cols]) for f in range(9)]
@@ -756,6 +843,9 @@ class MeshBucketStore(ColumnarPipeline):
         """Loader.Load path (gubernator.go:78-90), routed to the owner shard."""
         s = shard_of_key(item.key, self.n_shards)
         slot, _ = self.tables[s].lookup_or_assign(item.key, 0)
+        # A promotion queued by the resolve would otherwise overwrite
+        # the injected row at the next drain.
+        self._drain_moves()
         self._inject(s, slot, item)
 
     @_drained_locked
@@ -763,14 +853,23 @@ class MeshBucketStore(ColumnarPipeline):
         """Loader.Save path (gubernator.go:93-111) across all shards.
         Materialized under the lock so a concurrent apply cannot swap
         state buffers mid-snapshot."""
+        self._drain_moves()  # pending promotions leave front rows stale
         items = []
         for s in range(self.n_shards):
             keys = self.tables[s].keys()
-            if not keys:
-                continue
-            slots = [self.tables[s].get_slot(k) for k in keys]
-            rows = self._read_shard_rows(s, slots)
-            items.extend(_rows_to_items(keys, rows))
+            if keys:
+                slots = [self.tables[s].get_slot(k) for k in keys]
+                rows = self._read_shard_rows(s, slots)
+                items.extend(_rows_to_items(keys, rows))
+            if self.back is not None:
+                bkeys, bslots, _ = self.tables[s].back_entries()
+                if bkeys:
+                    back_shard = jax.tree.map(lambda col: col[s], self.back)
+                    rows = jax.tree.map(
+                        np.asarray,
+                        buckets.read_back_rows(back_shard, bslots),
+                    )
+                    items.extend(_rows_to_items(bkeys, rows))
         return items
 
     # ------------------------------------------------------------------
@@ -852,6 +951,10 @@ class MeshBucketStore(ColumnarPipeline):
             if self.tables[o].get_slot(key) != int(self.gtable.owner_slot[g]):
                 self.gtable.owner_slot[g] = -1
 
+        # Owner-slot resolution above may promote demoted GLOBAL keys;
+        # their rows must be in the front table before the collective
+        # reads them.
+        self._drain_moves()
         cfg = global_ops.SyncConfig(
             owner_slot=jnp.asarray(self.gtable.owner_slot),
             owner_shard=jnp.asarray(self.gtable.owner_shard),
@@ -1018,6 +1121,19 @@ class MeshBucketStore(ColumnarPipeline):
         )
         self.apply([req], now_ms)
         self.sync_globals(now_ms)
+        if self.back is not None:
+            # Compile the tier-move program at its smallest pad bucket
+            # (all-noop records): the first real demotion otherwise pays
+            # the compile inside a client's deadline.
+            S = self.n_shards
+            noop = np.full((S, 8), -1, dtype=np.int32)
+            z = np.zeros((S, 8), dtype=np.int32)
+            put = lambda a: jax.device_put(a, self._sharding)  # noqa: E731
+            with self._lock:
+                self.state, self.back = _moves_mesh_jit(
+                    self.state, self.back, put(z), put(noop), put(z),
+                    put(noop), put(z),
+                )
         if self._native and self.store is None:
             # Compile the columnar ingress kernels too (the gateway/gRPC
             # hot path).  Each pad_size bucket is its own XLA program,

@@ -62,6 +62,10 @@ class ServiceConfig:
 
     store: Optional[MeshBucketStore] = None  # built from sizes when None
     cache_size: int = 50_000
+    # Two-tier table: > 0 adds a device-resident back tier of this many
+    # extra slots (total capacity = cache_size + back_cache_size; the
+    # small front absorbs every kernel scatter, see MeshBucketStore).
+    back_cache_size: int = 0
     global_cache_size: int = 4096
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
     advertise_address: str = ""
@@ -280,6 +284,9 @@ class V1Service:
             g_capacity=conf.global_cache_size,
             devices=conf.devices,
             store=conf.persist_store,
+            back_capacity_per_shard=max(
+                conf.back_cache_size // _n_local_devices(conf.devices), 0
+            ),
         )
         self.local_picker = conf.local_picker or ReplicatedConsistentHash()
         self.region_picker = conf.region_picker or RegionPicker()

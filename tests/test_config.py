@@ -23,6 +23,7 @@ def test_env_overrides():
     env = {
         "GUBER_HTTP_ADDRESS": "0.0.0.0:9090",
         "GUBER_CACHE_SIZE": "1234",
+        "GUBER_BACK_CACHE_SIZE": "99999",
         "GUBER_DATA_CENTER": "dc-west",
         "GUBER_BATCH_LIMIT": "500",
         "GUBER_BATCH_WAIT": "2ms",
@@ -33,6 +34,7 @@ def test_env_overrides():
     conf = setup_daemon_config(env=env)
     assert conf.listen_address == "0.0.0.0:9090"
     assert conf.cache_size == 1234
+    assert conf.back_cache_size == 99999
     assert conf.data_center == "dc-west"
     assert conf.behaviors.batch_limit == 500
     assert conf.behaviors.batch_wait_s == pytest.approx(0.002)
