@@ -110,7 +110,9 @@ def measure_device(jax, now, samples: int = 5):
 
         return run
 
-    k_lo, k_hi = 4, 20
+    # dK=64: at dK=16 the tunnel-weather error bar is ~±1.5ms/batch
+    # (round-4 probe finding — it had produced impossible orderings).
+    k_lo, k_hi = 4, 68
     chain_t = {}
     for K in (k_lo, k_hi):
         fn = _chain(K)
@@ -258,6 +260,7 @@ def measure_device_zipf(jax, now, samples: int = 5):
     )
     write_frac = float(write.mean())
 
+    assert n_rounds == 1, n_rounds  # grouped Zipf plan is single-round
     state = buckets.init_state(front_cap)
     back = buckets.init_back(back_cap)  # resident: the capacity is real
     back = jax.device_put(back)
@@ -302,7 +305,7 @@ def measure_device_zipf(jax, now, samples: int = 5):
 
         return run
 
-    k_lo, k_hi = 4, 20
+    k_lo, k_hi = 4, 68  # dK=64: see measure_device's error-bar note
     chain_t = {}
     for K in (k_lo, k_hi):
         fn = _chain(K)

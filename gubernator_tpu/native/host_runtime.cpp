@@ -93,9 +93,10 @@ struct Table {
   std::vector<int32_t> mv_demo_src, mv_demo_dst;
   // back slot -> index into mv_demo (this window) for cycle rewrite
   std::unordered_map<int32_t, int32_t> pending_demo_by_back;
-  // front slots whose promo move is queued but not yet drained (their
-  // device row is not there yet; eviction must skip them)
-  std::vector<uint8_t> pending_promo;
+  // per front slot: index into mv_promo_* of a queued-but-undrained
+  // promotion (-1 none).  The row is not on device yet, so eviction
+  // must prefer other slots and, if forced, CANCEL the record.
+  std::vector<int32_t> pending_promo;
 
   explicit Table(int64_t cap)
       : capacity(cap),
@@ -105,7 +106,7 @@ struct Table {
         pending_write(cap, 0),
         lru_prev(cap, -1),
         lru_next(cap, -1),
-        pending_promo(cap, 0) {
+        pending_promo(cap, -1) {
     free_slots.reserve(cap);
     for (int64_t i = cap - 1; i >= 0; --i) free_slots.push_back((int32_t)i);
     key_to_slot.reserve((size_t)cap * 2);
@@ -212,7 +213,21 @@ struct Table {
     const std::string k = std::move(slot_key[s]);
     key_to_slot.erase(k);
     slot_mapped[s] = 0;
-    if (back_capacity > 0 && expire_ms[s] >= now_ms) {
+    // Demotion preserves state ONLY when the device row at s really is
+    // this key's current state.  Under the all-pending starvation
+    // fallback the chosen slot may have (a) a queued promotion whose
+    // row hasn't arrived — demoting would park the PREVIOUS occupant's
+    // row under this key's name (cross-key corruption, round-4 review
+    // repro) — cancel the promo and drop instead; (b) an in-flight
+    // batch write (pending_write) — the row is mid-air, drop.  Both
+    // degrade to the documented reference-grade loss, never to serving
+    // another key's counters.
+    if (pending_promo[s] >= 0) {
+      mv_promo_src[(size_t)pending_promo[s]] = -1;  // device no-op
+      pending_promo[s] = -1;
+      ++back_evictions;  // the promoted state is lost
+    } else if (back_capacity > 0 && pending_write[s] == 0 &&
+               expire_ms[s] >= now_ms) {
       int32_t b = alloc_back(k);
       if (b >= 0) {
         back_expire[b] = expire_ms[s];
@@ -306,13 +321,26 @@ struct Table {
       // slots are the recently-touched ones, so the head is normally
       // clean.  Fall back to the raw head only when every slot is
       // pending (capacity fully in flight).
-      s = lru_head;
+      // Preference ladder: fully clean slot > promo-free slot (in-
+      // flight write: evict_front drops instead of demoting) > raw
+      // head (pending promo: evict_front cancels the record — loss,
+      // never corruption).
+      s = -1;
       for (int32_t cand = lru_head; cand >= 0; cand = lru_next[cand]) {
-        if (pending_write[cand] == 0 && pending_promo[cand] == 0) {
+        if (pending_write[cand] == 0 && pending_promo[cand] < 0) {
           s = cand;
           break;
         }
       }
+      if (s < 0) {
+        for (int32_t cand = lru_head; cand >= 0; cand = lru_next[cand]) {
+          if (pending_promo[cand] < 0) {
+            s = cand;
+            break;
+          }
+        }
+      }
+      if (s < 0) s = lru_head;
       evict_front(s, now_ms);
     }
     key_to_slot.emplace(std::move(k), s);
@@ -337,7 +365,7 @@ struct Table {
         mv_promo_src.push_back(promo_b);
       }
       mv_promo_dst.push_back(s);
-      pending_promo[s] = 1;
+      pending_promo[s] = (int32_t)mv_promo_dst.size() - 1;
       unmap_back(promo_b);
       promo_in_flight = -1;
       ++promotions;
@@ -469,7 +497,7 @@ void gt_table_take_moves(void* tv, int32_t* promo_kind, int32_t* promo_src,
               t->mv_demo_src.size() * sizeof(int32_t));
   std::memcpy(demo_dst, t->mv_demo_dst.data(),
               t->mv_demo_dst.size() * sizeof(int32_t));
-  for (int32_t s : t->mv_promo_dst) t->pending_promo[s] = 0;
+  for (int32_t s : t->mv_promo_dst) t->pending_promo[s] = -1;
   t->mv_promo_kind.clear();
   t->mv_promo_src.clear();
   t->mv_promo_dst.clear();

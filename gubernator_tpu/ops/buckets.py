@@ -363,6 +363,68 @@ def apply_batch(
     select — strictly worse than scattering unconditionally — so
     vmapped callers must pass cold_cond=False.
     """
+    out, new = _apply_compute(state, req, now_ms)
+    state = _commit_rows(state, req, new, cold_cond)
+    return state, out
+
+
+class _NewRows(NamedTuple):
+    """Per-lane post-batch row values (the commit's input): what
+    _apply_compute would store for each lane, before any scatter."""
+
+    flags: jax.Array  # i32[B]
+    rem: jax.Array  # i64[B]
+    stamp: jax.Array  # i64[B]
+    exp: jax.Array  # i64[B]
+    limit: jax.Array  # i64[B]
+    dur: jax.Array  # i64[B]
+    writes: jax.Array  # bool[B] — lanes that commit state
+    cold_changed: jax.Array  # bool[B] — writes whose stored config changed
+
+
+def _commit_rows(state: BucketState, req, new: _NewRows, cold_cond: bool):
+    """Per-lane row scatter (every lane submits a row; dropped lanes
+    still pay the scatter's per-submitted-row price — the compact
+    commit below avoids that when the plan allows)."""
+    C = state.hot.shape[0]
+    # Non-write lanes map to DISTINCT out-of-bounds indices (C + lane)
+    # rather than a shared C: mode='drop' discards them either way, but
+    # unique_indices=True promises uniqueness over the WHOLE index
+    # vector and repeated sentinels would be undefined behavior.
+    lane = jnp.arange(req.slot.shape[0], dtype=_I32)
+    oob = C + lane
+    scat = jnp.where(new.writes, req.slot, oob)
+    drop = dict(mode="drop", unique_indices=True)
+    new_hot = state.hot.at[scat].set(
+        _pack_hot(new.flags, new.rem, new.stamp, new.exp), **drop
+    )
+
+    scat_cold = jnp.where(new.cold_changed, req.slot, oob)
+    cold_rows = _pack_cold(new.limit, new.dur)
+
+    if cold_cond:
+        def _scatter_cold(args):
+            cold, idx, rows = args
+            return cold.at[idx].set(rows, **drop)
+
+        def _keep_cold(args):
+            return args[0]
+
+        new_cold = jax.lax.cond(
+            jnp.any(new.cold_changed), _scatter_cold, _keep_cold,
+            (state.cold, scat_cold, cold_rows),
+        )
+    else:
+        new_cold = state.cold.at[scat_cold].set(cold_rows, **drop)
+    return BucketState(hot=new_hot, cold=new_cold)
+
+
+def _apply_compute(
+    state: BucketState, req: RequestBatch, now_ms
+) -> "tuple[BatchOutput, _NewRows]":
+    """The batch evaluation WITHOUT the state commit: returns the
+    responses plus every lane's post-batch row values (see apply_batch
+    for semantics; the split exists so commits can be compacted)."""
     now = jnp.asarray(now_ms, _I64)
     C = state.hot.shape[0]
 
@@ -558,48 +620,13 @@ def apply_batch(
 
     removed = tok_reset & valid
 
-    # Scatter rows back.  Padding lanes (slot=-1) must NOT write: jax
-    # `.at[-1]` wraps like NumPy negative indexing, so map them to C
-    # (out of bounds) where mode='drop' actually drops them.  In grouped
-    # mode only the LAST occurrence of each duplicate group writes.
-    #
-    # ONE hot row scatter always; the cold scatter only runs when some
-    # write lane actually changed its stored config (create, limit or
-    # duration hot-change, algo switch) — steady-state batches skip it
-    # entirely (the lax.cond prices it at one scalar predicate).
+    # Padding lanes (slot=-1) must NOT write; in grouped mode only the
+    # LAST occurrence of each duplicate group writes.  The cold row is
+    # rewritten only when a write lane actually changed its stored
+    # config (create, limit or duration hot-change, algo switch).
     writes = valid if req.write is None else (valid & req.write)
-    # Non-write lanes map to DISTINCT out-of-bounds indices (C + lane)
-    # rather than a shared C: mode='drop' discards them either way, but
-    # unique_indices=True promises uniqueness over the WHOLE index
-    # vector and repeated sentinels would be undefined behavior.
-    lane = jnp.arange(req.slot.shape[0], dtype=_I32)
-    oob = C + lane
-    scat = jnp.where(writes, req.slot, oob)
-    drop = dict(mode="drop", unique_indices=True)
     n_flags = (n_algo & 3) | ((n_status & 1) << 2)
-    new_hot = state.hot.at[scat].set(
-        _pack_hot(n_flags, n_rem, n_stamp, n_exp), **drop
-    )
-
     cold_changed = writes & ((n_limit != g_limit) | (n_dur != g_dur))
-    scat_cold = jnp.where(cold_changed, req.slot, oob)
-    cold_rows = _pack_cold(n_limit, n_dur)
-
-    if cold_cond:
-        def _scatter_cold(args):
-            cold, idx, rows = args
-            return cold.at[idx].set(rows, **drop)
-
-        def _keep_cold(args):
-            return args[0]
-
-        new_cold = jax.lax.cond(
-            jnp.any(cold_changed), _scatter_cold, _keep_cold,
-            (state.cold, scat_cold, cold_rows),
-        )
-    else:
-        new_cold = state.cold.at[scat_cold].set(cold_rows, **drop)
-    new_state = BucketState(hot=new_hot, cold=new_cold)
 
     out = BatchOutput(
         status=jnp.where(valid, resp_status, UNDER),
@@ -610,7 +637,11 @@ def apply_batch(
         removed=removed,
         pre_expire=jnp.where(valid, g_exp, z64),
     )
-    return new_state, out
+    new = _NewRows(
+        flags=n_flags, rem=n_rem, stamp=n_stamp, exp=n_exp,
+        limit=n_limit, dur=n_dur, writes=writes, cold_changed=cold_changed,
+    )
+    return out, new
 
 
 apply_batch_jit = jax.jit(apply_batch, donate_argnums=0)
@@ -815,6 +846,90 @@ apply_rounds32_jit = jax.jit(
 )
 
 
+def apply_compact32(
+    state: BucketState, req32: RequestBatch32, wlane, now_ms,
+) -> "tuple[BucketState, jax.Array]":
+    """Single-round narrow kernel with a COMPACTED commit.
+
+    XLA's random-row scatter prices per SUBMITTED row — ~21ns each on
+    TPU v5e — whether or not mode='drop' discards it, so the per-lane
+    commit pays for all B lanes even when the grouped planner marked
+    only ~25% as writers (measured Zipf write fraction 0.235,
+    probe/bench round 4).  Here the host ALSO sends `wlane` (i32[Pw]):
+    the batch lanes that commit state, compacted and padded with -1.
+    The kernel computes all lanes as usual, then gathers just the
+    write lanes' rows and scatters Pw rows instead of B.
+
+    Legal ONLY for single-round plans (n_rounds == 1 — the grouped
+    planner's common case): multi-round batches need the scatter
+    between rounds.  Callers guarantee wlane lists exactly the plan's
+    write lanes.  Output packing is identical to apply_rounds32.
+    """
+    now = jnp.asarray(now_ms, _I64)
+    req = RequestBatch(
+        slot=req32.slot,
+        exists=req32.exists,
+        algorithm=req32.algorithm,
+        behavior=req32.behavior,
+        hits=req32.hits.astype(_I64),
+        limit=req32.limit.astype(_I64),
+        duration=req32.duration.astype(_I64),
+        greg_expire=now + req32.greg_expire_delta.astype(_I64),
+        greg_duration=req32.greg_duration.astype(_I64),
+        occ=req32.occ,
+        write=req32.write,
+    )
+    out, new = _apply_compute(state, req, now_ms)
+
+    C = state.hot.shape[0]
+    wl = jnp.clip(wlane, 0, req.slot.shape[0] - 1)
+    wvalid = (wlane >= 0) & new.writes[wl]
+    lane = jnp.arange(wlane.shape[0], dtype=_I32)
+    dst = jnp.where(wvalid, req.slot[wl], C + lane)
+    drop = dict(mode="drop", unique_indices=True)
+    hot_rows = _pack_hot(new.flags, new.rem, new.stamp, new.exp)[wl]
+    new_hot = state.hot.at[dst].set(hot_rows, **drop)
+
+    ccold = wvalid & new.cold_changed[wl]
+    dst_cold = jnp.where(ccold, req.slot[wl], C + lane)
+    cold_rows = _pack_cold(new.limit, new.dur)[wl]
+
+    def _scatter_cold(args):
+        cold, idx, rows = args
+        return cold.at[idx].set(rows, **drop)
+
+    new_cold = jax.lax.cond(
+        jnp.any(ccold), _scatter_cold, lambda a: a[0],
+        (state.cold, dst_cold, cold_rows),
+    )
+    state = BucketState(hot=new_hot, cold=new_cold)
+
+    pre_exp = out.pre_expire
+    hi = jnp.asarray((1 << 31) - 1, _I64)
+
+    def delta(v):
+        d = v - now
+        fits = (d >= 0) & (d <= hi)
+        return jnp.where(
+            v == 0, -1,
+            jnp.where(fits, d, jnp.where(v == pre_exp, -2, jnp.clip(d, 0, hi))),
+        )
+
+    row0 = out.status.astype(_I64) | (out.removed.astype(_I64) << 1)
+    packed32 = jnp.stack(
+        (
+            row0,
+            jnp.clip(out.remaining, 0, hi),
+            delta(out.reset_time),
+            delta(out.new_expire),
+        )
+    ).astype(_I32)
+    return state, packed32
+
+
+apply_compact32_jit = jax.jit(apply_compact32, donate_argnums=0)
+
+
 class RequestBatchDict(NamedTuple):
     """Config-dictionary wire: the narrowest host->device encoding.
 
@@ -975,6 +1090,34 @@ def apply_rounds_packed(
 apply_rounds_packed_jit = jax.jit(
     apply_rounds_packed, donate_argnums=0, static_argnames=("cold_cond",)
 )
+
+
+def apply_compact_packed(
+    state: BucketState, wire, wlane, now_ms
+) -> "tuple[BucketState, jax.Array]":
+    """apply_compact32 behind the single-buffer dict wire: the
+    production fast path for SINGLE-ROUND narrow batches — the compact
+    commit scatters only the plan's write lanes (wlane i32[Pw],
+    -1-padded) instead of all B lanes.  The wire's round_id words are
+    ignored (every lane is round 0 by the caller's n_rounds==1
+    guarantee)."""
+    P = (wire.shape[0] - DICT_WIRE_TABLE_WORDS) // 3
+    slot, fl, cfg, occ, _rid, rows = unpack_dict_wire(wire, P)
+    cfg = cfg.astype(_I32)
+    req32 = RequestBatch32(
+        slot=slot,
+        exists=(fl & 1) != 0,
+        algorithm=rows[0][cfg],
+        behavior=rows[1][cfg],
+        hits=rows[2][cfg].astype(_I32),
+        limit=rows[3][cfg].astype(_I32),
+        duration=rows[4][cfg].astype(_I32),
+        greg_expire_delta=rows[5][cfg].astype(_I32),
+        greg_duration=rows[6][cfg].astype(_I32),
+        occ=occ.astype(_I32),
+        write=(fl & 2) != 0,
+    )
+    return apply_compact32(state, req32, wlane, now_ms)
 
 
 def apply_rounds_packed_wide(

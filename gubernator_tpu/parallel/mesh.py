@@ -623,6 +623,12 @@ class MeshBucketStore(ColumnarPipeline):
                 mp.slot, mp.exists, mp.write, cfg_a, mp.occ, mp.rid, cfg_table
             )
             wire_dev = jax.device_put(wire, self._sharding)
+            # (A compacted-commit variant — scatter only the write
+            # lanes, buckets.apply_compact32 — measured SLOWER on TPU
+            # v5e despite submitting ~4x fewer rows: the scatter's
+            # price at these shapes is not per-submitted-row.  See
+            # benchmarks/RESULTS.md round-4 notes; the kernel remains
+            # available and equivalence-tested.)
             fn_packed = (
                 _rounds_packed_mesh_jit if narrow else _rounds_packed_wide_mesh_jit
             )

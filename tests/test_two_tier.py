@@ -248,3 +248,39 @@ def test_back_capacity_one_degenerates_to_loss_not_corruption():
     assert t.get_expire_bulk([sa2])[0] == T0 + 60_000
     _, eb = t.lookup_or_assign("b", T0)
     assert eb is False  # b dropped (documented degenerate), not corrupted
+
+
+def test_starved_fallback_never_serves_another_keys_row():
+    """Round-4 review repro: with every front slot holding a pending
+    promotion, the all-pending eviction fallback must CANCEL the
+    promo (state loss) — demoting it would park the previous
+    occupant's device row under the promoted key's name and later
+    lookups would serve another key's counters."""
+    t = native.NativeSlotTable(2)
+    t.enable_back(8)
+    for k in ("ka", "kb"):
+        s, _ = t.lookup_or_assign(k, T0)
+        t.set_expire(s, T0 + 60_000)
+    for k in ("kc", "kd"):  # demote ka, kb
+        s, _ = t.lookup_or_assign(k, T0)
+        t.set_expire(s, T0 + 60_000)
+    t.take_moves()
+    # One window: promote ka and kb (both slots pending-promo), then a
+    # miss forces the starved fallback.
+    sa, ea = t.lookup_or_assign("ka", T0)
+    sb, eb = t.lookup_or_assign("kb", T0)
+    assert ea and eb
+    se, ee = t.lookup_or_assign("ke", T0)
+    assert ee is False
+    pk, ps, pdst, ds, dd = t.take_moves()
+    # the evicted promo was cancelled (src -1), and no demo record may
+    # target a slot whose row never arrived
+    live_promos = [(int(k), int(s), int(d))
+                   for k, s, d in zip(pk, ps, pdst) if s >= 0]
+    assert len(live_promos) == 1, (pk, ps, pdst)
+    assert all(int(s) < 0 or int(dsl) != se for s, dsl in zip(ds, dd))
+    # the evicted promoted key lost its state (loss, not corruption)
+    _, e_again = t.lookup_or_assign(
+        "ka" if se == sa else "kb", T0
+    )
+    assert e_again is False
