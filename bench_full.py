@@ -243,10 +243,19 @@ def config4():
     _emit("4_amortized", batch * iters * 4, dt, shards=n_dev,
           broadcasts=syncs, sync_every=4)
     # Device-only cost of ONE sync collective + the window the
-    # GlobalManager auto-tuner would derive from it.
+    # GlobalManager auto-tuner would derive from it.  Measured on a
+    # FRESH same-shape store: measure_sync_cost_s refuses stores with
+    # live GLOBAL traffic (its raw timed syncs would drain their
+    # device-side hit accumulations without the host legs), and the
+    # collective's cost depends on g_capacity, not on which gslots are
+    # active — the program scans all of them every pass.
     from gubernator_tpu.service import GlobalManager
 
-    cost_s = store.measure_sync_cost_s(NOW + 10_000)
+    cal = MeshBucketStore(
+        capacity_per_shard=store.capacity_per_shard,
+        g_capacity=store.g_capacity,
+    )
+    cost_s = cal.measure_sync_cost_s(NOW + 10_000)
     g_active = max(len(store.gtable.active_gslots()), 1)
     print(
         json.dumps(
