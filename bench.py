@@ -173,23 +173,42 @@ def measure_device(jax, now, samples: int = 5):
         # ms-scale jitter — so the K spread must be large enough that
         # the differential signal (dK * per-batch cost) clears the
         # noise: dK=512 puts a 50 us/batch kernel at ~25 ms of signal.
+        # Round-4 shipped device_us_b256 = -33 us: tunnel weather can
+        # still underflow the differential.  Sample in rounds until the
+        # noise estimate (gap between the two fastest runs of each
+        # chain, in per-batch units) is < 20% of the point estimate,
+        # clamp at 0, and mark below-floor rows explicitly.
         times = {}
         k_pair = (8, 520)
+        fns = {}
         for K in k_pair:
-            fn = _chain(K)
-            sstate2, spk = fn(sstate, sbatch, srid)
+            fns[K] = _chain(K)
+            sstate, spk = fns[K](sstate, sbatch, srid)
             sync(spk)
-            s_samples = []
-            for _ in range(max(samples - 1, 2)):
-                t0 = time.perf_counter()
-                sstate2, spk = fn(sstate2, sbatch, srid)
-                sync(spk)
-                s_samples.append(time.perf_counter() - t0)
-            times[K] = s_samples
+            times[K] = []
         dk = k_pair[1] - k_pair[0]
-        per_batch = (min(times[k_pair[1]]) - min(times[k_pair[0]])) / dk
-        worst = (max(times[k_pair[1]]) - min(times[k_pair[0]])) / dk
-        small_batch_us[sb] = (per_batch * 1e6, worst * 1e6)
+        per_batch = worst = noise = 0.0
+        for _round in range(6):
+            for K in k_pair:
+                for _ in range(max(samples - 1, 2)):
+                    t0 = time.perf_counter()
+                    sstate, spk = fns[K](sstate, sbatch, srid)
+                    sync(spk)
+                    times[K].append(time.perf_counter() - t0)
+            lo_s = sorted(times[k_pair[0]])
+            hi_s = sorted(times[k_pair[1]])
+            per_batch = (hi_s[0] - lo_s[0]) / dk
+            worst = (hi_s[-1] - lo_s[0]) / dk
+            noise = ((hi_s[1] - hi_s[0]) + (lo_s[1] - lo_s[0])) / dk
+            if per_batch > 0 and noise < 0.2 * per_batch:
+                break
+        below_floor = per_batch <= 0 or noise >= per_batch
+        small_batch_us[sb] = (
+            max(per_batch, 0.0) * 1e6,
+            worst * 1e6,
+            below_floor,
+            noise * 1e6,
+        )
 
     # Single-dispatch completion latency distribution (dispatch ->
     # forced completion, minimal transfer).  On this host each sample
@@ -333,19 +352,26 @@ GATE_THRESHOLDS = "benchmarks/gate_thresholds.json"
 LAST_DEVICE_ROWS = "benchmarks/last_device_rows.json"
 
 
-def _save_device_rows(dev) -> None:
+def _save_device_rows(dev, extra=None) -> None:
     """Persist main()'s device rows so a follow-up `--gate` (the `make
     bench` sequence) can evaluate thresholds without re-paying the
     whole differential measurement on the tunnel."""
+    rows = {
+        "time": time.time(),
+        "device_batch_us": dev["device_batch_us"],
+        "device_us_b1024": dev["small_batch_us"][1024][0],
+        "device_us_b256": dev["small_batch_us"][256][0],
+        # Below-floor rows are excluded from gating: their point
+        # estimate is tunnel noise, not chip cost.
+        "below_floor": {
+            f"device_us_b{sb}": dev["small_batch_us"][sb][2]
+            for sb in (256, 1024)
+        },
+    }
+    if extra:
+        rows.update(extra)
     with open(LAST_DEVICE_ROWS, "w") as f:
-        json.dump(
-            {
-                "time": time.time(),
-                "device_batch_us": dev["device_batch_us"],
-                "device_us_b1024": dev["small_batch_us"][1024][0],
-            },
-            f,
-        )
+        json.dump(rows, f)
 
 
 def gate() -> int:
@@ -362,13 +388,16 @@ def gate() -> int:
     with open(GATE_THRESHOLDS) as f:
         thresholds = json.load(f)
     rows = None
+    below_floor = {}
     try:
         with open(LAST_DEVICE_ROWS) as f:
             saved = json.load(f)
         if time.time() - saved["time"] < 3600:
+            below_floor = saved.get("below_floor", {})
             rows = {
-                "device_batch_us": saved["device_batch_us"],
-                "device_us_b1024": saved["device_us_b1024"],
+                k: saved[k]
+                for k in thresholds
+                if k in saved and not below_floor.get(k, False)
             }
             print(f"gate: using rows from {LAST_DEVICE_ROWS}")
     except (OSError, KeyError, ValueError):
@@ -379,13 +408,31 @@ def gate() -> int:
         rows = {
             "device_batch_us": dev["device_batch_us"],
             "device_us_b1024": dev["small_batch_us"][1024][0],
+            "device_us_b256": dev["small_batch_us"][256][0],
         }
+        below_floor = {
+            f"device_us_b{sb}": dev["small_batch_us"][sb][2]
+            for sb in (256, 1024)
+        }
+        rows = {k: v for k, v in rows.items() if not below_floor.get(k, False)}
     failed = []
-    for name, value in rows.items():
-        limit = thresholds[name]["fail_above_us"]
-        ok = value <= limit
-        print(f"gate {name}: {value:.1f} us (fail above {limit:.1f}) "
-              f"{'PASS' if ok else 'FAIL'}")
+    for name, spec in thresholds.items():
+        if name.startswith("_"):
+            continue  # metadata keys (_comment, _updated)
+        value = rows.get(name)
+        if value is None:
+            why = ("below measurement floor"
+                   if below_floor.get(name) else "no fresh measurement")
+            print(f"gate {name}: SKIP ({why})")
+            continue
+        if "fail_above_us" in spec:
+            limit, ok = spec["fail_above_us"], value <= spec["fail_above_us"]
+            print(f"gate {name}: {value:.1f} us (fail above {limit:.1f}) "
+                  f"{'PASS' if ok else 'FAIL'}")
+        else:
+            limit, ok = spec["fail_below"], value >= spec["fail_below"]
+            print(f"gate {name}: {value:.1f} (fail below {limit:.1f}) "
+                  f"{'PASS' if ok else 'FAIL'}")
         if not ok:
             failed.append(name)
     if failed:
@@ -542,6 +589,10 @@ def main():
     svc_p50 = svc_lat[len(svc_lat) // 2] * 1000.0
     svc_p99 = svc_lat[min(len(svc_lat) - 1, int(len(svc_lat) * 0.99))] * 1000.0
     svc.close()
+    # Re-save with the ingress row so --gate covers an end-to-end
+    # service-path regression, not just the device kernel (round-4
+    # verdict: the headline regressed ungated across rounds).
+    _save_device_rows(dev, {"service_ingress_checks_per_sec": service_cps})
 
     # ---- secondary: request-object path ------------------------------
     def make_batch(salt):
@@ -594,10 +645,16 @@ def main():
                 "dispatch_batch_us_incl_tunnel": round(dispatch_batch_us, 1),
                 "device_us_b256": round(small_batch_us[256][0], 1),
                 "device_us_b256_worst": round(small_batch_us[256][1], 1),
+                "device_us_b256_below_floor": small_batch_us[256][2],
+                "device_us_b256_noise_us": round(small_batch_us[256][3], 1),
                 "device_us_b1024": round(small_batch_us[1024][0], 1),
                 "device_us_b1024_worst": round(small_batch_us[1024][1], 1),
+                "device_us_b1024_below_floor": small_batch_us[1024][2],
+                "device_us_b1024_noise_us": round(small_batch_us[1024][3], 1),
                 "device_us_b4096": round(small_batch_us[4096][0], 1),
                 "device_us_b4096_worst": round(small_batch_us[4096][1], 1),
+                "device_us_b4096_below_floor": small_batch_us[4096][2],
+                "device_us_b4096_noise_us": round(small_batch_us[4096][3], 1),
                 "dispatch_latency_ms_p50": round(dispatch_p50, 2),
                 "dispatch_latency_ms_p99": round(dispatch_p99, 2),
                 "dispatch_latency_includes_tunnel_rtt": True,

@@ -281,7 +281,7 @@ def config5():
     HTTP edge — the reference's loopback-cluster benchmark topology
     (benchmark_test.go ThunderingHeard + cluster/cluster.go)."""
     from gubernator_tpu.client import V1Client
-    from gubernator_tpu.cluster import Cluster
+    from gubernator_tpu.cluster import Cluster, fast_test_behaviors
     from gubernator_tpu.types import (
         Algorithm,
         Behavior,
@@ -289,7 +289,17 @@ def config5():
         RateLimitRequest,
     )
 
-    cl = Cluster().start_with(["", "", "dc-east", "dc-east"])
+    # Deployment-tuned peer deadline: each peer-forward leg waits on a
+    # device round that costs 100-400ms through the TPU tunnel (vs
+    # single-digit ms locally attached), and a 100-way storm stacks
+    # several rounds of queueing on top.  With the default 5s deadline
+    # ~half the forwarded lanes die as DEADLINE_EXCEEDED *error
+    # responses* — which earlier rounds silently counted as throughput
+    # (round-4's 1,217 number).  Errors are now counted separately and
+    # excluded from the headline.
+    beh = fast_test_behaviors()
+    beh.batch_timeout_s = 30.0
+    cl = Cluster().start_with(["", "", "dc-east", "dc-east"], behaviors=beh)
     try:
         # Generous timeout: the first batch shape pays its jit compile.
         clients = [V1Client(d.gateway.address, timeout_s=120.0) for d in cl.daemons]
@@ -321,15 +331,21 @@ def config5():
         import threading as _th
 
         N_STORM = 100
-        totals = [0, 0]
+        totals = [0, 0, 0]  # ok lanes, over_limit, error lanes
         lock = _th.Lock()
 
         def _storm(i, b):
             resp = clients[i % len(clients)].get_rate_limits(b)
-            o = sum(r.status == 1 for r in resp.responses)
+            o = e = 0
+            for r in resp.responses:
+                if r.error:
+                    e += 1
+                elif r.status == 1:
+                    o += 1
             with lock:
-                totals[0] += len(resp.responses)
+                totals[0] += len(resp.responses) - e
                 totals[1] += o
+                totals[2] += e
 
         # Untimed concurrent warm epoch: 100-way coalescing produces
         # pad shapes the serial warm loop never dispatches, and a cold
@@ -343,7 +359,7 @@ def config5():
             t.start()
         for t in warm_ts:
             t.join()
-        totals[0] = totals[1] = 0
+        totals[0] = totals[1] = totals[2] = 0
         t0 = time.perf_counter()
         ts = [
             _th.Thread(target=_storm, args=(i, batches[i % len(batches)]))
@@ -354,8 +370,12 @@ def config5():
         for t in ts:
             t.join()
         dt = time.perf_counter() - t0
+        # Headline counts only non-error lanes; error_lanes must be 0
+        # for the number to stand (the reference's bench never counts
+        # failed requests as served traffic).
         _emit(5, totals[0], dt, regions=2, daemons=len(cl.daemons),
-              over_limit=totals[1], concurrency=len(ts))
+              over_limit=totals[1], error_lanes=totals[2],
+              concurrency=len(ts))
 
         # Plain storm (no MULTI_REGION): max-size batches of locally-mixed
         # keys through ONE daemon's gateway — the columnar ingress path

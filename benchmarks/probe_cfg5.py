@@ -1,0 +1,199 @@
+"""Instrumented cfg5 repro: WHERE does the 100-way MULTI_REGION storm
+spend its time?  (VERDICT r4: 1,217 checks/s = 0.6x baseline, the one
+losing number.)
+
+Counts device dispatches, peer RPCs, error lanes, and CPU vs wall time
+for the storm epoch.  Run on the tunnel chip (default) or --cpu.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--clients", type=int, default=100)
+    ap.add_argument("--batch", type=int, default=512)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache_cpu")
+    else:
+        jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from gubernator_tpu.client import V1Client
+    from gubernator_tpu.cluster import Cluster
+    from gubernator_tpu.parallel.mesh import MeshBucketStore
+    from gubernator_tpu.peer_client import PeerClient
+    from gubernator_tpu.types import (
+        Algorithm,
+        Behavior,
+        GetRateLimitsRequest,
+        RateLimitRequest,
+    )
+
+    counters = {
+        "dispatch_columns": 0,
+        "dispatch_lanes": 0,
+        "apply_dataclass": 0,
+        "peer_rpcs": 0,
+        "peer_rpc_lanes": 0,
+        "peer_rpc_time_s": 0.0,
+    }
+    clock = {"on": False}
+    lk = threading.Lock()
+
+    orig_async = MeshBucketStore.apply_columns_async
+    orig_apply = MeshBucketStore.apply
+    orig_rpc = PeerClient.get_peer_rate_limits
+
+    def wrap_async(self, keys, *a, **kw):
+        if clock["on"]:
+            with lk:
+                counters["dispatch_columns"] += 1
+                counters["dispatch_lanes"] += len(keys)
+        return orig_async(self, keys, *a, **kw)
+
+    def wrap_apply(self, reqs, *a, **kw):
+        if clock["on"]:
+            with lk:
+                counters["apply_dataclass"] += 1
+                counters["dispatch_lanes"] += len(reqs)
+        return orig_apply(self, reqs, *a, **kw)
+
+    def wrap_rpc(self, req, *a, **kw):
+        t0 = time.perf_counter()
+        try:
+            return orig_rpc(self, req, *a, **kw)
+        finally:
+            if clock["on"]:
+                with lk:
+                    counters["peer_rpcs"] += 1
+                    counters["peer_rpc_lanes"] += len(req.requests)
+                    counters["peer_rpc_time_s"] += time.perf_counter() - t0
+
+    MeshBucketStore.apply_columns_async = wrap_async
+    MeshBucketStore.apply = wrap_apply
+    PeerClient.get_peer_rate_limits = wrap_rpc
+
+    from gubernator_tpu.cluster import fast_test_behaviors
+
+    beh = fast_test_behaviors()
+    beh.batch_timeout_s = 30.0
+    cl = Cluster().start_with(["", "", "dc-east", "dc-east"], behaviors=beh)
+    try:
+        clients = [V1Client(d.gateway.address, timeout_s=120.0) for d in cl.daemons]
+        rng = np.random.RandomState(5)
+        batches = []
+        for _ in range(8):
+            batches.append(
+                GetRateLimitsRequest(
+                    requests=[
+                        RateLimitRequest(
+                            name="c5",
+                            unique_key=f"storm{rng.randint(16)}",
+                            hits=5,
+                            limit=10,
+                            duration=60_000,
+                            algorithm=Algorithm.TOKEN_BUCKET,
+                            behavior=Behavior.MULTI_REGION,
+                        )
+                        for _ in range(args.batch)
+                    ]
+                )
+            )
+        for c in clients:
+            c.get_rate_limits(batches[0])
+
+        N = args.clients
+        totals = [0, 0, 0]  # responses, over_limit, errors
+        lats = []
+        tlock = threading.Lock()
+
+        err_samples = {}
+
+        def _storm(i, b):
+            t0 = time.perf_counter()
+            resp = clients[i % len(clients)].get_rate_limits(b)
+            dt = time.perf_counter() - t0
+            o = sum(r.status == 1 for r in resp.responses)
+            e = 0
+            for r in resp.responses:
+                if r.error:
+                    e += 1
+                    with tlock:
+                        key = r.error[:120]
+                        err_samples[key] = err_samples.get(key, 0) + 1
+            with tlock:
+                totals[0] += len(resp.responses)
+                totals[1] += o
+                totals[2] += e
+                lats.append(dt)
+
+        warm = [
+            threading.Thread(target=_storm, args=(i, batches[i % len(batches)]))
+            for i in range(N)
+        ]
+        for t in warm:
+            t.start()
+        for t in warm:
+            t.join()
+        totals[0] = totals[1] = totals[2] = 0
+        lats.clear()
+
+        clock["on"] = True
+        cpu0 = time.process_time()
+        t0 = time.perf_counter()
+        ts = [
+            threading.Thread(target=_storm, args=(i, batches[i % len(batches)]))
+            for i in range(N)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        wall = time.perf_counter() - t0
+        cpu = time.process_time() - cpu0
+        clock["on"] = False
+
+        lats.sort()
+        print(
+            json.dumps(
+                {
+                    "checks_per_sec": round(totals[0] / wall, 1),
+                    "wall_s": round(wall, 2),
+                    "process_cpu_s": round(cpu, 2),
+                    "responses": totals[0],
+                    "over_limit": totals[1],
+                    "error_lanes": totals[2],
+                    "storm_lat_s_p50": round(lats[len(lats) // 2], 2),
+                    "storm_lat_s_max": round(lats[-1], 2),
+                    **{k: (round(v, 2) if isinstance(v, float) else v)
+                       for k, v in counters.items()},
+                    "error_kinds": dict(
+                        sorted(err_samples.items(), key=lambda kv: -kv[1])[:6]
+                    ),
+                },
+                indent=1,
+            ),
+            flush=True,
+        )
+    finally:
+        cl.stop()
+
+
+if __name__ == "__main__":
+    main()

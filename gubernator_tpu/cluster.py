@@ -45,9 +45,13 @@ class Cluster:
         clock: Optional[Clock] = None,
         cache_size: int = 4096,
         g_capacity: int = 256,
+        behaviors: Optional[BehaviorConfig] = None,
     ) -> "Cluster":
         """cluster/cluster.go:96-131: spawn every daemon, then feed the
-        full converged peer list to all of them."""
+        full converged peer list to all of them.  `behaviors` overrides
+        the shortened test windows (e.g. benchmarks on a tunnel-attached
+        device need peer RPC deadlines sized to its 100-400ms rounds,
+        the same GUBER_BATCH_TIMEOUT tuning a real deployment does)."""
         for dc in data_centers:
             conf = DaemonConfig(
                 listen_address="127.0.0.1:0",
@@ -55,7 +59,7 @@ class Cluster:
                 cache_size=cache_size,
                 global_cache_size=g_capacity,
                 data_center=dc,
-                behaviors=fast_test_behaviors(),
+                behaviors=behaviors or fast_test_behaviors(),
                 peer_discovery_type="static",
             )
             d = Daemon(conf, clock=clock).start()

@@ -65,7 +65,10 @@ class DaemonConfig:
     advertise_address: str = ""
     cache_size: int = 50_000
     back_cache_size: int = 0  # two-tier back tier (0 = single-tier)
-    global_cache_size: int = 4096
+    # None = auto-size to cache_size, clamped [4096, 65536] (the
+    # reference caps GLOBAL keys only by its shared cache,
+    # global.go:83-91).  See ServiceConfig.global_cache_size.
+    global_cache_size: "int | None" = None
     data_center: str = ""
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
     # Static peer list (the zero-dependency discovery mode; etcd/
@@ -211,9 +214,9 @@ def setup_daemon_config(
     conf.back_cache_size = _env_int(
         merged, "GUBER_BACK_CACHE_SIZE", conf.back_cache_size
     )
-    conf.global_cache_size = _env_int(
-        merged, "GUBER_GLOBAL_CACHE_SIZE", conf.global_cache_size
-    )
+    v = merged.get("GUBER_GLOBAL_CACHE_SIZE", "")
+    if v:
+        conf.global_cache_size = int(v)
     conf.data_center = merged.get("GUBER_DATA_CENTER", "")
     if merged.get("GUBER_WARMUP_SHAPES"):
         conf.warmup_shapes = [

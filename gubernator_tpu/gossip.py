@@ -56,6 +56,19 @@ LEFT = "left"
 MAX_PIGGYBACK = 8
 RETRANSMIT = 5
 
+# Gossip wire version, stamped on every UDP packet and push-pull frame.
+# INTEROP CONTRACT (see README "Peer discovery"): this JSON/UDP wire is
+# NOT hashicorp/memberlist-compatible — a node here cannot join a
+# reference cluster's port-7946 gossip (memberlist.go:68-151 uses
+# msgpack framing + gob/JSON node meta).  Membership migration between
+# the two therefore goes through the static/etcd/k8s backends, not
+# mixed gossip.  Within THIS wire, compatibility is by tolerance:
+# receivers ignore unknown top-level message types, unknown update
+# states, and unknown fields (version skew between nodes must never
+# break membership — pinned by tests/test_gossip.py version-skew tests).
+# Bump only for semantic changes; never gate handling on an exact match.
+WIRE_VERSION = 1
+
 
 @dataclass
 class Member:
@@ -245,7 +258,7 @@ class Gossip:
     # Wire helpers
     # ------------------------------------------------------------------
     def _send(self, addr: Tuple[str, int], msg: dict) -> None:
-        msg = dict(msg)
+        msg = dict(msg, v=WIRE_VERSION)
         with self._lock:
             gossip = []
             for entry in self._piggyback[:MAX_PIGGYBACK]:
@@ -475,7 +488,9 @@ class Gossip:
     def _push_pull(self, addr: Tuple[str, int]) -> None:
         with socket.create_connection(addr, timeout=2.0) as sock:
             f = sock.makefile("rw", encoding="utf-8")
-            f.write(json.dumps({"t": "push-pull", "m": self._state_snapshot()}) + "\n")
+            f.write(json.dumps(
+                {"t": "push-pull", "v": WIRE_VERSION, "m": self._state_snapshot()}
+            ) + "\n")
             f.flush()
             line = f.readline()
         if line:

@@ -1120,8 +1120,9 @@ void gt_mesh_finish_narrow(void* mpv, const int32_t* packed, int64_t now_ms,
         reset_time[orig] = (int64_t)d2 + now_ms;
       }
       int32_t d3 = row3[j];
-      // -1 keeps the host expire (commit skips negatives); -2 decodes
-      // to -1 for the same reason (unpack_output32 semantics).
+      // -1 decodes to absolute 0 (removed/no-reset; commit_plan WRITES
+      // expire_ms=0); -2 decodes to -1 so commit_plan skips the
+      // already-correct host value (unpack_output32 parity).
       ne[j] = (d3 == -1) ? 0 : (d3 == -2 ? -1 : (int64_t)d3 + now_ms);
     }
     gt_batch_commit_plan(b, ne.data(), rm.data());

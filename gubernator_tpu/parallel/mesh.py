@@ -301,6 +301,10 @@ class SyncResult:
 
     broadcasts: List[UpdatePeerGlobal] = field(default_factory=list)
     remote_hits: List[RateLimitRequest] = field(default_factory=list)
+    # False only for the empty early return (no active gslots, nothing
+    # dirty): such passes never ran the collective, so observers tuning
+    # windows from sync cost must ignore them.
+    did_work: bool = True
 
     @property
     def broadcast_count(self) -> int:
@@ -921,15 +925,18 @@ class MeshBucketStore(ColumnarPipeline):
         import time as _time
 
         t0 = _time.perf_counter()
-        try:
-            return self._sync_globals_locked(now_ms)
-        finally:
+        res = self._sync_globals_locked(now_ms)
+        if res.did_work:
+            # No-work passes (empty early return) cost ~0 and would pin
+            # a min-of-N window estimator at its floor; only passes that
+            # ran the collective are valid sync-cost observations.
             self.last_sync_cost_s = _time.perf_counter() - t0
+        return res
 
     def _sync_globals_locked(self, now_ms: int) -> "SyncResult":
         active = self.gtable.active_gslots()
         if not active and not self.dirty.any():
-            return SyncResult()
+            return SyncResult(did_work=False)
 
         # Resolve each GLOBAL key's slot in its owner shard's table.
         # Assigning one key can evict another's slot under capacity

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Sequence
@@ -66,7 +67,16 @@ class ServiceConfig:
     # extra slots (total capacity = cache_size + back_cache_size; the
     # small front absorbs every kernel scatter, see MeshBucketStore).
     back_cache_size: int = 0
-    global_cache_size: int = 4096
+    # GLOBAL replica-table capacity (gslots).  None = auto-size to the
+    # bucket-table capacity (clamped [4096, 65536]): the reference has
+    # NO separate GLOBAL key cap — GLOBAL keys share its 50k cache
+    # (global.go:83-91) — so a working set that fits the cache must fit
+    # the replica table.  The sync collective scans every gslot each
+    # pass (cost is linear in this capacity, ~us/gslot; see
+    # benchmarks/RESULTS.md "GLOBAL capacity" row), and the auto-tuned
+    # GlobalSyncWait stretches to keep that overhead ≤10%, so
+    # convergence lag grows with the capacity you provision.
+    global_cache_size: Optional[int] = None
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
     advertise_address: str = ""
     data_center: str = ""
@@ -211,10 +221,24 @@ class ColumnarBatcher:
     (ColumnarPipeline).  NO_BATCHING batches bypass the window."""
 
     MAX_SUBMISSIONS = 64  # x 1000-lane cap each = device batch <= 64k lanes
+    # Overload backstop, NOT a pacing gate: the flush worker only blocks
+    # when this many of ITS OWN dispatches are unresolved.  Round-5
+    # probes showed a tight gate (depth 2) is actively harmful on a
+    # high-latency device — flushes queue behind multi-100ms rounds and
+    # forwarded peers blow their 5s RPC deadline — while the 500us
+    # window already coalesces a 100-way storm into ~14 dispatches.  At
+    # depth 8 the gate never fires in steady state; it only stops a
+    # pathological pileup (arrival rate >> device rate for seconds).
+    MAX_INFLIGHT = 8
 
     def __init__(self, store, behaviors: BehaviorConfig, clock: Clock):
         self.store = store
         self.clock = clock
+        self._own_inflight: "deque" = deque()
+        # _flush can run concurrently in edge cases (worker stuck past
+        # stop()'s join timeout while the stop/post-stop-submit drain
+        # flushes from another thread) — the backstop deque needs a lock.
+        self._inflight_lock = threading.Lock()
         self._window = BatchWindow(
             self._flush, behaviors.batch_wait_s, self.MAX_SUBMISSIONS
         )
@@ -235,6 +259,19 @@ class ColumnarBatcher:
 
     def _flush(self, batch) -> None:
         try:
+            # Overload backstop (see MAX_INFLIGHT): block on the oldest
+            # unresolved dispatch only when the pipeline is pathologically
+            # deep.  Submissions queue behind the wait, so the next flush
+            # merges them.  (Waiters resolve handles concurrently; `done`
+            # flips as they do, and result() is idempotent/thread-safe.)
+            oldest = None
+            with self._inflight_lock:
+                while self._own_inflight and self._own_inflight[0].done:
+                    self._own_inflight.popleft()
+                if len(self._own_inflight) >= self.MAX_INFLIGHT:
+                    oldest = self._own_inflight.popleft()
+            if oldest is not None:
+                oldest.result()
             if len(batch) == 1:
                 (cols, fut) = batch[0]
                 keys = cols[0]
@@ -259,6 +296,8 @@ class ColumnarBatcher:
                 keys, algo, beh, hits, limit, duration,
                 self.clock.now_ms(), ge, gd,
             )
+            with self._inflight_lock:
+                self._own_inflight.append(handle)
             lo = 0
             for (c, fut) in batch:
                 hi = lo + len(c[0])
@@ -281,12 +320,21 @@ class V1Service:
         self.metrics = conf.metrics or Metrics()
         self.store = conf.store or MeshBucketStore(
             capacity_per_shard=max(conf.cache_size // _n_local_devices(conf.devices), 1),
-            g_capacity=conf.global_cache_size,
+            g_capacity=(
+                conf.global_cache_size
+                if conf.global_cache_size is not None
+                else min(max(4096, conf.cache_size), 65536)
+            ),
             devices=conf.devices,
             store=conf.persist_store,
-            back_capacity_per_shard=max(
-                conf.back_cache_size // _n_local_devices(conf.devices), 0
-            ),
+            # Ceil division: any nonzero back_cache_size must enable the
+            # back tier (flooring to 0 on small-config/many-device hosts
+            # silently disabled two-tier with no signal).
+            back_capacity_per_shard=-(
+                -conf.back_cache_size // _n_local_devices(conf.devices)
+            )
+            if conf.back_cache_size > 0
+            else 0,
         )
         self.local_picker = conf.local_picker or ReplicatedConsistentHash()
         self.region_picker = conf.region_picker or RegionPicker()
@@ -1011,9 +1059,10 @@ class GlobalManager:
         # auto window ~10x (it pinned cfg6's window at the 1s cap on
         # the contended CPU host).  Fall back to wall time only for
         # stores that don't report.
-        self._last_sync_cost_s = getattr(
-            svc.store, "last_sync_cost_s", None
-        ) or (time.perf_counter() - t0)
+        cost = getattr(svc.store, "last_sync_cost_s", None)
+        self._last_sync_cost_s = (
+            cost if cost is not None else (time.perf_counter() - t0)
+        )
         if res.remote_hits:
             start = time.perf_counter()
             by_owner: Dict[str, List[RateLimitRequest]] = {}

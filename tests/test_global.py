@@ -231,3 +231,26 @@ def test_configured_sync_wait_disables_autotune():
         assert svc.global_mgr.sync_wait_s == 0.05
     finally:
         svc.close()
+
+
+def test_global_cache_auto_sizes_to_bucket_capacity():
+    """Unset global_cache_size auto-sizes the replica table to the
+    bucket-table capacity, clamped [4096, 65536] — the reference has no
+    separate GLOBAL key cap (GLOBAL keys share its cache,
+    global.go:83-91), so a working set that fits the cache must fit the
+    replica table.  An explicit setting still wins."""
+    from gubernator_tpu.service import ServiceConfig, V1Service
+
+    for cache, explicit, want in (
+        (256, None, 4096),        # clamp floor
+        (20_000, None, 20_000),   # match capacity
+        (500_000, None, 65_536),  # clamp ceiling
+        (20_000, 512, 512),       # explicit wins
+    ):
+        svc = V1Service(ServiceConfig(
+            cache_size=cache, global_cache_size=explicit,
+        ))
+        try:
+            assert svc.store.g_capacity == want, (cache, explicit, want)
+        finally:
+            svc.close()

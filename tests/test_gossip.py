@@ -254,3 +254,106 @@ def test_leave_reaches_every_member_in_large_cluster():
         node.close()
         for s in socks:
             s.close()
+
+
+class TestVersionSkew:
+    """Wire-tolerance contract (gossip.py WIRE_VERSION): a NEWER node
+    may stamp a higher version, add fields to updates, introduce new
+    message types, or gossip new member states — an older node must
+    ignore what it doesn't know and keep the membership converging.
+    This is the rolling-upgrade story the hashicorp wire gets from its
+    protocol-version range; here it is by-construction JSON tolerance,
+    and these tests pin it so a future field addition can't break it."""
+
+    def test_future_wire_fields_and_types_ignored(self):
+        import json
+        import socket
+
+        node = make_node("skew0")
+        try:
+            # A "v2" peer announces itself: higher version stamp, extra
+            # unknown fields at every level, plus an unknown message
+            # type in the same packet stream.
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            addr = ("127.0.0.1", node.port)
+            s.sendto(json.dumps({"t": "mesh-scan", "v": 2, "depth": 3}).encode(), addr)
+            s.sendto(
+                json.dumps(
+                    {
+                        "t": "ping",
+                        "v": 2,
+                        "seq": 7,
+                        "hmac": "ab12",  # unknown field
+                        "g": [
+                            {
+                                "s": "alive",
+                                "name": "future-node",
+                                "addr": ["127.0.0.1", port],
+                                "inc": 1,
+                                "meta": {"grpc_address": "127.0.0.1:9"},
+                                "shard_epoch": 42,  # unknown field
+                            },
+                            # Unknown state: must be skipped, not crash.
+                            {"s": "draining", "name": "x", "addr": ["127.0.0.1", 1], "inc": 1},
+                        ],
+                    }
+                ).encode(),
+                addr,
+            )
+            # The ping must still be acked (v2 stamp didn't spook v1)...
+            s.settimeout(2.0)
+            data, _ = s.recvfrom(65536)
+            msg = json.loads(data.decode())
+            assert msg["t"] == "ack" and msg["seq"] == 7
+            # ...and the alive update (with its unknown extras) landed.
+            wait_until(
+                lambda: any(m.name == "future-node" for m in node.members()),
+                msg="future-node joined membership",
+            )
+            assert not any(m.name == "x" for m in node.members())
+        finally:
+            node.close()
+            s.close()
+
+    def test_old_node_without_version_stamp_accepted(self):
+        """The inverse skew: a pre-WIRE_VERSION packet (no "v" key at
+        all) is still handled — receivers never require the stamp."""
+        import json
+        import socket
+
+        node = make_node("skew1")
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.bind(("127.0.0.1", 0))
+            s.settimeout(2.0)
+            s.sendto(
+                json.dumps({"t": "ping", "seq": 3}).encode(),
+                ("127.0.0.1", node.port),
+            )
+            data, _ = s.recvfrom(65536)
+            msg = json.loads(data.decode())
+            assert msg["t"] == "ack" and msg["seq"] == 3
+        finally:
+            node.close()
+            s.close()
+
+    def test_push_pull_tolerates_future_state_entries(self):
+        """Anti-entropy with a newer node: unknown states / extra keys
+        inside the TCP push-pull state dump are skipped lane-wise."""
+        node = make_node("skew2")
+        try:
+            node.merge_state(
+                [
+                    {"s": "alive", "name": "ok-node", "addr": ["127.0.0.1", 5],
+                     "inc": 1, "meta": {}, "zone": "z1"},
+                    {"s": "quarantined", "name": "weird", "addr": ["127.0.0.1", 6],
+                     "inc": 1},
+                    {"bogus": True},
+                ]
+            )
+            assert any(m.name == "ok-node" for m in node.members())
+            assert not any(m.name == "weird" for m in node.members())
+        finally:
+            node.close()
