@@ -348,6 +348,79 @@ def measure_device_zipf(jax, now, samples: int = 5):
     }
 
 
+def measure_service_ingress(n_threads: int = 32, svc_iters: int = 10,
+                            n_keys: int = 100_000):
+    """The full V1Service request path (validation, ownership routing,
+    metrics, 1000-item cap — gubernator.go:116-227) fed by
+    get_rate_limits_columns: what the gateway/gRPC edges execute per
+    multi-item request.  Batches are capped at 1000 (reference parity),
+    so throughput comes from concurrent clients pipelining through the
+    ColumnarPipeline locks; on the tunnel each batch pays one ~120ms
+    readback, so 32 concurrent callers keep the pipeline deep enough
+    that the host cost is the measured ceiling (the reference benches
+    100-way, benchmark_test.go:117).  Shared by main() and the --gate
+    fallback so the ingress threshold is evaluable standalone.
+    Returns (checks_per_sec, p50_ms, p99_ms)."""
+    import threading
+
+    from gubernator_tpu.service import IngressColumns, ServiceConfig, V1Service
+    from gubernator_tpu.types import PeerInfo
+
+    svc = V1Service(ServiceConfig(cache_size=131_072))
+    svc.set_peers([PeerInfo(grpc_address="127.0.0.1:1", is_owner=True)])
+    svc_batch = 1000
+
+    def svc_cols(tid, i):
+        # RandomState is not thread-safe: derive ids deterministically.
+        ids = (np.arange(svc_batch) * 2654435761 + tid * 97 + i) % n_keys
+        return IngressColumns(
+            names=["bench"] * svc_batch,
+            unique_keys=[f"s{tid}:{k}" for k in ids],
+            algorithm=(ids % 2).astype(np.int32),
+            behavior=np.zeros(svc_batch, np.int32),
+            hits=np.ones(svc_batch, np.int64),
+            limit=np.full(svc_batch, 1_000_000, np.int64),
+            duration=np.full(svc_batch, 3_600_000, np.int64),
+        )
+
+    svc.get_rate_limits_columns(svc_cols(0, 0))  # warm the 1024-pad shape
+    svc_lat: list = []
+    svc_lock = threading.Lock()
+
+    def svc_worker(tid):
+        lats = []
+        for i in range(svc_iters):
+            cols = svc_cols(tid, i)
+            t_b = time.perf_counter()
+            svc.get_rate_limits_columns(cols)
+            lats.append(time.perf_counter() - t_b)
+        with svc_lock:
+            svc_lat.extend(lats)
+
+    def svc_epoch():
+        ts = [threading.Thread(target=svc_worker, args=(t,)) for t in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+    # Untimed warm epoch: coalesced flush sizes hit pad buckets whose
+    # FIRST dispatch pays a multi-second executable load on a remote
+    # device (a long-running daemon warms these at startup,
+    # GUBER_WARMUP_SHAPES); measure steady state.
+    svc_epoch()
+    svc_lat.clear()
+    t0 = time.perf_counter()
+    svc_epoch()
+    svc_dt = time.perf_counter() - t0
+    service_cps = svc_batch * svc_iters * n_threads / svc_dt
+    svc_lat.sort()
+    svc_p50 = svc_lat[len(svc_lat) // 2] * 1000.0
+    svc_p99 = svc_lat[min(len(svc_lat) - 1, int(len(svc_lat) * 0.99))] * 1000.0
+    svc.close()
+    return service_cps, svc_p50, svc_p99
+
+
 GATE_THRESHOLDS = "benchmarks/gate_thresholds.json"
 LAST_DEVICE_ROWS = "benchmarks/last_device_rows.json"
 
@@ -405,10 +478,12 @@ def gate() -> int:
     if rows is None:
         jax = _jax_setup()
         dev = measure_device(jax, 1_700_000_000_000, samples=6)
+        ingress_cps, _, _ = measure_service_ingress()
         rows = {
             "device_batch_us": dev["device_batch_us"],
             "device_us_b1024": dev["small_batch_us"][1024][0],
             "device_us_b256": dev["small_batch_us"][256][0],
+            "service_ingress_checks_per_sec": ingress_cps,
         }
         below_floor = {
             f"device_us_b{sb}": dev["small_batch_us"][sb][2]
@@ -519,76 +594,7 @@ def main():
     dispatch_p99 = dev["dispatch_p99"]
 
     # ---- service-tier columnar ingress -------------------------------
-    # The full V1Service request path (validation, ownership routing,
-    # metrics, 1000-item cap — gubernator.go:116-227) fed by
-    # get_rate_limits_columns: what the gateway/gRPC edges execute per
-    # multi-item request.  Batches are capped at 1000 (reference
-    # parity), so throughput comes from concurrent clients pipelining
-    # through the ColumnarPipeline locks.
-    import threading
-
-    from gubernator_tpu.service import IngressColumns, ServiceConfig, V1Service
-    from gubernator_tpu.types import PeerInfo
-
-    svc = V1Service(ServiceConfig(cache_size=131_072))
-    svc.set_peers([PeerInfo(grpc_address="127.0.0.1:1", is_owner=True)])
-    svc_batch = 1000
-    svc_iters = 10
-    # Throughput here is in-flight-depth x 1/RTT on the tunnel (each
-    # batch pays one ~120ms readback); 32 concurrent callers keep the
-    # pipeline deep enough that the host cost, not the RTT, is the
-    # measured ceiling (the reference benches with 100-way fanout,
-    # benchmark_test.go:117).
-    n_threads = 32
-
-    def svc_cols(tid, i):
-        # RandomState is not thread-safe: derive ids deterministically.
-        ids = (np.arange(svc_batch) * 2654435761 + tid * 97 + i) % n_keys
-        return IngressColumns(
-            names=["bench"] * svc_batch,
-            unique_keys=[f"s{tid}:{k}" for k in ids],
-            algorithm=(ids % 2).astype(np.int32),
-            behavior=np.zeros(svc_batch, np.int32),
-            hits=np.ones(svc_batch, np.int64),
-            limit=np.full(svc_batch, 1_000_000, np.int64),
-            duration=np.full(svc_batch, 3_600_000, np.int64),
-        )
-
-    svc.get_rate_limits_columns(svc_cols(0, 0))  # warm the 1024-pad shape
-    svc_lat: list = []
-    svc_lock = threading.Lock()
-
-    def svc_worker(tid):
-        lats = []
-        for i in range(svc_iters):
-            cols = svc_cols(tid, i)
-            t_b = time.perf_counter()
-            svc.get_rate_limits_columns(cols)
-            lats.append(time.perf_counter() - t_b)
-        with svc_lock:
-            svc_lat.extend(lats)
-
-    def svc_epoch():
-        ts = [threading.Thread(target=svc_worker, args=(t,)) for t in range(n_threads)]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join()
-
-    # Untimed warm epoch: coalesced flush sizes hit pad buckets whose
-    # FIRST dispatch pays a multi-second executable load on a remote
-    # device (a long-running daemon warms these at startup,
-    # GUBER_WARMUP_SHAPES); measure steady state.
-    svc_epoch()
-    svc_lat.clear()
-    t0 = time.perf_counter()
-    svc_epoch()
-    svc_dt = time.perf_counter() - t0
-    service_cps = svc_batch * svc_iters * n_threads / svc_dt
-    svc_lat.sort()
-    svc_p50 = svc_lat[len(svc_lat) // 2] * 1000.0
-    svc_p99 = svc_lat[min(len(svc_lat) - 1, int(len(svc_lat) * 0.99))] * 1000.0
-    svc.close()
+    service_cps, svc_p50, svc_p99 = measure_service_ingress()
     # Re-save with the ingress row so --gate covers an end-to-end
     # service-path regression, not just the device kernel (round-4
     # verdict: the headline regressed ungated across rounds).

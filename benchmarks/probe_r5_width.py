@@ -1,0 +1,133 @@
+"""Round-5 probe: does scatter ROW WIDTH price the hot-row commit?
+
+Round 4 established the scatter floor (131k-row RMW into [262k, 8] i32
+~2.75 ms) and killed masking/compaction/sorting as levers.  Width was
+never isolated — the only datapoint is [C,16] costing ~6x [C,8] at 2M
+slots, which suggests a steep width curve.  If [C,4] RMW is ~2x
+cheaper, splitting the hot row (flags/remaining/expire in [C,4];
+stamp+rem_hi in a second [C,4] written only by leaky/wide lanes) beats
+the current single [C,8] on mixed traffic and wins ~big on token-only
+traffic.
+
+Differential dK chaining (K=4 vs 68) so tunnel RTT cancels; every
+variant's chained state is mutation-checked against DCE.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+C = 262_144
+B = 131_072
+K_LO, K_HI = 4, 68
+SAMPLES = 5
+
+rng = np.random.RandomState(7)
+idx_np = rng.choice(C, size=B, replace=False).astype(np.int32)
+# "leaky half": every other write lane also hits the aux table
+aux_idx_np = np.where(np.arange(B) % 2 == 0, idx_np, C + 10).astype(np.int32)
+
+_ = np.asarray(jnp.zeros((1,), jnp.int32))  # honest-timing mode
+
+
+def chain(body, K):
+    @jax.jit
+    def run(state, idx, aux_idx):
+        def f(i, st):
+            return jax.lax.optimization_barrier(body(st, i, idx, aux_idx))
+
+        return jax.lax.fori_loop(0, K, f, state)
+
+    return run
+
+
+def measure(name, body, state0, check=None):
+    ts = {}
+    for K in (K_LO, K_HI):
+        fn = chain(body, K)
+        st = fn(state0, jnp.asarray(idx_np), jnp.asarray(aux_idx_np))
+        np.asarray(jax.tree_util.tree_leaves(st)[0].ravel()[:1])  # drain
+        best = float("inf")
+        for _ in range(SAMPLES):
+            t0 = time.perf_counter()
+            st = fn(st, jnp.asarray(idx_np), jnp.asarray(aux_idx_np))
+            np.asarray(jax.tree_util.tree_leaves(st)[0].ravel()[:1])
+            best = min(best, time.perf_counter() - t0)
+        ts[K] = best
+        if check is not None:
+            check(st, K)
+    us = (ts[K_HI] - ts[K_LO]) / (K_HI - K_LO) * 1e6
+    print(f"{name:44s} {us:9.1f} us/batch", flush=True)
+    return us
+
+
+def rmw_width(width):
+    def body(st, i, idx, aux_idx):
+        rows = st[idx]
+        rows = rows + 1
+        return st.at[idx].set(rows, mode="drop")
+
+    return body
+
+
+def rmw_split(st, i, idx, aux_idx):
+    t1, t2 = st
+    r1 = t1[idx] + 1
+    t1 = t1.at[idx].set(r1, mode="drop")
+    r2 = t2[jnp.clip(aux_idx, 0, C - 1)] + 1
+    t2 = t2.at[aux_idx].set(r2, mode="drop")
+    return (t1, t2)
+
+
+def main():
+    for width in (8, 4, 2):
+        st = jnp.zeros((C, width), jnp.int32)
+
+        def check(s, K, w=width):
+            # DCE check: every indexed row must have advanced by K per run
+            v = int(np.asarray(s[idx_np[0], 0]))
+            assert v > 0, (w, v)
+
+        measure(f"rmw [{C},{width}] 131k rows", rmw_width(width), st, check)
+
+    st2 = (jnp.zeros((C, 4), jnp.int32), jnp.zeros((C, 4), jnp.int32))
+    measure("split: rmw [C,4] all + [C,4] half", rmw_split, st2)
+
+    # Width at the 2M single-table size (the table-size term interacts
+    # with width; two-tier made 262k the production front, but record
+    # the curve).
+    C2 = 2_097_152
+    for width in (8, 4):
+        st = jnp.zeros((C2, width), jnp.int32)
+
+        def body(s, i, idx, aux_idx):
+            rows = s[idx] + 1
+            return s.at[idx].set(rows, mode="drop")
+
+        ts = {}
+        for K in (K_LO, K_HI):
+            fn = chain(body, K)
+            s = fn(st, jnp.asarray(idx_np), jnp.asarray(aux_idx_np))
+            np.asarray(s.ravel()[:1])
+            best = float("inf")
+            for _ in range(SAMPLES):
+                t0 = time.perf_counter()
+                s = fn(s, jnp.asarray(idx_np), jnp.asarray(aux_idx_np))
+                np.asarray(s.ravel()[:1])
+                best = min(best, time.perf_counter() - t0)
+            ts[K] = best
+        us = (ts[K_HI] - ts[K_LO]) / (K_HI - K_LO) * 1e6
+        print(f"rmw [2M,{width}] 131k rows {us:31.1f} us/batch", flush=True)
+
+
+if __name__ == "__main__":
+    main()

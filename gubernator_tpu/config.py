@@ -214,9 +214,9 @@ def setup_daemon_config(
     conf.back_cache_size = _env_int(
         merged, "GUBER_BACK_CACHE_SIZE", conf.back_cache_size
     )
-    v = merged.get("GUBER_GLOBAL_CACHE_SIZE", "")
-    if v:
-        conf.global_cache_size = int(v)
+    conf.global_cache_size = _env_int(
+        merged, "GUBER_GLOBAL_CACHE_SIZE", conf.global_cache_size
+    )
     conf.data_center = merged.get("GUBER_DATA_CENTER", "")
     if merged.get("GUBER_WARMUP_SHAPES"):
         conf.warmup_shapes = [

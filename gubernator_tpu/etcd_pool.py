@@ -103,6 +103,11 @@ class EtcdClient:
             request_serializer=rpc.DeleteRangeRequest.SerializeToString,
             response_deserializer=rpc.DeleteRangeResponse.FromString,
         )
+        self._compact = u(
+            "/etcdserverpb.KV/Compact",
+            request_serializer=rpc.CompactionRequest.SerializeToString,
+            response_deserializer=rpc.CompactionResponse.FromString,
+        )
         self._grant = u(
             "/etcdserverpb.Lease/LeaseGrant",
             request_serializer=rpc.LeaseGrantRequest.SerializeToString,
@@ -197,6 +202,16 @@ class EtcdClient:
         )
         kvs = [(kv.key.decode(), kv.value) for kv in resp.kvs]
         return kvs, resp.header.revision
+
+    def compact(self, revision: int) -> None:
+        """KV.Compact — not used by the pool itself (etcd compacts on
+        its own schedule in production); exposed for the integration
+        tests that prove the pool survives watch-resume across a
+        compaction (mvcc ErrCompacted -> canceled watch -> re-list)."""
+        self._compact(
+            rpc.CompactionRequest(revision=revision),
+            timeout=self.timeout_s, metadata=self._metadata,
+        )
 
     def put(self, key: str, value: bytes, lease_id: int = 0) -> None:
         self._put(

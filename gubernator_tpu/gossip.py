@@ -520,7 +520,10 @@ class _PushPullHandler(socketserver.StreamRequestHandler):
                 return
             msg = json.loads(line)
             self.wfile.write(
-                (json.dumps({"t": "push-pull", "m": gossip._state_snapshot()}) + "\n").encode()
+                (json.dumps({
+                    "t": "push-pull", "v": WIRE_VERSION,
+                    "m": gossip._state_snapshot(),
+                }) + "\n").encode()
             )
             gossip.merge_state(msg.get("m", []))
         except (OSError, json.JSONDecodeError, UnicodeDecodeError):

@@ -225,23 +225,40 @@ class K8sApiClient:
             raise OSError(f"k8s API returned HTTP {resp.status}: {body!r}")
         return resp
 
+    # LIST page size: apiservers cap very large lists and the reflector
+    # contract is chunked reads (metadata.continue tokens); 500 matches
+    # client-go's default reflector page size.
+    LIST_LIMIT = 500
+
     def list(
         self, namespace: str, resource: str, selector: str = ""
     ) -> Tuple[List[dict], str]:
-        """LIST a namespaced resource; returns (items, resourceVersion)."""
-        params = {}
-        if selector:
-            params["labelSelector"] = selector
-        conn = self._connect(timeout=10.0)
+        """Chunked LIST of a namespaced resource (limit= + continue=
+        pagination, the client-go reflector contract); returns
+        (all items, resourceVersion of the FINAL chunk — the version
+        the subsequent watch must start from)."""
+        items: List[dict] = []
+        cont = ""
+        conn = self._connect(timeout=10.0)  # one connection for all chunks
         try:
-            body = json.load(
-                self._request(conn, f"/api/v1/namespaces/{namespace}/{resource}", params)
-            )
+            while True:
+                params = {"limit": str(self.LIST_LIMIT)}
+                if selector:
+                    params["labelSelector"] = selector
+                if cont:
+                    params["continue"] = cont
+                body = json.load(
+                    self._request(
+                        conn, f"/api/v1/namespaces/{namespace}/{resource}", params
+                    )
+                )
+                items.extend(body.get("items", []))
+                meta = body.get("metadata", {})
+                cont = meta.get("continue", "")
+                if not cont:
+                    return items, meta.get("resourceVersion", "")
         finally:
             conn.close()
-        return body.get("items", []), body.get("metadata", {}).get(
-            "resourceVersion", ""
-        )
 
     def watch(
         self,

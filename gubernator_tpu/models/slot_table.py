@@ -41,6 +41,12 @@ class SlotTable:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # Mapping-change generation (C++ twin: Table::map_generation):
+        # bumped on every key->slot mapping change (assign, remap,
+        # evict, remove) but NOT on in-place expiry reuse or expire
+        # writes.  Equal reads across two points in time guarantee the
+        # mapping is unchanged between them (the GLOBAL sync fast path).
+        self.generation = 0
 
     def __len__(self) -> int:
         return len(self._key_to_slot)
@@ -80,6 +86,7 @@ class SlotTable:
         self.expire_ms[slot] = 0
         self._lru[slot] = None
         self._lru.move_to_end(slot)
+        self.generation += 1
         return slot, False
 
     def commit(
@@ -111,6 +118,7 @@ class SlotTable:
                     self._key_to_slot[keys[i]] = slot
                     self._slot_to_key[slot] = keys[i]
                     self.expire_ms[slot] = exp
+                    self.generation += 1
                     # The slot was appended to _free by this very
                     # commit loop's remove leg — O(1) pop from the end
                     # in the common case, cold linear scan otherwise.
@@ -141,6 +149,7 @@ class SlotTable:
         self.expire_ms[slot] = 0
         self._lru.pop(slot, None)
         self._free.append(slot)
+        self.generation += 1
 
     def remove(self, key: str) -> None:
         slot = self._key_to_slot.get(key)

@@ -104,6 +104,8 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.gt_table_stats.argtypes = [c.c_void_p, c.POINTER(c.c_int64)]
     lib.gt_table_evictions.restype = c.c_int64
     lib.gt_table_evictions.argtypes = [c.c_void_p]
+    lib.gt_table_generation.restype = c.c_uint64
+    lib.gt_table_generation.argtypes = [c.c_void_p]
     lib.gt_table_get_slot.restype = c.c_int32
     lib.gt_table_get_slot.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
     lib.gt_table_lookup_or_assign.argtypes = [
@@ -439,6 +441,12 @@ class NativeSlotTable:
     @property
     def misses(self) -> int:
         return self._stats[1]
+
+    @property
+    def generation(self) -> int:
+        """Key->slot mapping-change counter (Table::map_generation);
+        unchanged across two reads == no mapping changed between them."""
+        return int(self._lib.gt_table_generation(self._ptr))
 
     @property
     def evictions(self) -> int:

@@ -64,6 +64,12 @@ struct Table {
   std::vector<int32_t> free_slots;  // stack, top = back
   std::unordered_map<std::string, int32_t> key_to_slot;
   int64_t hits = 0, misses = 0, evictions = 0;
+  // Bumped on every key->front-slot MAPPING change (assign, remap,
+  // evict, remove).  NOT bumped by in-place expiry reuse (same key,
+  // same slot) or value/expire writes.  Lets the GLOBAL sync skip
+  // owner-slot re-verification for shards whose mapping is provably
+  // unchanged since the last sync (O(active-gslots) -> O(changed)).
+  uint64_t map_generation = 0;
 
   // ---- two-tier mode (back_capacity > 0) ----------------------------
   // The device keeps a small FRONT table (every kernel lane addresses
@@ -141,6 +147,7 @@ struct Table {
     expire_ms[s] = 0;
     lru_unlink(s);
     free_slots.push_back(s);
+    ++map_generation;
   }
 
   void enable_back(int64_t cap) {
@@ -241,6 +248,7 @@ struct Table {
     }
     expire_ms[s] = 0;
     ++evictions;
+    ++map_generation;
   }
 
   // Re-map an unmapped slot to `key` (the remove-then-recreate chain:
@@ -262,6 +270,7 @@ struct Table {
       }
     }
     lru_push_back(s);
+    ++map_generation;
     return true;
   }
 
@@ -347,6 +356,7 @@ struct Table {
     slot_key[s].assign(key, len);
     slot_mapped[s] = 1;
     lru_push_back(s);
+    ++map_generation;
     if (promo_b >= 0) {
       expire_ms[s] = back_expire[promo_b];
       // Queue the device move.  A demo still pending for this back
@@ -425,6 +435,11 @@ void gt_table_stats(void* tv, int64_t* out) {  // hits, misses, evictions
 // lookup to detect evictions, so it must not marshal the whole stats
 // array per call.
 int64_t gt_table_evictions(void* tv) { return ((Table*)tv)->evictions; }
+
+// Mapping-change generation (see Table::map_generation): equal reads
+// across two points in time guarantee no key->front-slot mapping
+// changed between them.
+uint64_t gt_table_generation(void* tv) { return ((Table*)tv)->map_generation; }
 
 int32_t gt_table_get_slot(void* tv, const char* key, int64_t len) {
   Table* t = (Table*)tv;

@@ -938,6 +938,23 @@ class MeshBucketStore(ColumnarPipeline):
         if not active and not self.dirty.any():
             return SyncResult(did_work=False)
 
+        # Owner-slot resolution fast path: re-verifying every active
+        # gslot's slot each pass is O(active) host work — at 50k-gslot
+        # working sets that is the sync's dominant cost.  A shard whose
+        # table reports an unchanged mapping GENERATION since the end of
+        # the last sync cannot have moved/evicted/removed any key, so
+        # its already-resolved gslots (owner_slot >= 0) are still valid;
+        # only unresolved gslots and shards with mapping churn pay the
+        # per-key verification.  (generation is bumped by assign/remap/
+        # evict/remove in both table twins; value/expire writes and
+        # in-place expiry reuse keep slot ownership and don't bump.)
+        gens = [getattr(t, "generation", None) for t in self.tables]
+        last = getattr(self, "_sync_gen", None)
+        shard_clean = [
+            last is not None and g is not None and last[o] == g
+            for o, g in enumerate(gens)
+        ]
+
         # Resolve each GLOBAL key's slot in its owner shard's table.
         # Assigning one key can evict another's slot under capacity
         # pressure, so iterate to a fixed point (bounded), then drop any
@@ -948,17 +965,20 @@ class MeshBucketStore(ColumnarPipeline):
                 o = int(self.gtable.owner_shard[g])
                 if o < 0:
                     continue  # remote daemon owns it: no local slot
+                if shard_clean[o] and self.gtable.owner_slot[g] >= 0:
+                    continue
                 key = self.gtable.key_of(g)
                 slot = self.tables[o].get_slot(key)
                 if slot is None:
                     slot, _ = self.tables[o].lookup_or_assign(key, now_ms)
                     changed = True
+                    shard_clean[o] = False  # assignment may have evicted
                 self.gtable.owner_slot[g] = slot
             if not changed:
                 break
         for g in active:
             o = int(self.gtable.owner_shard[g])
-            if o < 0:
+            if o < 0 or (shard_clean[o] and self.gtable.owner_slot[g] >= 0):
                 continue
             key = self.gtable.key_of(g)
             if self.tables[o].get_slot(key) != int(self.gtable.owner_slot[g]):
@@ -996,35 +1016,61 @@ class MeshBucketStore(ColumnarPipeline):
         self.gtable.rep_expire[:] = packed_np[0, 7]
 
         result = SyncResult()
-        for g in active:
-            key = self.gtable.key_of(g)
-            o = int(self.gtable.owner_shard[g])
-            if o < 0:
-                # Remote daemon owns this key: surface aggregated hits
-                # for the host sendHits leg (global.go:120-160).
-                if totals_np[g] > 0 and self.gtable.req_proto.get(g) is not None:
-                    req = replace(self.gtable.req_proto[g], hits=int(totals_np[g]))
-                    result.remote_hits.append(req)
-                continue
-            slot = int(self.gtable.owner_slot[g])
-            if slot < 0 or not applied_np[g]:
-                continue
-            self.tables[o].commit([slot], [out_exp[o, g]], [out_rm[o, g]], keys=[key])
-            # Store SPI parity: the owner-side apply of forwarded hits
-            # goes through the algorithms in the reference and fires
-            # OnChange/Remove (algorithms.go:64-68,38-40).
+        # Vectorized decode tail: the all-gslot Python loop was O(active)
+        # per pass; numpy masks select the (typically sparse) gslots
+        # that actually need host work — remote hit totals, applied
+        # owner commits, broadcasts.
+        act = np.fromiter(active, dtype=np.int64, count=len(active))
+        owner_np = self.gtable.owner_shard[act]
+        # Remote daemons' keys with aggregated hits: sendHits payloads
+        # (global.go:120-160).
+        for g in act[(owner_np < 0) & (totals_np[act] > 0)]:
+            g = int(g)
+            if self.gtable.req_proto.get(g) is not None:
+                req = replace(self.gtable.req_proto[g], hits=int(totals_np[g]))
+                result.remote_hits.append(req)
+        local = act[owner_np >= 0]
+        sel = local[applied_np[local] & (self.gtable.owner_slot[local] >= 0)]
+        sel_shard = self.gtable.owner_shard[sel]
+        for o in np.unique(sel_shard):
+            o = int(o)
+            idx = sel[sel_shard == o]
+            slots = self.gtable.owner_slot[idx]
+            keys = [self.gtable.key_of(int(g)) for g in idx]
             if self.store is not None:
-                req = self.gtable.req_proto.get(g)
-                if out_rm[o, g]:
-                    self.store.remove(key)
-                elif req is not None:
-                    rows = self._read_shard_rows(o, [slot])
-                    self.store.on_change(req, _rows_to_items([key], rows)[0])
-            # Authoritative status for the host broadcast leg
-            # (UpdatePeerGlobal payload, peers.proto:52-56).
+                # Store SPI parity: the owner-side apply of forwarded
+                # hits fires OnChange/Remove per key in the reference
+                # (algorithms.go:64-68,38-40) — keep the per-key path.
+                for k, g, slot in zip(keys, idx, slots):
+                    g, slot = int(g), int(slot)
+                    self.tables[o].commit(
+                        [slot], [out_exp[o, g]], [out_rm[o, g]], keys=[k]
+                    )
+                    req = self.gtable.req_proto.get(g)
+                    if out_rm[o, g]:
+                        self.store.remove(k)
+                    elif req is not None:
+                        rows = self._read_shard_rows(o, [slot])
+                        self.store.on_change(req, _rows_to_items([k], rows)[0])
+            else:
+                self.tables[o].commit(
+                    [int(s) for s in slots],
+                    [int(e) for e in out_exp[o, idx]],
+                    [bool(r) for r in out_rm[o, idx]],
+                    keys=keys,
+                )
+            # Commit-removals unmapped their keys: invalidate now so the
+            # post-commit generation snapshot below can't let a clean
+            # shard skip re-resolving them next pass.
+            for g in idx[out_rm[o, idx]]:
+                self.gtable.owner_slot[int(g)] = -1
+        # Authoritative statuses for the host broadcast leg
+        # (UpdatePeerGlobal payload, peers.proto:52-56).
+        for g in sel:
+            g = int(g)
             result.broadcasts.append(
                 UpdatePeerGlobal(
-                    key=key,
+                    key=self.gtable.key_of(g),
                     algorithm=int(self.gtable.algorithm[g]),
                     status=RateLimitResponse(
                         status=int(rep_status[g]),
@@ -1034,6 +1080,9 @@ class MeshBucketStore(ColumnarPipeline):
                     ),
                 )
             )
+        # Snapshot AFTER our own commits (which may bump generations):
+        # shards untouched until the next sync verify nothing then.
+        self._sync_gen = [getattr(t, "generation", None) for t in self.tables]
         self.dirty[:] = False
         return result
 

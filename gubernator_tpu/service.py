@@ -298,6 +298,11 @@ class ColumnarBatcher:
             )
             with self._inflight_lock:
                 self._own_inflight.append(handle)
+                # Reap resolved heads now, not just at the next flush:
+                # after a burst goes idle, lingering done handles would
+                # pin their result arrays until traffic resumes.
+                while self._own_inflight and self._own_inflight[0].done:
+                    self._own_inflight.popleft()
             lo = 0
             for (c, fut) in batch:
                 hi = lo + len(c[0])
@@ -311,6 +316,8 @@ class ColumnarBatcher:
 
     def stop(self) -> None:
         self._window.stop()
+        with self._inflight_lock:
+            self._own_inflight.clear()  # drop pinned result arrays
 
 
 class V1Service:

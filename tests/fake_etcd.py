@@ -50,6 +50,11 @@ class FakeEtcd:
         self._next_lease = 1000
         self._watchers: List[Tuple[bytes, bytes, "queue.Queue"]] = []
         self._history: List[Tuple[int, kvpb.Event]] = []  # (revision, event)
+        # Real-etcd compaction semantics: history below this revision is
+        # gone; a Watch created with start_revision < compact_revision
+        # is answered created-then-canceled with compact_revision set
+        # (mvcc ErrCompacted surface).
+        self._compact_revision = 0
         self._stop = threading.Event()
         self._reaper = threading.Thread(target=self._reap_leases, daemon=True)
         self._reaper.start()
@@ -108,6 +113,7 @@ class FakeEtcd:
             "/etcdserverpb.KV/Range": uu(self._do_range, rpc.RangeRequest),
             "/etcdserverpb.KV/Put": uu(self._do_put, rpc.PutRequest),
             "/etcdserverpb.KV/DeleteRange": uu(self._do_delete, rpc.DeleteRangeRequest),
+            "/etcdserverpb.KV/Compact": uu(self._do_compact, rpc.CompactionRequest),
             "/etcdserverpb.Lease/LeaseGrant": uu(self._do_grant, rpc.LeaseGrantRequest),
             "/etcdserverpb.Lease/LeaseRevoke": uu(self._do_revoke, rpc.LeaseRevokeRequest),
             "/etcdserverpb.Lease/LeaseKeepAlive": ss(self._do_keepalive, rpc.LeaseKeepAliveRequest),
@@ -206,6 +212,24 @@ class FakeEtcd:
             deleted = sum(1 for k in keys if self._delete_locked(k))
             return rpc.DeleteRangeResponse(header=self._header(), deleted=deleted)
 
+    def _do_compact(self, req: rpc.CompactionRequest, ctx) -> rpc.CompactionResponse:
+        with self._lock:
+            if req.revision <= self._compact_revision:
+                ctx.abort(
+                    grpc.StatusCode.OUT_OF_RANGE,
+                    "etcdserver: mvcc: required revision has been compacted",
+                )
+            if req.revision > self._revision:
+                ctx.abort(
+                    grpc.StatusCode.OUT_OF_RANGE,
+                    "etcdserver: mvcc: required revision is a future revision",
+                )
+            self._compact_revision = req.revision
+            self._history = [
+                (rev, ev) for rev, ev in self._history if rev >= req.revision
+            ]
+            return rpc.CompactionResponse(header=self._header())
+
     def _do_grant(self, req: rpc.LeaseGrantRequest, ctx) -> rpc.LeaseGrantResponse:
         with self._lock:
             self._next_lease += 1
@@ -240,6 +264,18 @@ class FakeEtcd:
         q: "queue.Queue" = queue.Queue()
         start, end = create.key, create.range_end
         with self._lock:
+            if (
+                create.start_revision
+                and create.start_revision < self._compact_revision
+            ):
+                # Watch from a compacted revision: etcd creates the
+                # watcher, then immediately cancels it with
+                # compact_revision set (the client must re-list and
+                # re-watch from a current revision).
+                compact_rev = self._compact_revision
+                stale = True
+            else:
+                stale = False
             backlog = [
                 (rev, ev)
                 for rev, ev in self._history
@@ -250,6 +286,13 @@ class FakeEtcd:
             self._watchers.append((start, end, q))
         try:
             yield rpc.WatchResponse(header=rpc.ResponseHeader(), created=True, watch_id=1)
+            if stale:
+                yield rpc.WatchResponse(
+                    header=self._header(), watch_id=1, canceled=True,
+                    compact_revision=compact_rev,
+                    cancel_reason="etcdserver: mvcc: required revision has been compacted",
+                )
+                return
             for rev, ev in backlog:
                 yield rpc.WatchResponse(
                     header=rpc.ResponseHeader(revision=rev), watch_id=1, events=[ev]
@@ -259,12 +302,27 @@ class FakeEtcd:
                     rev, ev = q.get(timeout=0.05)
                 except queue.Empty:
                     continue
+                if rev == "CANCEL":  # cancel_watchers() sentinel
+                    yield rpc.WatchResponse(
+                        header=self._header(), watch_id=1, canceled=True,
+                        compact_revision=ev,
+                    )
+                    return
                 yield rpc.WatchResponse(
                     header=rpc.ResponseHeader(revision=rev), watch_id=1, events=[ev]
                 )
         finally:
             with self._lock:
                 self._watchers.remove((start, end, q))
+
+    # ------------------------------------------------------------------
+    def cancel_watchers(self) -> None:
+        """Cancel every live watch stream (the server-side stream kill a
+        real etcd performs on leader change / compaction pressure);
+        clients must re-list and re-watch."""
+        with self._lock:
+            for _, _, q in list(self._watchers):
+                q.put(("CANCEL", self._compact_revision))
 
     # ------------------------------------------------------------------
     def revoke_lease(self, lease_id: int) -> None:

@@ -307,3 +307,67 @@ def test_etcd_env_surface(monkeypatch, tmp_path):
     assert conf.etcd_password == "p1"
     assert conf.etcd_tls_enable is True
     assert conf.etcd_tls_ca == str(ca)
+
+
+def test_watch_resume_across_compaction(server):
+    """Real-etcd drift point (etcd.go:174-220 vs mvcc compaction): a
+    watch whose resume revision has been compacted is answered
+    created-then-CANCELED with compact_revision set; the pool must
+    fall back to a fresh list+watch and converge on membership changes
+    that happened behind the compaction.  The fake implements the
+    etcdserverpb Compact RPC + cancel surface; test_etcd_real.py runs
+    the same scenario against a real etcd when one is available."""
+    u1 = []
+    p1 = make_pool(server, "10.0.0.1:81", u1, backoff_s=0.05)
+    try:
+        wait_until(lambda: u1 and len(u1[-1]) == 1, msg="self visible")
+
+        # Second peer registers directly (no pool): its PUT advances the
+        # revision past p1's watch position after we compact.
+        c = EtcdClient(endpoints=[server.address])
+        lease = c.lease_grant(30)
+        c.put("/gubernator/peers/10.0.0.9:81",
+              b'{"grpcAddress": "10.0.0.9:81"}', lease)
+        wait_until(lambda: u1 and len(u1[-1]) == 2, msg="peer 2 via watch")
+
+        # Compact everything, then kill p1's live stream so it must
+        # re-create a watch.  If the pool tried to resume from its old
+        # revision it would get canceled+compact_revision — either way
+        # it must recover membership.
+        c.compact(server._revision)
+        server.cancel_watchers()
+        c.put("/gubernator/peers/10.0.0.10:81",
+              b'{"grpcAddress": "10.0.0.10:81"}', lease)
+        wait_until(
+            lambda: u1 and {p.grpc_address for p in u1[-1]}
+            == {"10.0.0.1:81", "10.0.0.9:81", "10.0.0.10:81"},
+            msg="membership recovered after compaction",
+        )
+        c.close()
+    finally:
+        p1.close()
+
+
+def test_stale_watch_canceled_with_compact_revision(server):
+    """The wire surface itself: a Watch created below the compact
+    revision gets canceled=True + compact_revision (the exact etcd v3
+    behavior the pool's canceled branch consumes)."""
+    import threading
+
+    c = EtcdClient(endpoints=[server.address])
+    lease = c.lease_grant(30)
+    for i in range(4):
+        c.put(f"/gubernator/peers/10.0.0.{i}:81", b"{}", lease)
+    c.compact(server._revision)
+
+    stream, done = c.watch_prefix("/gubernator/peers/", 1, threading.Event())
+    resps = []
+    for resp in stream:
+        resps.append(resp)
+        if resp.canceled:
+            break
+    done.set()
+    assert resps[0].created
+    assert resps[-1].canceled
+    assert resps[-1].compact_revision == server._revision
+    c.close()

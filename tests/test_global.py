@@ -254,3 +254,81 @@ def test_global_cache_auto_sizes_to_bucket_capacity():
             assert svc.store.g_capacity == want, (cache, explicit, want)
         finally:
             svc.close()
+
+
+def test_sync_fast_path_survives_owner_slot_eviction():
+    """The generation-gated resolution fast path (round 5): a sync pass
+    skips owner-slot verification for shards with no mapping churn, but
+    MUST re-resolve when the owner's slot was evicted between syncs —
+    the stale slot would otherwise read another key's row."""
+    store = MeshBucketStore(capacity_per_shard=4, g_capacity=32)
+    owner, _ = owner_and_other(store, "gk")
+
+    store.apply([mk("gk", hits=3, limit=10)], T0, home_shard=owner)
+    store.sync_globals(T0)
+    slot_before = int(store.gtable.owner_slot[store.gtable.get("glob_gk")])
+
+    # Churn the owner shard's tiny table until gk's slot is stolen
+    # (filler keys chosen to hash onto the owner shard).
+    filler_keys = [
+        f"fill{i}" for i in range(256)
+        if shard_of_key(f"glob_fill{i}", store.n_shards) == owner
+    ][:8]
+    filler = [
+        RateLimitRequest(name="glob", unique_key=k, hits=1,
+                         limit=100, duration=60_000,
+                         algorithm=Algorithm.TOKEN_BUCKET)
+        for k in filler_keys
+    ]
+    store.apply(filler, T0 + 1, home_shard=owner)
+    assert store.tables[owner].get_slot("glob_gk") is None  # evicted
+
+    # More GLOBAL hits; the next sync must re-resolve (generation
+    # bumped), reassign a slot, and still converge the counter.
+    store.apply([mk("gk", hits=2, limit=10)], T0 + 2, home_shard=owner)
+    res = store.sync_globals(T0 + 2)
+    g = store.gtable.get("glob_gk")
+    slot_after = int(store.gtable.owner_slot[g])
+    assert store.tables[owner].get_slot("glob_gk") == slot_after
+    bc = {b.key: b for b in res.broadcasts}
+    assert "glob_gk" in bc
+    # Eviction lost the first 3 hits (reference-grade loss); the
+    # re-resolved slot carries the post-eviction state consistently.
+    assert bc["glob_gk"].status.remaining == 8, (slot_before, slot_after, bc)
+
+
+def test_sync_fast_path_steady_state_skips_verification():
+    """With no mapping churn between syncs, the second pass must not
+    touch the tables' lookup path at all (the O(active) -> O(changed)
+    contract).  Pinned by COUNTING get_slot calls on the owner shard's
+    table during the second sync — deleting the shard_clean fast path
+    from _sync_globals_locked fails this test."""
+    store = MeshBucketStore(capacity_per_shard=64, g_capacity=32)
+    owner, _ = owner_and_other(store, "s1")
+    store.apply([mk("s1", hits=1, limit=100)], T0, home_shard=owner)
+    store.sync_globals(T0)
+    gen_before = [t.generation for t in store.tables]
+
+    # Hits only (no new keys): values change, mapping doesn't.
+    store.apply([mk("s1", hits=1, limit=100)], T0 + 1, home_shard=owner)
+    assert [t.generation for t in store.tables] == gen_before
+
+    calls = {"n": 0}
+    table = store.tables[owner]
+    orig = table.get_slot
+
+    def counting_get_slot(key):
+        calls["n"] += 1
+        return orig(key)
+
+    table.get_slot = counting_get_slot
+    try:
+        store.sync_globals(T0 + 1)
+    finally:
+        del table.get_slot  # restore the bound method
+    assert calls["n"] == 0, "clean shard must skip owner-slot verification"
+    # And the resolved slot is still correct.
+    g = store.gtable.get("glob_s1")
+    assert store.tables[owner].get_slot("glob_s1") == int(
+        store.gtable.owner_slot[g]
+    )
