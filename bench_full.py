@@ -682,107 +682,121 @@ def config8():
     replaced by the measured on-chip device time (bench.py
     device_us_b1024, ~35-115us) plus PCIe transfer — the decomposition
     the RESULTS.md north-star row reports."""
+    from gubernator_tpu.config import DaemonConfig
+    from gubernator_tpu.daemon import Daemon
+
+    def run_edge(native: bool):
+        d = Daemon(
+            DaemonConfig(
+                listen_address="127.0.0.1:0",
+                grpc_listen_address="127.0.0.1:0",
+                cache_size=16_384,
+                peer_discovery_type="static",
+                native_http=native or None,
+            )
+        ).start()
+        try:
+            d.set_peers([d.peer_info])
+            return _config8_measure(d)
+        finally:
+            d.close()
+
+    stdlib_rows = run_edge(False)
+    try:
+        native_rows = {f"native_{k}": v for k, v in run_edge(True).items()}
+    except RuntimeError:
+        native_rows = {"native_edge": "unavailable"}
+    print(
+        json.dumps(
+            {
+                "metric": "cfg8_service_latency_1key_p99_ms",
+                "value": stdlib_rows["lat_1key_p99_ms"],
+                "unit": "ms",
+                "vs_baseline": 0,
+                **stdlib_rows,
+                **native_rows,
+                "includes_device_exec": "CPU-backend kernel (swap in "
+                "bench.py device_us_b1024 for a locally attached chip)",
+            }
+        ),
+        flush=True,
+    )
+
+
+def _config8_measure(d):
+    """One daemon's latency ladder: HTTP 1-key / 1000-lane + in-process
+    decomposition rows.  Returns the row dict (caller prints/merges)."""
     import statistics
 
     from gubernator_tpu.client import V1Client
-    from gubernator_tpu.config import DaemonConfig
-    from gubernator_tpu.daemon import Daemon
     from gubernator_tpu.types import (
         Algorithm,
+        Behavior,
         GetRateLimitsRequest,
         RateLimitRequest,
     )
 
-    d = Daemon(
-        DaemonConfig(
-            listen_address="127.0.0.1:0",
-            grpc_listen_address="127.0.0.1:0",
-            cache_size=16_384,
-            peer_discovery_type="static",
+    client = V1Client(d.gateway.address, timeout_s=30.0)
+
+    def req(k):
+        return RateLimitRequest(
+            name="c8", unique_key=k, hits=1, limit=1_000_000,
+            duration=3_600_000, algorithm=Algorithm.TOKEN_BUCKET,
         )
-    ).start()
-    try:
-        d.set_peers([d.peer_info])
-        client = V1Client(d.gateway.address, timeout_s=30.0)
 
-        def req(k):
-            return RateLimitRequest(
-                name="c8", unique_key=k, hits=1, limit=1_000_000,
-                duration=3_600_000, algorithm=Algorithm.TOKEN_BUCKET,
-            )
-
-        def run(batch_of, n_iters, tag):
-            lats = []
-            for i in range(max(n_iters // 10, 3)):  # warm
-                client.get_rate_limits(batch_of(i))
-            for i in range(n_iters):
-                b = batch_of(n_iters + i)
-                t0 = time.perf_counter()
-                client.get_rate_limits(b)
-                lats.append((time.perf_counter() - t0) * 1e3)
-            lats.sort()
-            return {
-                f"{tag}_p50_ms": round(lats[len(lats) // 2], 3),
-                f"{tag}_p99_ms": round(
-                    lats[min(len(lats) - 1, int(len(lats) * 0.99))], 3
-                ),
-                f"{tag}_mean_ms": round(statistics.fmean(lats), 3),
-            }
-
-        iters = max(int(200 * SCALE), 20)
-        one = run(lambda i: GetRateLimitsRequest(
-            requests=[req(f"one{i % 64}")]), iters, "lat_1key")
-        kilo = run(lambda i: GetRateLimitsRequest(
-            requests=[req(f"k{i % 8}:{j}") for j in range(_sz(1000, lo=16))]),
-            max(iters // 4, 10), "lat_1000lane")
-
-        # Decomposition: in-process service call (no HTTP stack) and
-        # NO_BATCHING (no 500us ingress window) — attributes the HTTP
-        # p50 to its layers.
-        from gubernator_tpu.types import Behavior as _B
-
-        svc = d.service
-
-        def run_inproc(tag, behavior):
-            lats = []
-            for i in range(iters + 5):
-                r = GetRateLimitsRequest(requests=[RateLimitRequest(
-                    name="c8i", unique_key=f"ip{i % 64}", hits=1,
-                    limit=1_000_000, duration=3_600_000,
-                    algorithm=Algorithm.TOKEN_BUCKET, behavior=behavior)])
-                t0 = time.perf_counter()
-                svc.get_rate_limits(r)
-                if i >= 5:
-                    lats.append((time.perf_counter() - t0) * 1e3)
-            lats.sort()
-            return {
-                f"{tag}_p50_ms": round(lats[len(lats) // 2], 3),
-                f"{tag}_p99_ms": round(
-                    lats[min(len(lats) - 1, int(len(lats) * 0.99))], 3
-                ),
-            }
-
-        inproc = run_inproc("lat_inproc_1key", 0)
-        direct = run_inproc("lat_inproc_nobatch", int(_B.NO_BATCHING))
-        print(
-            json.dumps(
-                {
-                    "metric": "cfg8_service_latency_1key_p99_ms",
-                    "value": one["lat_1key_p99_ms"],
-                    "unit": "ms",
-                    "vs_baseline": 0,
-                    **one,
-                    **kilo,
-                    **inproc,
-                    **direct,
-                    "includes_device_exec": "CPU-backend kernel (swap in "
-                    "bench.py device_us_b1024 for a locally attached chip)",
-                }
+    def run(batch_of, n_iters, tag):
+        lats = []
+        for i in range(max(n_iters // 10, 3)):  # warm
+            client.get_rate_limits(batch_of(i))
+        for i in range(n_iters):
+            b = batch_of(n_iters + i)
+            t0 = time.perf_counter()
+            client.get_rate_limits(b)
+            lats.append((time.perf_counter() - t0) * 1e3)
+        lats.sort()
+        return {
+            f"{tag}_p50_ms": round(lats[len(lats) // 2], 3),
+            f"{tag}_p99_ms": round(
+                lats[min(len(lats) - 1, int(len(lats) * 0.99))], 3
             ),
-            flush=True,
-        )
-    finally:
-        d.close()
+            f"{tag}_mean_ms": round(statistics.fmean(lats), 3),
+        }
+
+    iters = max(int(200 * SCALE), 20)
+    rows = {}
+    rows.update(run(lambda i: GetRateLimitsRequest(
+        requests=[req(f"one{i % 64}")]), iters, "lat_1key"))
+    rows.update(run(lambda i: GetRateLimitsRequest(
+        requests=[req(f"k{i % 8}:{j}") for j in range(_sz(1000, lo=16))]),
+        max(iters // 4, 10), "lat_1000lane"))
+
+    # Decomposition: in-process service call (no HTTP stack) and
+    # NO_BATCHING (no 500us ingress window) — attributes the HTTP
+    # p50 to its layers.
+    svc = d.service
+
+    def run_inproc(tag, behavior):
+        lats = []
+        for i in range(iters + 5):
+            r = GetRateLimitsRequest(requests=[RateLimitRequest(
+                name="c8i", unique_key=f"ip{i % 64}", hits=1,
+                limit=1_000_000, duration=3_600_000,
+                algorithm=Algorithm.TOKEN_BUCKET, behavior=behavior)])
+            t0 = time.perf_counter()
+            svc.get_rate_limits(r)
+            if i >= 5:
+                lats.append((time.perf_counter() - t0) * 1e3)
+        lats.sort()
+        return {
+            f"{tag}_p50_ms": round(lats[len(lats) // 2], 3),
+            f"{tag}_p99_ms": round(
+                lats[min(len(lats) - 1, int(len(lats) * 0.99))], 3
+            ),
+        }
+
+    rows.update(run_inproc("lat_inproc_1key", 0))
+    rows.update(run_inproc("lat_inproc_nobatch", int(Behavior.NO_BATCHING)))
+    return rows
 
 
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,

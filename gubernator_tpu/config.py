@@ -69,6 +69,13 @@ class DaemonConfig:
     # reference caps GLOBAL keys only by its shared cache,
     # global.go:83-91).  See ServiceConfig.global_cache_size.
     global_cache_size: "int | None" = None
+    # HTTP edge: True serves the gateway from the C++ epoll edge
+    # (NativeGatewayServer — better tail latency and per-request
+    # overhead; startup error if the native runtime is missing or TLS
+    # is on).  Default/False: the stdlib gateway (wins bulk-batch
+    # throughput on few-core hosts — measured A/B in RESULTS.md).
+    # Env: GUBER_NATIVE_HTTP=1/0.
+    native_http: "bool | None" = None
     data_center: str = ""
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
     # Static peer list (the zero-dependency discovery mode; etcd/
@@ -217,6 +224,9 @@ def setup_daemon_config(
     conf.global_cache_size = _env_int(
         merged, "GUBER_GLOBAL_CACHE_SIZE", conf.global_cache_size
     )
+    v = merged.get("GUBER_NATIVE_HTTP", "")
+    if v:
+        conf.native_http = v.strip().lower() in ("1", "true", "yes", "on")
     conf.data_center = merged.get("GUBER_DATA_CENTER", "")
     if merged.get("GUBER_WARMUP_SHAPES"):
         conf.warmup_shapes = [

@@ -84,9 +84,37 @@ class Daemon:
             self.service, grpc_listen, tls_conf=tls_conf,
             max_conn_age_s=getattr(self.conf, "grpc_max_conn_age_s", 0),
         ).start()
-        self.gateway = GatewayServer(
-            self.service, self.conf.listen_address, tls_context=server_tls
-        )
+        # HTTP edge selection (measured A/B in RESULTS.md round 5): the
+        # C++ epoll edge (NativeGatewayServer) wins tail latency (1000-
+        # lane p99 85ms -> 15ms) and per-request overhead, but on a
+        # 1-core host the stdlib gateway's unbounded blocked threads
+        # keep more device windows in flight and win bulk-batch
+        # throughput ~15-20%.  Default is therefore the stdlib gateway;
+        # GUBER_NATIVE_HTTP=1 / native_http=True opts into the native
+        # edge (latency-sensitive or many-core deployments).  TLS always
+        # uses the Python+ssl gateway.
+        self.gateway = None
+        if self.conf.native_http is True and server_tls is not None:
+            raise RuntimeError(
+                "GUBER_NATIVE_HTTP=1 is incompatible with TLS: the native "
+                "edge has no TLS support (use the default stdlib gateway)"
+            )
+        if server_tls is None and self.conf.native_http is True:
+            from . import native as _native
+            from .gateway import NativeGatewayServer
+
+            if not _native.available():
+                raise RuntimeError(
+                    f"GUBER_NATIVE_HTTP=1 but native runtime unavailable: "
+                    f"{_native.build_error()}"
+                )
+            self.gateway = NativeGatewayServer(
+                self.service, self.conf.listen_address
+            )
+        if self.gateway is None:
+            self.gateway = GatewayServer(
+                self.service, self.conf.listen_address, tls_context=server_tls
+            )
         self.gateway.start()
         # Port 0 resolves at bind time; a wildcard host — bound OR
         # explicitly configured — must be replaced by a routable IP

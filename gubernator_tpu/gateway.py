@@ -15,6 +15,7 @@ import json
 import socket
 import ssl
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -176,6 +177,161 @@ def render_columns(result: ColumnarResult) -> dict:
     return {"responses": out}
 
 
+def handle_request(service: V1Service, method: str, path: str, raw: bytes):
+    """Transport-independent request handler: the single routing +
+    metrics + error surface behind BOTH edges (the stdlib ThreadingHTTP
+    server below and the native epoll edge, NativeGatewayServer).
+    Returns (http_status, content_type, body_bytes)."""
+    try:
+        if method == "GET":
+            if path == "/v1/HealthCheck":
+                with service.metrics.observe_rpc("/pb.gubernator.V1/HealthCheck"):
+                    hc = service.health_check()
+                return 200, "application/json", _json_bytes(hc.to_json())
+            if path == "/metrics":
+                # Collect-on-scrape: refresh the cache gauges from the
+                # store (the reference's prometheus Collector pattern,
+                # cache.go:205-218).
+                service.metrics.observe_cache(service.store)
+                return (200, "text/plain; version=0.0.4",
+                        service.metrics.render())
+            return 404, "application/json", _json_bytes(
+                {"code": 5, "message": f"no handler for {path}"}
+            )
+        if method != "POST":
+            return 404, "application/json", _json_bytes(
+                {"code": 5, "message": f"no handler for {method} {path}"}
+            )
+        if path == "/v1/GetRateLimits":
+            with service.metrics.observe_rpc("/pb.gubernator.V1/GetRateLimits"):
+                cols = parse_body_native(raw) if raw else None
+                if cols is not None:
+                    result = service.get_rate_limits_columns(cols)
+                    rendered = render_result_native(result)
+                else:
+                    body = json.loads(raw) if raw else {}
+                    result = service.get_rate_limits_columns(
+                        parse_columns(body.get("requests", []))
+                    )
+                    rendered = None
+                if rendered is None:
+                    rendered = _json_bytes(render_columns(result))
+            return 200, "application/json", rendered
+        body = json.loads(raw) if raw else {}
+        if path == "/v1/peer.GetPeerRateLimits":
+            with service.metrics.observe_rpc(
+                "/pb.gubernator.PeersV1/GetPeerRateLimits"
+            ):
+                cols = parse_columns(body.get("requests", []))
+                result = service.get_peer_rate_limits_columns(cols)
+            # PeersV1 response field is rate_limits (peers.proto:42-45).
+            return 200, "application/json", _json_bytes(
+                {"rateLimits": render_columns(result)["responses"]}
+            )
+        if path == "/v1/peer.UpdatePeerGlobals":
+            with service.metrics.observe_rpc(
+                "/pb.gubernator.PeersV1/UpdatePeerGlobals"
+            ):
+                updates = [
+                    UpdatePeerGlobal.from_json(u)
+                    for u in body.get("globals", [])
+                ]
+                service.update_peer_globals(updates)
+            return 200, "application/json", b"{}"
+        return 404, "application/json", _json_bytes(
+            {"code": 5, "message": f"no handler for {path}"}
+        )
+    except ApiError as e:
+        return e.http_status, "application/json", _json_bytes(
+            {"code": _GRPC_CODES.get(e.code, 2), "message": e.message}
+        )
+    except json.JSONDecodeError as e:
+        return 400, "application/json", _json_bytes(
+            {"code": 3, "message": f"invalid JSON: {e}"}
+        )
+    except Exception as e:  # noqa: BLE001
+        return 500, "application/json", _json_bytes(
+            {"code": 13, "message": str(e)}
+        )
+
+
+def _json_bytes(payload) -> bytes:
+    return json.dumps(payload).encode("utf-8")
+
+
+_HTTP_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                 500: "Internal Server Error"}
+
+
+class NativeGatewayServer:
+    """The C++ epoll edge (host_runtime.cpp gt_http_*): one native
+    thread owns accept/read/frame/write for every connection; N Python
+    workers pull parsed requests (GIL released while blocked) and run
+    the same handle_request path as the stdlib gateway.  Replaces the
+    measured ~1.1 ms/request Python HTTP layer and the thread-per-
+    connection model that convoys at 100-way concurrency (RESULTS.md
+    cfg8/cfg5).  No TLS — the daemon selects the stdlib gateway when
+    TLS is configured."""
+
+    # Workers BLOCK on device rounds inside the service path, so the
+    # pool bounds in-flight requests.  16 measured best on the 1-core
+    # bench host (48 bought nothing: core contention, not pool size,
+    # limits there); multi-core hosts may want ~2x cores.
+    N_WORKERS = 16
+
+    def __init__(self, service: V1Service, listen_address: str = "127.0.0.1:0"):
+        from . import native as _nat
+
+        self.service = service
+        self._edge = _nat.HttpEdge(listen_address)  # raises if unavailable
+        self._host = listen_address.partition(":")[0] or "127.0.0.1"
+        self._threads: list = []
+        self._stopped = threading.Event()
+
+    @property
+    def address(self) -> str:
+        return f"{self._host}:{self._edge.port}"
+
+    def start(self) -> None:
+        for i in range(self.N_WORKERS):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"native-gw-{i}")
+            t.start()
+            self._threads.append(t)
+
+    def _worker(self) -> None:
+        edge, service = self._edge, self.service
+        while not self._stopped.is_set():
+            got = edge.next(timeout_ms=200)
+            if got is None:
+                if edge.stopped:
+                    return
+                continue
+            token, method, path, body = got
+            if getattr(service, "_closed", False):
+                edge.respond(token, 503, b'{"code": 14, "message": "shutting down"}')
+                continue
+            status, ctype, payload = handle_request(service, method, path, body)
+            edge.respond(token, status, payload,
+                         reason=_HTTP_REASONS.get(status, "Error"),
+                         content_type=ctype)
+
+    def close(self) -> None:
+        # Teardown order matters (round-5 review: use-after-free):
+        # shutdown stops traffic but keeps the native server allocated;
+        # the workers — possibly mid-device-round, about to respond() —
+        # are joined BEFORE free() releases it.  A worker stuck past the
+        # join timeout leaks the server instead of crashing into freed
+        # memory.
+        self._stopped.set()
+        self._edge.shutdown()
+        deadline = time.monotonic() + 30.0
+        for t in self._threads:
+            t.join(timeout=max(deadline - time.monotonic(), 0.1))
+        if all(not t.is_alive() for t in self._threads):
+            self._edge.free()
+
+
 class _GatewayHTTPServer(ThreadingHTTPServer):
     # socketserver's default listen backlog of 5 resets connections under
     # a concurrent client burst; the reference edge accepts thousands of
@@ -223,14 +379,6 @@ def _make_handler(service: V1Service):
         def log_message(self, fmt, *args):  # noqa: N802 — silence stdlib logging
             pass
 
-        def _send_json(self, status: int, payload) -> None:
-            body = json.dumps(payload).encode("utf-8")
-            self.send_response(status)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
         def _send_bytes(self, status: int, content_type: str, body: bytes) -> None:
             self.send_response(status)
             self.send_header("Content-Type", content_type)
@@ -255,92 +403,18 @@ def _make_handler(service: V1Service):
             length = int(self.headers.get("Content-Length", "0"))
             return self.rfile.read(length) if length else b""
 
-        def _read_json(self) -> dict:
-            raw = self._read_raw()
-            if not raw:
-                return {}
-            return json.loads(raw)
-
         def do_GET(self):  # noqa: N802
             if self._refuse_if_closed():
                 return
-            try:
-                if self.path == "/v1/HealthCheck":
-                    with service.metrics.observe_rpc("/pb.gubernator.V1/HealthCheck"):
-                        hc = service.health_check()
-                    self._send_json(200, hc.to_json())
-                elif self.path == "/metrics":
-                    # Collect-on-scrape: refresh the cache gauges from
-                    # the store (the reference's prometheus Collector
-                    # pattern, cache.go:205-218).
-                    service.metrics.observe_cache(service.store)
-                    self._send_bytes(
-                        200, "text/plain; version=0.0.4", service.metrics.render()
-                    )
-                else:
-                    self._send_json(
-                        404, {"code": 5, "message": f"no handler for {self.path}"}
-                    )
-            except Exception as e:  # noqa: BLE001
-                self._send_json(500, {"code": 13, "message": str(e)})
+            status, ctype, body = handle_request(service, "GET", self.path, b"")
+            self._send_bytes(status, ctype, body)
 
         def do_POST(self):  # noqa: N802
             if self._refuse_if_closed():
                 return
-            try:
-                if self.path == "/v1/GetRateLimits":
-                    raw = self._read_raw()
-                    with service.metrics.observe_rpc("/pb.gubernator.V1/GetRateLimits"):
-                        cols = parse_body_native(raw) if raw else None
-                        if cols is not None:
-                            result = service.get_rate_limits_columns(cols)
-                            rendered = render_result_native(result)
-                        else:
-                            body = json.loads(raw) if raw else {}
-                            result = service.get_rate_limits_columns(
-                                parse_columns(body.get("requests", []))
-                            )
-                            rendered = None
-                        if rendered is None:
-                            payload = render_columns(result)
-                    if rendered is not None:
-                        self._send_bytes(200, "application/json", rendered)
-                    else:
-                        self._send_json(200, payload)
-                    return
-                body = self._read_json()
-                if self.path == "/v1/peer.GetPeerRateLimits":
-                    with service.metrics.observe_rpc(
-                        "/pb.gubernator.PeersV1/GetPeerRateLimits"
-                    ):
-                        cols = parse_columns(body.get("requests", []))
-                        result = service.get_peer_rate_limits_columns(cols)
-                    # PeersV1 response field is rate_limits (peers.proto:42-45).
-                    self._send_json(
-                        200, {"rateLimits": render_columns(result)["responses"]}
-                    )
-                elif self.path == "/v1/peer.UpdatePeerGlobals":
-                    with service.metrics.observe_rpc(
-                        "/pb.gubernator.PeersV1/UpdatePeerGlobals"
-                    ):
-                        updates = [
-                            UpdatePeerGlobal.from_json(u)
-                            for u in body.get("globals", [])
-                        ]
-                        service.update_peer_globals(updates)
-                    self._send_json(200, {})
-                else:
-                    self._send_json(
-                        404, {"code": 5, "message": f"no handler for {self.path}"}
-                    )
-            except ApiError as e:
-                self._send_json(
-                    e.http_status,
-                    {"code": _GRPC_CODES.get(e.code, 2), "message": e.message},
-                )
-            except json.JSONDecodeError as e:
-                self._send_json(400, {"code": 3, "message": f"invalid JSON: {e}"})
-            except Exception as e:  # noqa: BLE001
-                self._send_json(500, {"code": 13, "message": str(e)})
+            status, ctype, body = handle_request(
+                service, "POST", self.path, self._read_raw()
+            )
+            self._send_bytes(status, ctype, body)
 
     return Handler

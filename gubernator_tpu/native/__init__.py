@@ -190,6 +190,18 @@ def _build() -> Optional[ctypes.CDLL]:
         c.c_void_p, c.c_int64, c.c_char_p, c.c_void_p, c.c_char_p,
         c.c_int64,
     ]
+    lib.gt_http_start.restype = c.c_void_p
+    lib.gt_http_start.argtypes = [c.c_char_p, c.c_int]
+    lib.gt_http_port.restype = c.c_int
+    lib.gt_http_port.argtypes = [c.c_void_p]
+    lib.gt_http_next.restype = c.c_int
+    lib.gt_http_next.argtypes = [c.c_void_p, c.c_int64, c.c_void_p]
+    lib.gt_http_respond.argtypes = [
+        c.c_void_p, c.c_uint64, c.c_int, c.c_char_p, c.c_char_p,
+        c.c_char_p, c.c_int64,
+    ]
+    lib.gt_http_shutdown.argtypes = [c.c_void_p]
+    lib.gt_http_free.argtypes = [c.c_void_p]
     return lib
 
 
@@ -761,3 +773,89 @@ class NativeMeshPlanner:
             status.ctypes.data, remaining.ctypes.data, reset.ctypes.data,
         )
         return status[: self.n], remaining[: self.n], reset[: self.n]
+
+
+class _GtHttpReq(ctypes.Structure):
+    _fields_ = [
+        ("token", ctypes.c_uint64),
+        ("method", ctypes.c_int32),
+        ("path_len", ctypes.c_int32),
+        ("body_len", ctypes.c_int64),
+        ("path", ctypes.c_char_p),
+        ("body", ctypes.POINTER(ctypes.c_char)),
+    ]
+
+
+_HTTP_METHODS = {0: "GET", 1: "POST"}
+
+
+class HttpEdge:
+    """ctypes wrapper over the C++ epoll HTTP server (gt_http_*).
+
+    One native thread owns every socket; Python workers call next()
+    (GIL released while blocked in the native wait) and answer with
+    respond().  See gateway.NativeGatewayServer for the worker loop."""
+
+    def __init__(self, listen_address: str = "127.0.0.1:0"):
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError(f"native runtime unavailable: {build_error()}")
+        self._lib = lib
+        host, _, port = listen_address.partition(":")
+        # gt_http_start takes a dotted-quad (AF_INET): resolve hostnames
+        # here so 'localhost:1051' etc. keep working like the stdlib
+        # gateway.  IPv6 listen addresses are not supported by this edge.
+        import socket as _socket
+
+        host_ip = _socket.gethostbyname(host or "127.0.0.1")
+        self._ptr = lib.gt_http_start(host_ip.encode(), int(port or 0))
+        if not self._ptr:
+            raise OSError(f"gt_http_start failed to bind {listen_address}")
+        self.port = int(lib.gt_http_port(self._ptr))
+        self.stopped = False
+        self._freed = False
+        self._stop_lock = threading.Lock()
+
+    def next(self, timeout_ms: int = 200):
+        """Blocks up to timeout_ms for one parsed request.  Returns
+        (token, method, path, body_bytes) or None (timeout/stopping).
+        The body is copied out, so the token may be answered from any
+        thread at any later time."""
+        if self.stopped:
+            return None
+        req = _GtHttpReq()
+        rc = self._lib.gt_http_next(self._ptr, timeout_ms, ctypes.byref(req))
+        if rc != 1:
+            return None
+        method = _HTTP_METHODS.get(req.method, "OTHER")
+        path = req.path.decode("utf-8", "replace") if req.path else ""
+        body = ctypes.string_at(req.body, req.body_len) if req.body_len else b""
+        return req.token, method, path, body
+
+    def respond(self, token: int, status: int, body: bytes,
+                reason: str = "OK", content_type: str = "application/json"):
+        self._lib.gt_http_respond(
+            self._ptr, token, status, reason.encode(), content_type.encode(),
+            body, len(body),
+        )
+
+    def shutdown(self) -> None:
+        """Phase 1: stop traffic (closes sockets, joins the native
+        epoll thread).  The HttpServer stays ALLOCATED: workers still
+        blocked in next() or about to respond() keep valid memory.
+        Callers must join their workers, then call free()."""
+        with self._stop_lock:
+            if self.stopped:
+                return
+            self.stopped = True
+        self._lib.gt_http_shutdown(self._ptr)
+
+    def free(self) -> None:
+        """Phase 2: release the native server.  Only safe after every
+        worker thread using this edge has exited."""
+        with self._stop_lock:
+            if self._freed or self._ptr is None:
+                return
+            self._freed = True
+        self._lib.gt_http_free(self._ptr)
+        self._ptr = None

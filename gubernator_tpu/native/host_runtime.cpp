@@ -1538,3 +1538,454 @@ int64_t gt_json_render(const int32_t* status, const int64_t* limit,
 }
 
 }  // extern "C"
+
+// ======================================================================
+// Native HTTP/1.1 edge (gt_http_*): the gateway's socket + framing
+// layer in C++.
+//
+// The measured cost of the stdlib gateway (benchmarks/RESULTS.md cfg8
+// decomposition) is ~1.1 ms/request of Python HTTP parsing plus a
+// thread-per-connection model that convoys at 100-way concurrency on
+// the GIL.  This edge replaces exactly that layer: ONE epoll thread
+// owns accept/read/frame/write for every connection; parsed requests
+// (method, path, body) queue to Python worker threads via
+// gt_http_next (ctypes releases the GIL while they block), which run
+// the UNCHANGED service path (native JSON parse -> route/dispatch ->
+// native render) and hand the response bytes back via gt_http_respond.
+// The reference serves its edge from compiled code too (the Go http
+// runtime, daemon.go:194-239) — this is that capability, not a new
+// protocol: same endpoints, same JSON, same errors.
+//
+// Scope: HTTP/1.1 keep-alive, Content-Length bodies (no chunked
+// REQUESTS — no client of this API sends them), no TLS (the daemon
+// keeps the Python+ssl gateway when TLS is configured).  Bounded
+// header/body sizes and a bounded ready queue (overflow answers 503
+// without touching Python).
+// ======================================================================
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <thread>
+
+namespace {
+
+constexpr size_t kMaxHeaderBytes = 64 * 1024;
+constexpr size_t kMaxBodyBytes = 32 * 1024 * 1024;  // > 1000-lane batches
+constexpr size_t kMaxReadyQueue = 4096;
+
+struct HttpPending {
+  uint64_t token;
+  int fd;
+  int method;  // 0 GET, 1 POST, 2 other
+  bool keep_alive;
+  std::string path;
+  std::string body;
+};
+
+struct HttpConn {
+  int fd = -1;
+  std::string in;
+  // parsed-but-unanswered request count (pipelined clients): responses
+  // write in arrival order because tokens are handed out in order and
+  // the out buffer is appended in respond order per connection --
+  // workers MAY finish out of order, so per-conn ordering is enforced
+  // by queueing responses by token sequence.
+  std::deque<uint64_t> awaiting;          // tokens awaiting response
+  std::unordered_map<uint64_t, std::string> done;  // token -> response
+  std::string out;
+  size_t out_off = 0;
+  bool want_close = false;
+};
+
+struct HttpServer {
+  int listen_fd = -1, epfd = -1, evfd = -1, port = 0;
+  std::thread loop;
+  std::atomic<bool> stopping{false};
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<HttpPending*> ready;                  // parsed, for Python
+  std::unordered_map<uint64_t, HttpPending*> inflight;  // token -> req
+  // responses staged by Python, drained by the epoll thread
+  std::deque<std::pair<uint64_t, std::string>> resp_queue;
+  std::unordered_map<uint64_t, int> token_fd;
+  std::unordered_map<int, HttpConn*> conns;
+  uint64_t next_token = 1;
+};
+
+void http_close_conn(HttpServer* s, HttpConn* c) {
+  epoll_ctl(s->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+  close(c->fd);
+  {
+    // Tokens of this connection that are still inflight must not write
+    // to a reused fd: drop the mapping (responses get discarded).
+    std::lock_guard<std::mutex> lk(s->mu);
+    for (uint64_t t : c->awaiting) s->token_fd.erase(t);
+    s->conns.erase(c->fd);
+  }
+  delete c;
+}
+
+void http_arm(HttpServer* s, HttpConn* c) {
+  epoll_event ev{};
+  ev.data.fd = c->fd;
+  ev.events = EPOLLIN | (c->out.size() > c->out_off ? EPOLLOUT : 0u);
+  epoll_ctl(s->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+std::string http_simple_response(int code, const char* reason,
+                                 const std::string& body, bool keep_alive) {
+  std::string r = "HTTP/1.1 " + std::to_string(code) + " " + reason +
+                  "\r\nContent-Type: application/json\r\nContent-Length: " +
+                  std::to_string(body.size()) + "\r\n";
+  if (!keep_alive) r += "Connection: close\r\n";
+  r += "\r\n";
+  r += body;
+  return r;
+}
+
+// Flush completed responses (in token order) into the conn's out buffer.
+void http_stage_done(HttpServer* s, HttpConn* c) {
+  while (!c->awaiting.empty()) {
+    auto it = c->done.find(c->awaiting.front());
+    if (it == c->done.end()) break;
+    c->out += it->second;
+    c->done.erase(it);
+    c->awaiting.pop_front();
+  }
+}
+
+// Parse as many complete requests as the buffer holds.  Returns false
+// when the connection must die (malformed / oversize).
+bool http_drain_input(HttpServer* s, HttpConn* c) {
+  for (;;) {
+    size_t he = c->in.find("\r\n\r\n");
+    if (he == std::string::npos) {
+      return c->in.size() <= kMaxHeaderBytes;
+    }
+    std::string_view head(c->in.data(), he);
+    size_t line_end = head.find("\r\n");
+    std::string_view req_line =
+        head.substr(0, line_end == std::string_view::npos ? he : line_end);
+    int method = 2;
+    size_t path_off = 0;
+    if (req_line.rfind("GET ", 0) == 0) { method = 0; path_off = 4; }
+    else if (req_line.rfind("POST ", 0) == 0) { method = 1; path_off = 5; }
+    if (method == 2) {
+      if (req_line.find(' ') == std::string_view::npos) return false;
+      // Parseable frame, unsupported method (HEAD/OPTIONS/PUT...):
+      // answer 501 and close — a silent reset would make e.g. HEAD
+      // health probes read as a hard backend failure.
+      uint64_t t;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        t = s->next_token++;
+        c->awaiting.push_back(t);
+      }
+      c->done[t] = http_simple_response(
+          501, "Not Implemented",
+          "{\"code\": 12, \"message\": \"method not implemented\"}", false);
+      http_stage_done(s, c);
+      c->want_close = true;
+      c->in.clear();
+      return true;
+    }
+    size_t path_end = req_line.find(' ', path_off);
+    if (path_end == std::string_view::npos) return false;
+    std::string path(req_line.substr(path_off, path_end - path_off));
+
+    size_t content_len = 0;
+    bool keep_alive = true;  // HTTP/1.1 default
+    // header scan (case-insensitive names)
+    size_t pos = (line_end == std::string_view::npos) ? he : line_end + 2;
+    while (pos < he) {
+      size_t eol = head.find("\r\n", pos);
+      std::string_view line =
+          head.substr(pos, (eol == std::string_view::npos ? he : eol) - pos);
+      size_t colon = line.find(':');
+      if (colon != std::string_view::npos) {
+        std::string name(line.substr(0, colon));
+        for (auto& ch : name) ch = (char)tolower((unsigned char)ch);
+        std::string_view val = line.substr(colon + 1);
+        while (!val.empty() && val.front() == ' ') val.remove_prefix(1);
+        if (name == "content-length") {
+          content_len = strtoull(std::string(val).c_str(), nullptr, 10);
+        } else if (name == "connection") {
+          std::string v(val);
+          for (auto& ch : v) ch = (char)tolower((unsigned char)ch);
+          if (v.find("close") != std::string::npos) keep_alive = false;
+        }
+      }
+      if (eol == std::string_view::npos) break;
+      pos = eol + 2;
+    }
+    if (content_len > kMaxBodyBytes) return false;
+    size_t total = he + 4 + content_len;
+    if (c->in.size() < total) return true;  // need more body bytes
+
+    auto* p = new HttpPending;
+    p->fd = c->fd;
+    p->method = method;
+    p->keep_alive = keep_alive;
+    p->path = std::move(path);
+    p->body.assign(c->in, he + 4, content_len);
+    c->in.erase(0, total);
+    if (!keep_alive) c->want_close = true;
+
+    std::unique_lock<std::mutex> lk(s->mu);
+    p->token = s->next_token++;
+    c->awaiting.push_back(p->token);
+    if (s->ready.size() >= kMaxReadyQueue) {
+      // Overload: answer 503 without touching Python — through the
+      // ordered done-queue so pipelined responses never reorder.
+      uint64_t t = p->token;
+      lk.unlock();
+      delete p;
+      c->done[t] = http_simple_response(
+          503, "Service Unavailable",
+          "{\"code\": 14, \"message\": \"ingress queue full\"}", keep_alive);
+      http_stage_done(s, c);
+      continue;
+    }
+    s->token_fd[p->token] = c->fd;
+    s->ready.push_back(p);
+    lk.unlock();
+    s->cv.notify_one();
+  }
+}
+
+void http_loop(HttpServer* s) {
+  epoll_event evs[64];
+  for (;;) {
+    int n = epoll_wait(s->epfd, evs, 64, 200);
+    if (s->stopping.load()) return;
+    // Stage responses Python produced since the last wake.
+    {
+      std::unique_lock<std::mutex> lk(s->mu);
+      while (!s->resp_queue.empty()) {
+        auto [token, resp] = std::move(s->resp_queue.front());
+        s->resp_queue.pop_front();
+        auto tf = s->token_fd.find(token);
+        if (tf == s->token_fd.end()) continue;  // conn died
+        auto ci = s->conns.find(tf->second);
+        s->token_fd.erase(tf);
+        if (ci == s->conns.end()) continue;
+        HttpConn* c = ci->second;
+        c->done[token] = std::move(resp);
+        lk.unlock();
+        http_stage_done(s, c);
+        http_arm(s, c);
+        lk.lock();
+      }
+    }
+    for (int i = 0; i < n; ++i) {
+      int fd = evs[i].data.fd;
+      if (fd == s->evfd) {
+        uint64_t junk;
+        (void)!read(s->evfd, &junk, 8);
+        continue;
+      }
+      if (fd == s->listen_fd) {
+        for (;;) {
+          int cfd = accept4(s->listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
+          if (cfd < 0) break;
+          int one = 1;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+          auto* c = new HttpConn;
+          c->fd = cfd;
+          {
+            std::lock_guard<std::mutex> lk(s->mu);
+            s->conns[cfd] = c;
+          }
+          epoll_event ev{};
+          ev.data.fd = cfd;
+          ev.events = EPOLLIN;
+          epoll_ctl(s->epfd, EPOLL_CTL_ADD, cfd, &ev);
+        }
+        continue;
+      }
+      HttpConn* c;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        auto it = s->conns.find(fd);
+        if (it == s->conns.end()) continue;
+        c = it->second;
+      }
+      bool dead = false;
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        dead = true;
+      }
+      if (!dead && (evs[i].events & EPOLLIN)) {
+        char buf[65536];
+        for (;;) {
+          ssize_t r = read(fd, buf, sizeof buf);
+          if (r > 0) {
+            c->in.append(buf, (size_t)r);
+            if (c->in.size() > kMaxHeaderBytes + kMaxBodyBytes) { dead = true; break; }
+          } else if (r == 0) { dead = true; break; }
+          else { if (errno != EAGAIN && errno != EWOULDBLOCK) dead = true; break; }
+        }
+        if (!dead && !http_drain_input(s, c)) dead = true;
+      }
+      if (!dead && (evs[i].events & EPOLLOUT) && c->out.size() > c->out_off) {
+        ssize_t w = write(fd, c->out.data() + c->out_off, c->out.size() - c->out_off);
+        if (w > 0) {
+          c->out_off += (size_t)w;
+          if (c->out_off == c->out.size()) { c->out.clear(); c->out_off = 0; }
+        } else if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
+          dead = true;
+        }
+      }
+      if (!dead && c->want_close && c->awaiting.empty() && c->done.empty() &&
+          c->out.size() == c->out_off) {
+        dead = true;  // graceful close after the last response flushed
+      }
+      if (dead) http_close_conn(s, c);
+      else http_arm(s, c);
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef struct {
+  uint64_t token;
+  int32_t method;
+  int32_t path_len;
+  int64_t body_len;
+  const char* path;
+  const char* body;
+} GtHttpReq;
+
+void* gt_http_start(const char* host, int port) {
+  auto* s = new HttpServer;
+  s->listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  addr.sin_addr.s_addr = host && *host ? inet_addr(host) : htonl(INADDR_LOOPBACK);
+  if (bind(s->listen_fd, (sockaddr*)&addr, sizeof addr) != 0 ||
+      listen(s->listen_fd, 512) != 0) {
+    close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof addr;
+  getsockname(s->listen_fd, (sockaddr*)&addr, &alen);
+  s->port = ntohs(addr.sin_port);
+  s->epfd = epoll_create1(0);
+  s->evfd = eventfd(0, EFD_NONBLOCK);
+  epoll_event ev{};
+  ev.data.fd = s->listen_fd;
+  ev.events = EPOLLIN;
+  epoll_ctl(s->epfd, EPOLL_CTL_ADD, s->listen_fd, &ev);
+  ev.data.fd = s->evfd;
+  ev.events = EPOLLIN;
+  epoll_ctl(s->epfd, EPOLL_CTL_ADD, s->evfd, &ev);
+  s->loop = std::thread(http_loop, s);
+  return s;
+}
+
+int gt_http_port(void* sv) { return ((HttpServer*)sv)->port; }
+
+// Blocks (GIL released by ctypes) until a request is ready, the server
+// stops (-1), or timeout_ms elapses (0).  1 = *out filled; pointers
+// stay valid until gt_http_respond/gt_http_drop for that token.
+int gt_http_next(void* sv, int64_t timeout_ms, GtHttpReq* out) {
+  auto* s = (HttpServer*)sv;
+  std::unique_lock<std::mutex> lk(s->mu);
+  if (!s->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                      [&] { return !s->ready.empty() || s->stopping.load(); })) {
+    return 0;
+  }
+  if (s->ready.empty()) return -1;  // stopping
+  HttpPending* p = s->ready.front();
+  s->ready.pop_front();
+  s->inflight[p->token] = p;
+  out->token = p->token;
+  out->method = p->method;
+  out->path_len = (int32_t)p->path.size();
+  out->body_len = (int64_t)p->body.size();
+  out->path = p->path.c_str();
+  out->body = p->body.data();
+  return 1;
+}
+
+void gt_http_respond(void* sv, uint64_t token, int status, const char* reason,
+                     const char* ctype, const char* body, int64_t body_len) {
+  auto* s = (HttpServer*)sv;
+  std::string resp = "HTTP/1.1 " + std::to_string(status) + " " +
+                     (reason && *reason ? reason : "OK") +
+                     "\r\nContent-Type: " +
+                     (ctype && *ctype ? ctype : "application/json") +
+                     "\r\nContent-Length: " + std::to_string(body_len) +
+                     "\r\n\r\n";
+  resp.append(body, (size_t)body_len);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    auto it = s->inflight.find(token);
+    if (it != s->inflight.end()) {
+      delete it->second;
+      s->inflight.erase(it);
+    }
+    s->resp_queue.emplace_back(token, std::move(resp));
+    // After shutdown the eventfd is closed (and its number may be
+    // reused elsewhere in the process) — never write it while
+    // stopping.  Checked and written under s->mu: gt_http_shutdown
+    // closes the fds under the same lock after setting stopping, so a
+    // false read here guarantees the fd is still ours.
+    if (!s->stopping.load()) {
+      uint64_t one_u = 1;
+      (void)!write(s->evfd, &one_u, 8);
+    }
+  }
+}
+
+// Two-phase teardown (shutdown -> free): workers may still be blocked
+// in gt_http_next or finishing a long device round that will call
+// gt_http_respond — the HttpServer must stay allocated until every
+// worker has returned.  gt_http_shutdown stops traffic and joins the
+// epoll thread; the caller joins its workers; gt_http_free releases.
+void gt_http_shutdown(void* sv) {
+  auto* s = (HttpServer*)sv;
+  s->stopping.store(true);
+  s->cv.notify_all();
+  uint64_t one_u = 1;
+  (void)!write(s->evfd, &one_u, 8);
+  s->loop.join();
+  std::lock_guard<std::mutex> lk(s->mu);
+  for (auto& [fd, c] : s->conns) {
+    close(fd);
+    delete c;
+  }
+  s->conns.clear();
+  close(s->listen_fd);
+  close(s->epfd);
+  close(s->evfd);
+}
+
+void gt_http_free(void* sv) {
+  auto* s = (HttpServer*)sv;
+  for (auto& [t, p] : s->inflight) delete p;
+  for (auto* p : s->ready) delete p;
+  delete s;
+}
+
+}  // extern "C"

@@ -1,0 +1,310 @@
+"""Native C++ epoll HTTP edge (gt_http_* + gateway.NativeGatewayServer).
+
+Same surface as the stdlib gateway — the handler behind both is ONE
+function (gateway.handle_request) — so these tests focus on what the
+native edge newly owns: framing, keep-alive, pipelining order,
+Connection: close, malformed input, and daemon integration.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from gubernator_tpu import native
+from gubernator_tpu.client import V1Client
+from gubernator_tpu.config import DaemonConfig
+from gubernator_tpu.daemon import Daemon
+from gubernator_tpu.gateway import NativeGatewayServer
+from gubernator_tpu.service import ServiceConfig, V1Service
+from gubernator_tpu.types import (
+    Algorithm,
+    GetRateLimitsRequest,
+    PeerInfo,
+    RateLimitRequest,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native runtime unavailable"
+)
+
+T0 = 1_573_430_430_000
+
+
+@pytest.fixture
+def edge_service(frozen_clock):
+    svc = V1Service(ServiceConfig(cache_size=512, clock=frozen_clock,
+                                  advertise_address="127.0.0.1:9981"))
+    svc.set_peers([PeerInfo(grpc_address="127.0.0.1:9981", is_owner=True)])
+    gw = NativeGatewayServer(svc, "127.0.0.1:0")
+    gw.start()
+    yield gw, svc
+    gw.close()
+    svc.close()
+
+
+@pytest.fixture
+def frozen_clock():
+    from gubernator_tpu.utils.clock import Clock
+
+    c = Clock()
+    c.freeze(T0)
+    return c
+
+
+def _post(addr, path, payload, extra_headers=""):
+    host, _, port = addr.partition(":")
+    body = json.dumps(payload).encode()
+    with socket.create_connection((host, int(port)), timeout=5) as s:
+        s.sendall(
+            f"POST {path} HTTP/1.1\r\nHost: x\r\nContent-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n{extra_headers}\r\n".encode() + body
+        )
+        return _read_response(s)
+
+
+def _read_response(s):
+    data = b""
+    while b"\r\n\r\n" not in data:
+        chunk = s.recv(65536)
+        if not chunk:
+            raise ConnectionError(f"EOF mid-headers: {data!r}")
+        data += chunk
+    head, _, rest = data.partition(b"\r\n\r\n")
+    status = int(head.split(b" ", 2)[1])
+    clen = 0
+    for line in head.split(b"\r\n")[1:]:
+        if line.lower().startswith(b"content-length:"):
+            clen = int(line.split(b":", 1)[1])
+    while len(rest) < clen:
+        chunk = s.recv(65536)
+        if not chunk:
+            raise ConnectionError("EOF mid-body")
+        rest += chunk
+    return status, rest[:clen], rest[clen:]
+
+
+def _rl(key, hits=1, limit=10):
+    return {
+        "name": "ng", "uniqueKey": key, "hits": str(hits),
+        "limit": str(limit), "duration": "60000", "algorithm": "TOKEN_BUCKET",
+    }
+
+
+def test_get_rate_limits_roundtrip(edge_service):
+    gw, _ = edge_service
+    status, body, _ = _post(gw.address, "/v1/GetRateLimits",
+                            {"requests": [_rl("a", hits=3)]})
+    assert status == 200
+    resp = json.loads(body)["responses"][0]
+    assert resp["status"] == "UNDER_LIMIT" and resp["remaining"] == "7"
+
+
+def test_health_metrics_and_404(edge_service):
+    gw, _ = edge_service
+    host, _, port = gw.address.partition(":")
+    with socket.create_connection((host, int(port)), timeout=5) as s:
+        s.sendall(b"GET /v1/HealthCheck HTTP/1.1\r\nHost: x\r\n\r\n")
+        status, body, _ = _read_response(s)
+        assert status == 200 and json.loads(body)["status"] == "healthy"
+        # keep-alive: same connection serves the next two requests
+        s.sendall(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+        status, body, _ = _read_response(s)
+        assert status == 200 and b"gubernator_grpc_request_counts" in body
+        s.sendall(b"GET /nope HTTP/1.1\r\nHost: x\r\n\r\n")
+        status, body, _ = _read_response(s)
+        assert status == 404 and json.loads(body)["code"] == 5
+
+
+def test_invalid_json_is_400(edge_service):
+    gw, _ = edge_service
+    host, _, port = gw.address.partition(":")
+    with socket.create_connection((host, int(port)), timeout=5) as s:
+        s.sendall(
+            b"POST /v1/GetRateLimits HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: 9\r\n\r\nnot json!"
+        )
+        status, body, _ = _read_response(s)
+    assert status == 400
+    assert json.loads(body)["code"] == 3
+
+
+def test_pipelined_requests_answer_in_order(edge_service):
+    """Two requests written back-to-back before reading: responses must
+    come back in request order even though worker threads may finish
+    out of order (the per-connection token-ordered done-queue)."""
+    gw, _ = edge_service
+    host, _, port = gw.address.partition(":")
+    b1 = json.dumps({"requests": [_rl("p1", hits=1, limit=100)]}).encode()
+    b2 = json.dumps({"requests": [_rl("p2", hits=2, limit=200)]}).encode()
+    with socket.create_connection((host, int(port)), timeout=5) as s:
+        s.sendall(
+            b"POST /v1/GetRateLimits HTTP/1.1\r\nHost: x\r\n"
+            + f"Content-Length: {len(b1)}\r\n\r\n".encode() + b1
+            + b"POST /v1/GetRateLimits HTTP/1.1\r\nHost: x\r\n"
+            + f"Content-Length: {len(b2)}\r\n\r\n".encode() + b2
+        )
+        status1, body1, rest = _read_response(s)
+        # Any tail bytes of response 2 already read stay in `rest`.
+        data = rest
+        s.settimeout(5)
+        while b"\r\n\r\n" not in data:
+            data += s.recv(65536)
+        head, _, tail = data.partition(b"\r\n\r\n")
+        status2 = int(head.split(b" ", 2)[1])
+        clen = next(int(l.split(b":", 1)[1]) for l in head.split(b"\r\n")
+                    if l.lower().startswith(b"content-length:"))
+        while len(tail) < clen:
+            tail += s.recv(65536)
+        body2 = tail[:clen]
+    assert status1 == status2 == 200
+    assert json.loads(body1)["responses"][0]["limit"] == "100"
+    assert json.loads(body2)["responses"][0]["limit"] == "200"
+
+
+def test_connection_close_honored(edge_service):
+    gw, _ = edge_service
+    status, body, _ = _post(gw.address, "/v1/GetRateLimits",
+                            {"requests": [_rl("c")]},
+                            extra_headers="Connection: close\r\n")
+    assert status == 200
+
+
+def test_malformed_request_line_closes(edge_service):
+    gw, _ = edge_service
+    host, _, port = gw.address.partition(":")
+    with socket.create_connection((host, int(port)), timeout=5) as s:
+        s.sendall(b"BOGUS\r\n\r\n")
+        assert s.recv(1024) == b""  # server closes without a response
+
+
+def test_concurrent_clients(edge_service):
+    gw, _ = edge_service
+    errs = []
+
+    def worker(tid):
+        try:
+            for i in range(5):
+                status, body, _ = _post(
+                    gw.address, "/v1/GetRateLimits",
+                    {"requests": [_rl(f"w{tid}", limit=1000)] * 8},
+                )
+                assert status == 200, body
+                assert len(json.loads(body)["responses"]) == 8
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in range(12)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+
+
+def test_daemon_uses_native_edge_and_serves_clients(frozen_clock):
+    """native_http=True serves the gateway from the C++ edge; the
+    standard V1Client and the HTTP peer data plane work against it."""
+    d = Daemon(
+        DaemonConfig(
+            listen_address="127.0.0.1:0",
+            grpc_listen_address="127.0.0.1:0",
+            cache_size=512,
+            peer_discovery_type="static",
+            native_http=True,
+        ),
+        clock=frozen_clock,
+    ).start()
+    try:
+        assert isinstance(d.gateway, NativeGatewayServer), type(d.gateway)
+        c = V1Client(d.gateway.address, timeout_s=10.0)
+        r = c.get_rate_limits(GetRateLimitsRequest(requests=[
+            RateLimitRequest(name="d", unique_key="k", hits=4, limit=10,
+                             duration=60_000,
+                             algorithm=Algorithm.TOKEN_BUCKET)
+        ]))
+        assert r.responses[0].remaining == 6
+        hc = c.health_check()
+        assert hc.status == "healthy"
+        # peer HTTP data plane against the native edge
+        status, body, _ = _post(
+            d.gateway.address, "/v1/peer.GetPeerRateLimits",
+            {"requests": [_rl("peer-k", hits=1, limit=9)]},
+        )
+        assert status == 200
+        assert json.loads(body)["rateLimits"][0]["limit"] == "9"
+    finally:
+        d.close()
+
+
+def test_daemon_default_is_stdlib(frozen_clock):
+    from gubernator_tpu.gateway import GatewayServer
+
+    d = Daemon(
+        DaemonConfig(
+            listen_address="127.0.0.1:0",
+            grpc_listen_address="127.0.0.1:0",
+            cache_size=512,
+            peer_discovery_type="static",
+        ),
+        clock=frozen_clock,
+    ).start()
+    try:
+        assert isinstance(d.gateway, GatewayServer), type(d.gateway)
+        c = V1Client(d.gateway.address, timeout_s=10.0)
+        assert c.health_check().status == "healthy"
+    finally:
+        d.close()
+
+
+def test_unknown_method_gets_501(edge_service):
+    """HEAD/OPTIONS/PUT get a parseable 501 response, not a reset —
+    load balancers doing HEAD probes must see HTTP, never a RST."""
+    gw, _ = edge_service
+    host, _, port = gw.address.partition(":")
+    with socket.create_connection((host, int(port)), timeout=5) as s:
+        s.sendall(b"HEAD /v1/HealthCheck HTTP/1.1\r\nHost: x\r\n\r\n")
+        status, body, _ = _read_response(s)
+        assert status == 501
+        assert json.loads(body)["code"] == 12
+        assert s.recv(1024) == b""  # then the server closes
+
+
+def test_native_http_with_tls_is_startup_error(tmp_path, frozen_clock):
+    from gubernator_tpu.tls import TLSConfig
+
+    with pytest.raises(RuntimeError, match="incompatible with TLS"):
+        Daemon(
+            DaemonConfig(
+                listen_address="127.0.0.1:0",
+                grpc_listen_address="127.0.0.1:0",
+                cache_size=64,
+                peer_discovery_type="static",
+                native_http=True,
+                tls=TLSConfig(auto_tls=True),
+            ),
+            clock=frozen_clock,
+        ).start()
+
+
+def test_hostname_listen_address_resolves(frozen_clock):
+    """'localhost:0' must bind (the edge resolves hostnames before the
+    AF_INET-only native bind)."""
+    d = Daemon(
+        DaemonConfig(
+            listen_address="localhost:0",
+            grpc_listen_address="127.0.0.1:0",
+            cache_size=64,
+            peer_discovery_type="static",
+            native_http=True,
+        ),
+        clock=frozen_clock,
+    ).start()
+    try:
+        assert isinstance(d.gateway, NativeGatewayServer)
+        c = V1Client(d.gateway.address, timeout_s=10.0)
+        assert c.health_check().status == "healthy"
+    finally:
+        d.close()
