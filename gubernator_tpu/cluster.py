@@ -46,6 +46,7 @@ class Cluster:
         cache_size: int = 4096,
         g_capacity: int = 256,
         behaviors: Optional[BehaviorConfig] = None,
+        native_http: Optional[bool] = None,
     ) -> "Cluster":
         """cluster/cluster.go:96-131: spawn every daemon, then feed the
         full converged peer list to all of them.  `behaviors` overrides
@@ -61,6 +62,7 @@ class Cluster:
                 data_center=dc,
                 behaviors=behaviors or fast_test_behaviors(),
                 peer_discovery_type="static",
+                native_http=native_http,
             )
             d = Daemon(conf, clock=clock).start()
             self.daemons.append(d)
@@ -91,16 +93,17 @@ class Cluster:
 
     def restart(self, idx: int, clock: Optional[Clock] = None) -> None:
         """cluster/cluster.go:87-93: close and respawn at the same addresses."""
+        import dataclasses
+
         old = self.daemons[idx]
         info = old.peer_info
         old.close()
-        conf = DaemonConfig(
+        # replace() carries EVERY config field (a field-by-field rebuild
+        # silently dropped native_http/back_cache_size on restart).
+        conf = dataclasses.replace(
+            old.conf,
             listen_address=info.http_address,
             grpc_listen_address=info.grpc_address,
-            cache_size=old.conf.cache_size,
-            global_cache_size=old.conf.global_cache_size,
-            data_center=old.conf.data_center,
-            behaviors=old.conf.behaviors,
             peer_discovery_type="static",
         )
         d = Daemon(conf, clock=clock or old.clock).start()

@@ -308,3 +308,74 @@ def test_hostname_listen_address_resolves(frozen_clock):
         assert c.health_check().status == "healthy"
     finally:
         d.close()
+
+
+@pytest.mark.slow
+def test_native_edge_soak_with_shutdown_under_load():
+    """The two-phase teardown under real load: mixed-behavior traffic
+    through TWO native-edge daemons, one closed MID-TRAFFIC.  The
+    surviving daemon keeps serving, the closing daemon's workers (some
+    mid-device-round) join without deadlock or crash, and the failure
+    rate stays at transient-churn levels."""
+    from gubernator_tpu.cluster import Cluster
+    from gubernator_tpu.types import Behavior
+
+    cl = Cluster().start_with(["", ""], native_http=True)
+    assert all(isinstance(d.gateway, NativeGatewayServer) for d in cl.daemons)
+    stop = threading.Event()
+    failures = []
+    totals = {"requests": 0}
+    lock = threading.Lock()
+    behaviors = [0, Behavior.NO_BATCHING, Behavior.GLOBAL]
+
+    def worker(wid):
+        client = V1Client(cl.daemons[0].gateway.address, timeout_s=30.0)
+        i = 0
+        while not stop.is_set():
+            reqs = [
+                RateLimitRequest(
+                    name="nsoak", unique_key=f"k{(i + j) % 5}", hits=1,
+                    limit=1_000_000, duration=60_000,
+                    algorithm=Algorithm.TOKEN_BUCKET,
+                    behavior=behaviors[i % len(behaviors)],
+                )
+                for j in range(4)
+            ]
+            try:
+                resp = client.get_rate_limits(GetRateLimitsRequest(requests=reqs))
+                errs = [r.error for r in resp.responses if r.error]
+                if errs:
+                    with lock:
+                        failures.extend(errs)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    # Weight a whole-batch failure like len(reqs) lane
+                    # failures so the rate denominator stays consistent.
+                    failures.extend([f"{type(e).__name__}: {e}"] * len(reqs))
+            with lock:
+                totals["requests"] += len(reqs)
+            i += 1
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(6)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        # Close daemon 1 mid-traffic (its edge may be answering forwards)
+        # and shrink the ring to the survivor.
+        cl.daemons[1].close()
+        cl.daemons[0].set_peers([cl.daemons[0].peer_info])
+        time.sleep(1.5)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "worker deadlocked"
+        cl.stop()  # Daemon.close() is idempotent for the closed one
+
+    with lock:
+        assert totals["requests"] > 50, "soak made no progress"
+        rate = len(failures) / max(totals["requests"], 1)
+        assert rate < 0.2, (
+            f"{len(failures)}/{totals['requests']} failed; first: {failures[:3]}"
+        )
