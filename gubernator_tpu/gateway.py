@@ -16,6 +16,7 @@ import socket
 import ssl
 import threading
 import time
+from functools import partial
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -241,22 +242,112 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes):
         return 404, "application/json", _json_bytes(
             {"code": 5, "message": f"no handler for {path}"}
         )
-    except ApiError as e:
-        return e.http_status, "application/json", _json_bytes(
-            {"code": _GRPC_CODES.get(e.code, 2), "message": e.message}
-        )
-    except json.JSONDecodeError as e:
-        return 400, "application/json", _json_bytes(
-            {"code": 3, "message": f"invalid JSON: {e}"}
-        )
     except Exception as e:  # noqa: BLE001
-        return 500, "application/json", _json_bytes(
-            {"code": 13, "message": str(e)}
-        )
+        return _error_triplet(e)
 
 
 def _json_bytes(payload) -> bytes:
     return json.dumps(payload).encode("utf-8")
+
+
+def _error_triplet(e: BaseException):
+    """Map a handler exception to (status, content_type, body) — the
+    same arms as handle_request's except clauses, shared with the async
+    path so the two edges answer errors identically."""
+    if isinstance(e, ApiError):
+        return e.http_status, "application/json", _json_bytes(
+            {"code": _GRPC_CODES.get(e.code, 2), "message": e.message}
+        )
+    if isinstance(e, json.JSONDecodeError):
+        return 400, "application/json", _json_bytes(
+            {"code": 3, "message": f"invalid JSON: {e}"}
+        )
+    return 500, "application/json", _json_bytes(
+        {"code": 13, "message": str(e)}
+    )
+
+
+def handle_request_async(service: V1Service, method: str, path: str,
+                         raw: bytes, respond) -> None:
+    """Async twin of handle_request for the device-bound POST paths:
+    parse + submit on the calling thread, deliver via
+    respond(status, content_type, body) exactly once from a completion
+    thread.  Everything else (GET, globals push, unknown paths) answers
+    synchronously — those never wait on a device round.  Used by the
+    native epoll edge so its workers return to the ingress queue
+    instead of parking one thread per in-flight request."""
+    if method != "POST" or path not in (
+        "/v1/GetRateLimits", "/v1/peer.GetPeerRateLimits"
+    ):
+        respond(*handle_request(service, method, path, raw))
+        return
+    rpc = (
+        "/pb.gubernator.V1/GetRateLimits"
+        if path == "/v1/GetRateLimits"
+        else "/pb.gubernator.PeersV1/GetPeerRateLimits"
+    )
+    metrics = service.metrics
+    start = time.perf_counter()
+    finished = [False]  # exactly-once guard: an inline callback that
+    # raised must not re-enter through the outer except and answer the
+    # same token twice (round-5 review finding)
+
+    def finish(status_label: str, triplet) -> None:
+        if finished[0]:
+            return
+        finished[0] = True
+        # Manual observe_rpc: the span covers parse -> response-ready,
+        # like the sync context manager covers parse -> render.
+        metrics.request_counts.labels(status=status_label, method=rpc).inc()
+        metrics.request_duration.labels(method=rpc).observe(
+            time.perf_counter() - start
+        )
+        respond(*triplet)
+
+    try:
+        if path == "/v1/GetRateLimits":
+            cols = parse_body_native(raw) if raw else None
+            native = cols is not None
+            if cols is None:
+                body = json.loads(raw) if raw else {}
+                cols = parse_columns(body.get("requests", []))
+
+            def cb(result, exc):
+                # Guarded like the sync catch-all: a render failure on a
+                # completion thread must become a 500, not a swallowed
+                # exception that leaves the client hanging.
+                try:
+                    if exc is not None:
+                        finish("1", _error_triplet(exc))
+                        return
+                    rendered = (
+                        render_result_native(result) if native else None
+                    )
+                    if rendered is None:  # native render unavailable/cap
+                        rendered = _json_bytes(render_columns(result))
+                    finish("0", (200, "application/json", rendered))
+                except Exception as e:  # noqa: BLE001
+                    finish("1", _error_triplet(e))
+
+            service.get_rate_limits_columns_async(cols, cb)
+        else:
+            body = json.loads(raw) if raw else {}
+            cols = parse_columns(body.get("requests", []))
+
+            def cb(result, exc):
+                try:
+                    if exc is not None:
+                        finish("1", _error_triplet(exc))
+                        return
+                    finish("0", (200, "application/json", _json_bytes(
+                        {"rateLimits": render_columns(result)["responses"]}
+                    )))
+                except Exception as e:  # noqa: BLE001
+                    finish("1", _error_triplet(e))
+
+            service.get_peer_rate_limits_columns_async(cols, cb)
+    except Exception as e:  # noqa: BLE001 — parse/submit errors, before
+        finish("1", _error_triplet(e))  # any callback was registered
 
 
 _HTTP_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
@@ -273,11 +364,12 @@ class NativeGatewayServer:
     cfg8/cfg5).  No TLS — the daemon selects the stdlib gateway when
     TLS is configured."""
 
-    # Workers BLOCK on device rounds inside the service path, so the
-    # pool bounds in-flight requests.  16 measured best on the 1-core
-    # bench host (48 bought nothing: core contention, not pool size,
-    # limits there); multi-core hosts may want ~2x cores.
-    N_WORKERS = 16
+    # Workers only parse + SUBMIT (handle_request_async): the device
+    # round completes through the service's drainer pool and responds
+    # from there, so in-flight requests are bounded by the native
+    # ingress queue, not this pool — a handful of workers keeps the
+    # submit path fed even on a 1-core host.
+    N_WORKERS = 4
 
     def __init__(self, service: V1Service, listen_address: str = "127.0.0.1:0"):
         from . import native as _nat
@@ -287,6 +379,13 @@ class NativeGatewayServer:
         self._host = listen_address.partition(":")[0] or "127.0.0.1"
         self._threads: list = []
         self._stopped = threading.Event()
+        # Responses not yet handed back to the C++ edge: free() must
+        # wait for this to reach zero — async completions outlive the
+        # worker threads, and edge.respond on freed memory is a
+        # use-after-free (shutdown() alone is safe: respond after
+        # shutdown is an explicit no-op C++-side).
+        self._pending = 0
+        self._pending_cv = threading.Condition()
 
     @property
     def address(self) -> str:
@@ -311,10 +410,23 @@ class NativeGatewayServer:
             if getattr(service, "_closed", False):
                 edge.respond(token, 503, b'{"code": 14, "message": "shutting down"}')
                 continue
-            status, ctype, payload = handle_request(service, method, path, body)
-            edge.respond(token, status, payload,
-                         reason=_HTTP_REASONS.get(status, "Error"),
-                         content_type=ctype)
+            with self._pending_cv:
+                self._pending += 1
+            handle_request_async(
+                service, method, path, body, partial(self._respond, token)
+            )
+
+    def _respond(self, token: int, status: int, ctype: str,
+                 payload: bytes) -> None:
+        try:
+            self._edge.respond(token, status, payload,
+                               reason=_HTTP_REASONS.get(status, "Error"),
+                               content_type=ctype)
+        finally:
+            with self._pending_cv:
+                self._pending -= 1
+                if self._pending == 0:
+                    self._pending_cv.notify_all()
 
     def close(self) -> None:
         # Teardown order matters (round-5 review: use-after-free):
@@ -328,7 +440,17 @@ class NativeGatewayServer:
         deadline = time.monotonic() + 30.0
         for t in self._threads:
             t.join(timeout=max(deadline - time.monotonic(), 0.1))
-        if all(not t.is_alive() for t in self._threads):
+        # Async completions (service drainer / forward pool) may still
+        # owe edge.respond calls after the workers exit; free() only
+        # when none remain (a stuck completion leaks the edge instead
+        # of crashing into freed memory, same policy as a stuck worker).
+        with self._pending_cv:
+            self._pending_cv.wait_for(
+                lambda: self._pending == 0,
+                timeout=max(deadline - time.monotonic(), 0.1),
+            )
+            drained = self._pending == 0
+        if drained and all(not t.is_alive() for t in self._threads):
             self._edge.free()
 
 

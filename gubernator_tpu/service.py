@@ -16,7 +16,10 @@ import time
 from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field, replace
-from typing import Dict, List, Optional, Sequence
+from functools import partial
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .utils.logging import category_logger
 
 import numpy as np
 
@@ -45,6 +48,8 @@ from .utils.interval import Interval
 HEALTHY = "healthy"
 UNHEALTHY = "unhealthy"
 ERR_BATCHER_CLOSED = "local batcher is closed"
+
+logger = category_logger("gubernator")
 
 
 class ApiError(Exception):
@@ -212,6 +217,242 @@ class ColumnarResult:
         )
 
 
+@dataclass
+class _ColumnsPlan:
+    """Everything phase 1 (V1Service._submit_columns) left in flight:
+    consumed either by the blocking _finalize_columns or by the
+    callback-driven _ColumnsJoin — one submit path, two completion
+    modes."""
+
+    pendings: list  # [(batcher Future | (handle, lo, hi), fast_idx)]
+    group_futs: Dict[str, "Future"]  # owner addr -> forward future
+    remote_groups: Dict[str, list]  # owner addr -> [lane idx]
+    slow_idx: list  # lanes for the dataclass router
+    slow_fn: "Optional[Callable[[], list]]"  # blocking slow-lane resolver
+    hash_keys: object  # List[str] | PackedKeys
+
+
+def _deliver_future(callback, fut) -> None:
+    """Bridge a concurrent Future to the callback(result, exc) shape,
+    calling it exactly once (a raising callback must not re-enter)."""
+    try:
+        value, exc = fut.result(), None
+    except Exception as e:  # noqa: BLE001
+        value, exc = None, e
+    callback(value, exc)
+
+
+def _merge_fast_result(result, hash_keys, fast_idx, out, sl, exc) -> None:
+    """Scatter one resolved fast dispatch into `result` (or convert a
+    dispatch failure to per-lane errors) — the shared merge body of the
+    blocking _resolve_fast and the async _ColumnsJoin."""
+    if exc is not None:
+        for i in fast_idx:
+            result.overrides[int(i)] = RateLimitResponse(
+                error=f"while applying rate limit '{hash_keys[int(i)]}' - '{exc}'"
+            )
+        return
+    if fast_idx.size == result.n:
+        result.status = np.asarray(out["status"][sl], dtype=np.int32)
+        result.limit = np.asarray(out["limit"][sl], dtype=np.int64)
+        result.remaining = np.asarray(out["remaining"][sl], dtype=np.int64)
+        result.reset_time = np.asarray(out["reset_time"][sl], dtype=np.int64)
+    else:
+        result.status[fast_idx] = out["status"][sl]
+        result.limit[fast_idx] = out["limit"][sl]
+        result.remaining[fast_idx] = out["remaining"][sl]
+        result.reset_time[fast_idx] = out["reset_time"][sl]
+
+
+class _HandleDrainer:
+    """Resolves columnar dispatch handles OFF the request thread: a
+    small pool blocks on handle.result() (the device readback) and
+    fires callbacks.  The pool size bounds concurrently-overlapping
+    readbacks — matching the store's dispatch-depth backstop
+    (ColumnarBatcher.MAX_INFLIGHT) — NOT the in-flight request count,
+    which is the point: the sync path parks one caller thread per
+    request for the whole device round; this parks one thread per
+    DISPATCH, so a 100-way storm coalescing into a handful of windows
+    costs a handful of blocked threads."""
+
+    N_THREADS = 8
+
+    def __init__(self):
+        self._q: "deque" = deque()
+        self._cv = threading.Condition()
+        self._stopped = False
+        self._threads: list = []
+
+    def start(self) -> None:
+        for i in range(self.N_THREADS):
+            t = threading.Thread(
+                target=self._run, daemon=True, name=f"columns-drain-{i}"
+            )
+            t.start()
+            self._threads.append(t)
+
+    def register(self, handle, cb) -> None:
+        """cb(value, exc) fires exactly once from a drainer thread (or
+        inline with a shutdown error when the drainer has stopped)."""
+        with self._cv:
+            if not self._stopped:
+                self._q.append((handle, cb))
+                self._cv.notify()
+                return
+        cb(None, PeerError(ERR_BATCHER_CLOSED))
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._stopped:
+                    self._cv.wait()
+                if not self._q:
+                    return  # stopped and drained
+                handle, cb = self._q.popleft()
+            value, exc = None, None
+            try:
+                value = handle.result()
+            except Exception as e:  # noqa: BLE001
+                exc = e
+            try:
+                cb(value, exc)
+            except Exception:  # noqa: BLE001 — a callback must not kill the pool
+                logger.exception("columns drainer callback failed")
+
+    def stop(self, timeout_s: float = 30.0) -> None:
+        """Resolve everything already registered (workers drain the
+        queue before exiting), then join.  Late register() calls fail
+        fast with the batcher-closed error."""
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        deadline = time.monotonic() + timeout_s
+        for t in self._threads:
+            t.join(timeout=max(deadline - time.monotonic(), 0.1))
+
+
+class _ColumnsJoin:
+    """Completion join for one async columnar request: counts down the
+    plan's sub-completions (fast dispatch handles via the drainer,
+    owner-group forwards, the slow-lane route) and fires the callback
+    exactly once from whichever completion thread finishes last.  The
+    merge logic is the same _merge_fast_result / override-merge the
+    blocking _finalize_columns uses."""
+
+    def __init__(self, svc, plan, result, callback):
+        self.svc = svc
+        self.plan = plan
+        self.result = result
+        self.callback = callback
+        self._lock = threading.Lock()
+        self._remaining = 0
+        self._failure: "Optional[Exception]" = None
+        self._fast_outs: list = []  # (fast_idx, out, slice, exc)
+        self._group_res: dict = {}  # addr -> resps | Exception
+        self._slow_resps: "Optional[list]" = None
+
+    def start(self) -> None:
+        svc, plan = self.svc, self.plan
+        parts = (
+            len(plan.pendings)
+            + len(plan.group_futs)
+            + (1 if plan.slow_idx else 0)
+        )
+        if parts == 0:
+            self._finish()
+            return
+        self._remaining = parts
+        drainer = svc._get_drainer()
+        if plan.slow_idx:
+            # slow_fn runs _route / store.apply, which block on (and for
+            # _route, submit to) _forward_pool — the slow pool keeps the
+            # outer task off the pool its inner tasks need.
+            svc._slow_pool.submit(plan.slow_fn).add_done_callback(
+                self._on_slow
+            )
+        for addr, fut in plan.group_futs.items():
+            fut.add_done_callback(partial(self._on_group, addr))
+        for pending, fast_idx in plan.pendings:
+            if isinstance(pending, Future):
+                pending.add_done_callback(
+                    partial(self._on_dispatched, fast_idx, drainer)
+                )
+            else:
+                handle, lo, hi = pending
+                drainer.register(
+                    handle, partial(self._on_out, fast_idx, slice(lo, hi))
+                )
+
+    # -- sub-completion handlers (any thread) --------------------------
+    def _on_dispatched(self, fast_idx, drainer, fut) -> None:
+        try:
+            handle, lo, hi = fut.result()
+        except Exception as e:  # noqa: BLE001
+            self._on_out(fast_idx, None, None, e)
+            return
+        drainer.register(handle, partial(self._on_out, fast_idx, slice(lo, hi)))
+
+    def _on_out(self, fast_idx, sl, out, exc) -> None:
+        with self._lock:
+            self._fast_outs.append((fast_idx, out, sl, exc))
+        self._countdown()
+
+    def _on_group(self, addr, fut) -> None:
+        try:
+            resps = fut.result()
+        except Exception as e:  # noqa: BLE001 — _forward_group converts
+            resps = e  # internally; this is pool-failure defensive
+        with self._lock:
+            self._group_res[addr] = resps
+        self._countdown()
+
+    def _on_slow(self, fut) -> None:
+        try:
+            self._slow_resps = fut.result()
+        except Exception as e:  # noqa: BLE001
+            # The sync path propagates a slow-route failure to the
+            # caller (a 500 at the edge); same contract here.
+            with self._lock:
+                self._failure = e
+        self._countdown()
+
+    def _countdown(self) -> None:
+        with self._lock:
+            self._remaining -= 1
+            if self._remaining > 0:
+                return
+        self._finish()
+
+    def _finish(self) -> None:
+        result, err = self.result, self._failure
+        if err is None:
+            try:
+                plan = self.plan
+                if self._slow_resps is not None:
+                    for i, r in zip(plan.slow_idx, self._slow_resps):
+                        result.overrides[int(i)] = r
+                for addr, resps in self._group_res.items():
+                    idxs = plan.remote_groups[addr]
+                    if isinstance(resps, Exception):
+                        for i in idxs:
+                            result.overrides[int(i)] = RateLimitResponse(
+                                error=(
+                                    "while fetching rate limit from peer - "
+                                    f"'{resps}'"
+                                )
+                            )
+                    else:
+                        for i, r in zip(idxs, resps):
+                            result.overrides[int(i)] = r
+                for fast_idx, out, sl, exc in self._fast_outs:
+                    _merge_fast_result(
+                        result, plan.hash_keys, fast_idx, out, sl, exc
+                    )
+            except Exception as e:  # noqa: BLE001
+                result, err = None, e
+        self.callback(result if err is None else None, err)
+
+
 class ColumnarBatcher:
     """Ingress coalescer for COLUMN-form batches: concurrent multi-item
     requests inside one BatchWait window (config.go:107-109 semantics)
@@ -348,6 +589,18 @@ class V1Service:
         self._peer_mutex = threading.RLock()
         self._health = HealthCheckResponse(status=HEALTHY)
         self._forward_pool = ThreadPoolExecutor(max_workers=64)
+        # Async slow-lane / dataclass-fallback work runs on its OWN pool:
+        # those tasks run _route, which submits leaf forwards to
+        # _forward_pool and BLOCKS — putting them on _forward_pool too
+        # would let 64 outer tasks fill the pool and deadlock waiting on
+        # inner tasks queued behind them (round-5 review finding).  Leaf
+        # tasks never submit further work, so outer-on-_slow_pool /
+        # inner-on-_forward_pool cannot cycle.
+        self._slow_pool = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="columns-slow"
+        )
+        self._drainer: "Optional[_HandleDrainer]" = None
+        self._drainer_lock = threading.Lock()
         self._closed = False
 
         if conf.loader is not None:
@@ -422,7 +675,19 @@ class V1Service:
             resp = self._route([cols.request_at(i) for i in range(n)])
             result.overrides = dict(enumerate(resp.responses))
             return result
+        plan = self._submit_columns(cols, result)
+        if plan is None:
+            return result
+        return self._finalize_columns(plan, result)
 
+    def _submit_columns(self, cols, result) -> "Optional[_ColumnsPlan]":
+        """Phase 1 of the columnar route: validation, ownership, MR
+        queueing, and EVERY dispatch/forward submission — no blocking on
+        device rounds or peer RPCs.  Returns None when the request fully
+        resolved already (empty pool); otherwise a plan for
+        _finalize_columns (sync) or _ColumnsJoin (async) to complete.
+        Shared by both so the two entry points cannot diverge."""
+        n = len(cols)
         beh = cols.behavior
         # GLOBAL lanes need the replica-cache/dataclass path; MULTI_REGION
         # lanes stay columnar when locally owned (their only extra duty is
@@ -487,7 +752,7 @@ class V1Service:
                                 f"'{hash_keys[i]}' - 'unable to pick a peer; pool is empty'"
                             )
                         )
-                return result
+                return None
             if not single_owner and psize >= 1:
                 if pre is not None and not isinstance(hash_keys, list):
                     # Picker routing indexes by emptiness; materialize
@@ -535,17 +800,30 @@ class V1Service:
         # Remaining slow lanes (GLOBAL remote/local specials) ride the
         # dataclass router.
         slow_idx = [int(i) for i in np.nonzero(slow)[0] if int(i) not in grouped]
-        if slow_idx:
-            resp = self._route([cols.request_at(i) for i in slow_idx])
-            for i, r in zip(slow_idx, resp.responses):
-                result.overrides[i] = r
+        slow_reqs = [cols.request_at(i) for i in slow_idx]
+        return _ColumnsPlan(
+            pendings=pendings,
+            group_futs=group_futs,
+            remote_groups=remote_groups,
+            slow_idx=slow_idx,
+            slow_fn=(
+                (lambda: self._route(slow_reqs).responses) if slow_idx else None
+            ),
+            hash_keys=hash_keys,
+        )
 
-        for addr, fut in group_futs.items():
-            resps = fut.result()
-            for i, r in zip(remote_groups[addr], resps):
+    def _finalize_columns(self, plan: "_ColumnsPlan", result) -> ColumnarResult:
+        """Phase 2, blocking form: resolve every submission from phase 1
+        and merge into `result` (the async twin is _ColumnsJoin)."""
+        if plan.slow_idx:
+            resps = plan.slow_fn()
+            for i, r in zip(plan.slow_idx, resps):
                 result.overrides[int(i)] = r
-
-        self._resolve_fast(pendings, hash_keys, result)
+        for addr, fut in plan.group_futs.items():
+            resps = fut.result()
+            for i, r in zip(plan.remote_groups[addr], resps):
+                result.overrides[int(i)] = r
+        self._resolve_fast(plan.pendings, plan.hash_keys, result)
         return result
 
     # -- shared fast-lane halves of the two columnar entry points ------
@@ -637,28 +915,16 @@ class V1Service:
         result; a dispatch failure (e.g. shutdown race) converts to
         per-lane errors instead of failing lanes already computed."""
         for pending, fast_idx in pendings:
+            out, sl, exc = None, None, None
             try:
                 handle, lo, hi = (
                     pending.result() if isinstance(pending, Future) else pending
                 )
                 out = handle.result()
+                sl = slice(lo, hi)
             except Exception as e:  # noqa: BLE001
-                for i in fast_idx:
-                    result.overrides[int(i)] = RateLimitResponse(
-                        error=f"while applying rate limit '{hash_keys[int(i)]}' - '{e}'"
-                    )
-                continue
-            sl = slice(lo, hi)
-            if fast_idx.size == result.n:
-                result.status = np.asarray(out["status"][sl], dtype=np.int32)
-                result.limit = np.asarray(out["limit"][sl], dtype=np.int64)
-                result.remaining = np.asarray(out["remaining"][sl], dtype=np.int64)
-                result.reset_time = np.asarray(out["reset_time"][sl], dtype=np.int64)
-            else:
-                result.status[fast_idx] = out["status"][sl]
-                result.limit[fast_idx] = out["limit"][sl]
-                result.remaining[fast_idx] = out["remaining"][sl]
-                result.reset_time[fast_idx] = out["reset_time"][sl]
+                exc = e
+            _merge_fast_result(result, hash_keys, fast_idx, out, sl, exc)
 
     def _route(self, requests: Sequence[RateLimitRequest]) -> GetRateLimitsResponse:
         n = len(requests)
@@ -848,14 +1114,20 @@ class V1Service:
         result = ColumnarResult.empty(n)
         if n == 0:
             return result
-        beh = cols.behavior
         if not getattr(self.store, "supports_columns", False):
             req = GetRateLimitsRequest(
                 requests=[cols.request_at(i) for i in range(n)]
             )
             result.overrides = dict(enumerate(self.get_peer_rate_limits(req).responses))
             return result
+        plan = self._submit_peer_columns(cols, result)
+        return self._finalize_columns(plan, result)
 
+    def _submit_peer_columns(self, cols, result) -> "_ColumnsPlan":
+        """Phase 1 of the PeersV1 columnar receive (shared by the sync
+        entry above and get_peer_rate_limits_columns_async)."""
+        n = len(cols)
+        beh = cols.behavior
         slow = (beh & int(Behavior.GLOBAL)) != 0
         fast = np.logical_not(slow)
         hash_keys = [
@@ -868,16 +1140,104 @@ class V1Service:
         self._queue_mr_fast(cols, beh, np.ones(n, dtype=bool), hash_keys)
         pendings = self._dispatch_fast(cols, beh, fast, hash_keys, result)
 
-        slow_idx = np.nonzero(slow)[0]
-        if slow_idx.size:
-            resps = self.store.apply(
-                [cols.request_at(int(i)) for i in slow_idx], self.clock.now_ms()
-            )
-            for i, r in zip(slow_idx, resps):
-                result.overrides[int(i)] = r
+        slow_idx = [int(i) for i in np.nonzero(slow)[0]]
+        slow_reqs = [cols.request_at(i) for i in slow_idx]
+        return _ColumnsPlan(
+            pendings=pendings,
+            group_futs={},
+            remote_groups={},
+            slow_idx=slow_idx,
+            slow_fn=(
+                (lambda: self.store.apply(slow_reqs, self.clock.now_ms()))
+                if slow_idx
+                else None
+            ),
+            hash_keys=hash_keys,
+        )
 
-        self._resolve_fast(pendings, hash_keys, result)
-        return result
+    # -- async columnar entry points (native-edge completion path) -----
+    def _get_drainer(self) -> "_HandleDrainer":
+        """Lazily start the handle-drainer pool (most embedders never
+        use the async entry points; don't cost them 8 idle threads)."""
+        with self._drainer_lock:
+            if self._drainer is None:
+                d = _HandleDrainer()
+                d.start()
+                self._drainer = d
+            return self._drainer
+
+    def get_rate_limits_columns_async(
+        self, cols: IngressColumns, callback: "Callable"
+    ) -> None:
+        """Async twin of get_rate_limits_columns: submits everything on
+        the calling thread (validation, routing, dispatch/forward — no
+        blocking), then delivers via callback(result, exc) exactly once
+        from a completion thread.  Built for the native epoll edge: a
+        worker hands off and returns to the ingress queue immediately,
+        so the number of in-flight requests — and therefore how many
+        callers one coalescing window can merge — is bounded by the
+        ingress queue, not by a blocked-thread pool (the measured
+        convoy that cost the native edge its bulk throughput,
+        benchmarks/RESULTS.md round-5 A/B)."""
+        try:
+            if len(cols) > MAX_BATCH_SIZE:
+                raise ApiError(
+                    "OutOfRange",
+                    f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'",
+                )
+            n = len(cols)
+            result = ColumnarResult.empty(n)
+            if n == 0:
+                callback(result, None)
+                return
+            if n == 1 or not getattr(self.store, "supports_columns", False):
+                # Dataclass fallback blocks (LocalBatcher / peer RPCs):
+                # run it on the slow pool (NOT _forward_pool — _route
+                # submits leaf forwards there and blocks; sharing the
+                # pool deadlocks at saturation).  Per-REQUEST thread
+                # use, but only for single-key / exotic-store shapes.
+                fut = self._slow_pool.submit(
+                    self.get_rate_limits_columns, cols
+                )
+                fut.add_done_callback(partial(_deliver_future, callback))
+                return
+            plan = self._submit_columns(cols, result)
+        except Exception as e:  # noqa: BLE001
+            callback(None, e)
+            return
+        if plan is None:
+            callback(result, None)
+            return
+        _ColumnsJoin(self, plan, result, callback).start()
+
+    def get_peer_rate_limits_columns_async(
+        self, cols: IngressColumns, callback: "Callable"
+    ) -> None:
+        """Async twin of get_peer_rate_limits_columns (the owner-side
+        receive of forwarded batches — the OTHER device-bound endpoint a
+        native-edge worker must not block on)."""
+        try:
+            if len(cols) > MAX_BATCH_SIZE:
+                raise ApiError(
+                    "OutOfRange",
+                    f"'PeerRequest.rate_limits' list too large; max size is '{MAX_BATCH_SIZE}'",
+                )
+            n = len(cols)
+            result = ColumnarResult.empty(n)
+            if n == 0:
+                callback(result, None)
+                return
+            if not getattr(self.store, "supports_columns", False):
+                fut = self._slow_pool.submit(
+                    self.get_peer_rate_limits_columns, cols
+                )
+                fut.add_done_callback(partial(_deliver_future, callback))
+                return
+            plan = self._submit_peer_columns(cols, result)
+        except Exception as e:  # noqa: BLE001
+            callback(None, e)
+            return
+        _ColumnsJoin(self, plan, result, callback).start()
 
     def update_peer_globals(self, updates: Sequence[UpdatePeerGlobal]) -> None:
         """gubernator.go:259-272."""
@@ -959,9 +1319,17 @@ class V1Service:
         self._closed = True
         self.local_batcher.stop()
         self.columnar_batcher.stop()
+        # After the batchers stop every pending future is resolved, so
+        # all handles are registered; the drainer resolves them (device
+        # rounds complete) before the store/pools go away.
+        with self._drainer_lock:
+            drainer = self._drainer
+        if drainer is not None:
+            drainer.stop()
         self.global_mgr.stop()
         self.multi_region_mgr.stop()
         self._forward_pool.shutdown(wait=False)
+        self._slow_pool.shutdown(wait=False)
         if self.conf.loader is not None:
             self.conf.loader.save(self.store.snapshot_items())
         for peer in self.get_peer_list() + list(self.region_picker.peers()):

@@ -57,7 +57,7 @@ def frozen_clock():
 def _post(addr, path, payload, extra_headers=""):
     host, _, port = addr.partition(":")
     body = json.dumps(payload).encode()
-    with socket.create_connection((host, int(port)), timeout=5) as s:
+    with socket.create_connection((host, int(port)), timeout=30) as s:
         s.sendall(
             f"POST {path} HTTP/1.1\r\nHost: x\r\nContent-Type: application/json\r\n"
             f"Content-Length: {len(body)}\r\n{extra_headers}\r\n".encode() + body
@@ -105,7 +105,7 @@ def test_get_rate_limits_roundtrip(edge_service):
 def test_health_metrics_and_404(edge_service):
     gw, _ = edge_service
     host, _, port = gw.address.partition(":")
-    with socket.create_connection((host, int(port)), timeout=5) as s:
+    with socket.create_connection((host, int(port)), timeout=30) as s:
         s.sendall(b"GET /v1/HealthCheck HTTP/1.1\r\nHost: x\r\n\r\n")
         status, body, _ = _read_response(s)
         assert status == 200 and json.loads(body)["status"] == "healthy"
@@ -121,7 +121,7 @@ def test_health_metrics_and_404(edge_service):
 def test_invalid_json_is_400(edge_service):
     gw, _ = edge_service
     host, _, port = gw.address.partition(":")
-    with socket.create_connection((host, int(port)), timeout=5) as s:
+    with socket.create_connection((host, int(port)), timeout=30) as s:
         s.sendall(
             b"POST /v1/GetRateLimits HTTP/1.1\r\nHost: x\r\n"
             b"Content-Length: 9\r\n\r\nnot json!"
@@ -139,7 +139,7 @@ def test_pipelined_requests_answer_in_order(edge_service):
     host, _, port = gw.address.partition(":")
     b1 = json.dumps({"requests": [_rl("p1", hits=1, limit=100)]}).encode()
     b2 = json.dumps({"requests": [_rl("p2", hits=2, limit=200)]}).encode()
-    with socket.create_connection((host, int(port)), timeout=5) as s:
+    with socket.create_connection((host, int(port)), timeout=30) as s:
         s.sendall(
             b"POST /v1/GetRateLimits HTTP/1.1\r\nHost: x\r\n"
             + f"Content-Length: {len(b1)}\r\n\r\n".encode() + b1
@@ -175,7 +175,7 @@ def test_connection_close_honored(edge_service):
 def test_malformed_request_line_closes(edge_service):
     gw, _ = edge_service
     host, _, port = gw.address.partition(":")
-    with socket.create_connection((host, int(port)), timeout=5) as s:
+    with socket.create_connection((host, int(port)), timeout=30) as s:
         s.sendall(b"BOGUS\r\n\r\n")
         assert s.recv(1024) == b""  # server closes without a response
 
@@ -264,12 +264,66 @@ def test_unknown_method_gets_501(edge_service):
     load balancers doing HEAD probes must see HTTP, never a RST."""
     gw, _ = edge_service
     host, _, port = gw.address.partition(":")
-    with socket.create_connection((host, int(port)), timeout=5) as s:
+    with socket.create_connection((host, int(port)), timeout=30) as s:
         s.sendall(b"HEAD /v1/HealthCheck HTTP/1.1\r\nHost: x\r\n\r\n")
         status, body, _ = _read_response(s)
         assert status == 501
         assert json.loads(body)["code"] == 12
         assert s.recv(1024) == b""  # then the server closes
+
+
+def test_async_inflight_exceeds_worker_pool(edge_service):
+    """The async completion path's defining property: far more
+    concurrent in-flight requests than worker threads (N_WORKERS=4),
+    all served, with exact hit accounting — the old blocking-worker
+    edge would cap coalescing (and convoy) at the pool size."""
+    gw, svc = edge_service
+    n_clients, per_client = 24, 4
+    errs: list = []
+
+    def worker(tid):
+        try:
+            host, _, port = gw.address.partition(":")
+            with socket.create_connection((host, int(port)), timeout=30) as s:
+                for i in range(per_client):
+                    body = json.dumps(
+                        {"requests": [_rl("shared", limit=100000)] * 4}
+                    ).encode()
+                    s.sendall(
+                        b"POST /v1/GetRateLimits HTTP/1.1\r\nHost: x\r\n"
+                        b"Content-Length: %d\r\n\r\n" % len(body) + body
+                    )
+                    status, rbody, _ = _read_response(s)
+                    assert status == 200, rbody
+                    assert len(json.loads(rbody)["responses"]) == 4
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in range(n_clients)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    # Exact accounting: every request drained 4 hits off one key.
+    status, rbody, _ = _post(gw.address, "/v1/GetRateLimits",
+                             {"requests": [_rl("shared", hits=0, limit=100000)]})
+    assert status == 200
+    rem = int(json.loads(rbody)["responses"][0]["remaining"])
+    assert rem == 100000 - n_clients * per_client * 4
+
+
+def test_peer_endpoint_async_roundtrip(edge_service):
+    """The PeersV1 receive path rides the async completion too."""
+    gw, _ = edge_service
+    status, body, _ = _post(
+        gw.address, "/v1/peer.GetPeerRateLimits",
+        {"requests": [_rl("peer-async", hits=2, limit=50)] * 3},
+    )
+    assert status == 200
+    resps = json.loads(body)["rateLimits"]
+    assert len(resps) == 3
+    assert int(resps[-1]["remaining"]) == 50 - 6
 
 
 def test_native_http_with_tls_is_startup_error(tmp_path, frozen_clock):
