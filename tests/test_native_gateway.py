@@ -180,6 +180,105 @@ def test_malformed_request_line_closes(edge_service):
         assert s.recv(1024) == b""  # server closes without a response
 
 
+def test_trickled_request_frames_correctly(edge_service):
+    """A request delivered one byte at a time (worst-case TCP
+    segmentation) must frame identically to a single write."""
+    gw, _ = edge_service
+    host, _, port = gw.address.partition(":")
+    body = json.dumps({"requests": [_rl("trickle", hits=2)]}).encode()
+    raw = (b"POST /v1/GetRateLimits HTTP/1.1\r\nHost: x\r\n"
+           + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+    with socket.create_connection((host, int(port)), timeout=30) as s:
+        # byte-at-a-time through the headers, then the body in 3 chunks
+        split = raw.index(b"\r\n\r\n") + 4
+        for i in range(split):
+            s.sendall(raw[i:i + 1])
+        third = max(1, (len(raw) - split) // 3)
+        for off in range(split, len(raw), third):
+            s.sendall(raw[off:off + third])
+            time.sleep(0.005)
+        status, rbody, _ = _read_response(s)
+    assert status == 200
+    assert json.loads(rbody)["responses"][0]["remaining"] == "8"
+
+
+def test_oversize_header_closes_connection(edge_service):
+    """A header block past the 64 KiB cap must kill the connection, not
+    buffer unboundedly."""
+    gw, _ = edge_service
+    host, _, port = gw.address.partition(":")
+    with socket.create_connection((host, int(port)), timeout=30) as s:
+        s.sendall(b"POST /v1/GetRateLimits HTTP/1.1\r\n")
+        try:
+            # no terminating \r\n\r\n: stream junk headers past the cap
+            for _ in range(80):
+                s.sendall(b"X-Pad: " + b"a" * 1024 + b"\r\n")
+            got = s.recv(1024)
+        except (BrokenPipeError, ConnectionResetError):
+            got = b""
+        assert got == b""  # server closed without a response
+
+
+def test_oversize_content_length_closes_connection(edge_service):
+    """Content-Length past the body cap is rejected at the header, not
+    after buffering 32 MiB."""
+    gw, _ = edge_service
+    host, _, port = gw.address.partition(":")
+    with socket.create_connection((host, int(port)), timeout=30) as s:
+        s.sendall(b"POST /v1/GetRateLimits HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Length: 99999999999\r\n\r\n")
+        assert s.recv(1024) == b""
+
+
+def test_disconnect_mid_body_is_survivable(edge_service):
+    """A client vanishing mid-body must not wedge the edge or leak the
+    half-request into the service; the next client is served."""
+    gw, _ = edge_service
+    host, _, port = gw.address.partition(":")
+    s = socket.create_connection((host, int(port)), timeout=30)
+    s.sendall(b"POST /v1/GetRateLimits HTTP/1.1\r\nHost: x\r\n"
+              b"Content-Length: 5000\r\n\r\n" + b"{" * 100)
+    s.close()  # abort with 4900 bytes owed
+    status, body, _ = _post(gw.address, "/v1/GetRateLimits",
+                            {"requests": [_rl("after-abort")]})
+    assert status == 200
+    assert json.loads(body)["responses"][0]["status"] == "UNDER_LIMIT"
+
+
+def test_disconnect_with_response_in_flight(edge_service):
+    """Client closes after sending a full request but before reading
+    the response: the completion must discard safely (token unmapped),
+    and the edge keeps serving."""
+    gw, _ = edge_service
+    host, _, port = gw.address.partition(":")
+    body = json.dumps({"requests": [_rl("ghost", hits=1)] * 50}).encode()
+    for _ in range(5):
+        s = socket.create_connection((host, int(port)), timeout=30)
+        s.sendall(b"POST /v1/GetRateLimits HTTP/1.1\r\nHost: x\r\n"
+                  + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+        s.close()  # don't read the response
+    time.sleep(0.5)
+    status, rbody, _ = _post(gw.address, "/v1/GetRateLimits",
+                             {"requests": [_rl("ghost", hits=0)]})
+    assert status == 200
+    # The 250 ghost hits actually applied (limit 10 -> fully drained):
+    # an unread response discards the BYTES, never the state change.
+    assert int(json.loads(rbody)["responses"][0]["remaining"]) == 0
+
+
+def test_header_names_case_insensitive(edge_service):
+    gw, _ = edge_service
+    host, _, port = gw.address.partition(":")
+    body = json.dumps({"requests": [_rl("case")]}).encode()
+    with socket.create_connection((host, int(port)), timeout=30) as s:
+        s.sendall(b"POST /v1/GetRateLimits HTTP/1.1\r\nhost: x\r\n"
+                  b"CONTENT-LENGTH: " + str(len(body)).encode()
+                  + b"\r\ncOnNeCtIoN: Close\r\n\r\n" + body)
+        status, rbody, _ = _read_response(s)
+        assert status == 200
+        assert s.recv(1024) == b""  # Connection: close honored
+
+
 def test_concurrent_clients(edge_service):
     gw, _ = edge_service
     errs = []
