@@ -76,6 +76,12 @@ class DaemonConfig:
     # throughput on few-core hosts — measured A/B in RESULTS.md).
     # Env: GUBER_NATIVE_HTTP=1/0.
     native_http: "bool | None" = None
+    # Native-edge Python worker count (parse + submit only — the async
+    # completion path means workers never block on device rounds, so a
+    # handful saturates the submit path; raise on many-core hosts if
+    # /metrics shows ingress-queue 503s).  None = NativeGatewayServer
+    # default (4).  Env: GUBER_NATIVE_WORKERS.
+    native_workers: "int | None" = None
     data_center: str = ""
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
     # Static peer list (the zero-dependency discovery mode; etcd/
@@ -227,6 +233,9 @@ def setup_daemon_config(
     v = merged.get("GUBER_NATIVE_HTTP", "")
     if v:
         conf.native_http = v.strip().lower() in ("1", "true", "yes", "on")
+    conf.native_workers = _env_int(
+        merged, "GUBER_NATIVE_WORKERS", conf.native_workers
+    )
     conf.data_center = merged.get("GUBER_DATA_CENTER", "")
     if merged.get("GUBER_WARMUP_SHAPES"):
         conf.warmup_shapes = [

@@ -109,7 +109,8 @@ class Daemon:
                     f"{_native.build_error()}"
                 )
             self.gateway = NativeGatewayServer(
-                self.service, self.conf.listen_address
+                self.service, self.conf.listen_address,
+                n_workers=self.conf.native_workers,
             )
         if self.gateway is None:
             self.gateway = GatewayServer(

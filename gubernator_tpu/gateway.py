@@ -371,10 +371,17 @@ class NativeGatewayServer:
     # submit path fed even on a 1-core host.
     N_WORKERS = 4
 
-    def __init__(self, service: V1Service, listen_address: str = "127.0.0.1:0"):
+    def __init__(self, service: V1Service, listen_address: str = "127.0.0.1:0",
+                 n_workers: "Optional[int]" = None):
         from . import native as _nat
 
         self.service = service
+        if n_workers is not None and n_workers < 1:
+            # Fail at startup: 0/negative would accept-but-never-serve.
+            raise ValueError(
+                f"native_workers must be >= 1, got {n_workers}"
+            )
+        self.n_workers = self.N_WORKERS if n_workers is None else n_workers
         self._edge = _nat.HttpEdge(listen_address)  # raises if unavailable
         self._host = listen_address.partition(":")[0] or "127.0.0.1"
         self._threads: list = []
@@ -392,7 +399,7 @@ class NativeGatewayServer:
         return f"{self._host}:{self._edge.port}"
 
     def start(self) -> None:
-        for i in range(self.N_WORKERS):
+        for i in range(self.n_workers):
             t = threading.Thread(target=self._worker, daemon=True,
                                  name=f"native-gw-{i}")
             t.start()

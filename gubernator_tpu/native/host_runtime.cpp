@@ -1579,6 +1579,7 @@ int64_t gt_json_render(const int32_t* status, const int64_t* limit,
 #include <deque>
 #include <mutex>
 #include <thread>
+#include <vector>
 
 namespace {
 
@@ -1608,6 +1609,15 @@ struct HttpConn {
   std::string out;
   size_t out_off = 0;
   bool want_close = false;
+  // Read side hit EOF (client close or shutdown(SHUT_WR)): stop
+  // watching EPOLLIN — level-triggered EOF would otherwise re-fire
+  // every epoll_wait and spin the loop while responses are pending.
+  bool saw_eof = false;
+  // Write-stall clock for EOF'd conns with staged output: a peer that
+  // half-closed and never reads would otherwise pin the fd + buffer
+  // forever (no EPOLLIN events, EPOLLOUT never re-fires past a full
+  // sndbuf).  Zero = not stalled; reset on write progress.
+  std::chrono::steady_clock::time_point stall_start{};
 };
 
 struct HttpServer {
@@ -1642,7 +1652,8 @@ void http_close_conn(HttpServer* s, HttpConn* c) {
 void http_arm(HttpServer* s, HttpConn* c) {
   epoll_event ev{};
   ev.data.fd = c->fd;
-  ev.events = EPOLLIN | (c->out.size() > c->out_off ? EPOLLOUT : 0u);
+  ev.events = (c->saw_eof ? 0u : EPOLLIN) |
+              (c->out.size() > c->out_off ? EPOLLOUT : 0u);
   epoll_ctl(s->epfd, EPOLL_CTL_MOD, c->fd, &ev);
 }
 
@@ -1767,11 +1778,37 @@ bool http_drain_input(HttpServer* s, HttpConn* c) {
   }
 }
 
+// An EOF'd peer gets this long to drain its staged response before the
+// conn is reclaimed.  Generous on purpose: it exists to bound abuse
+// (half-close, never read), not to race legitimate slow readers or the
+// multi-tens-of-seconds device rounds a response may still be awaiting
+// (the clock only runs while bytes are STAGED and unread).
+constexpr auto kEofWriteStall = std::chrono::seconds(30);
+
 void http_loop(HttpServer* s) {
   epoll_event evs[64];
   for (;;) {
     int n = epoll_wait(s->epfd, evs, 64, 200);
     if (s->stopping.load()) return;
+    {
+      // Reclaim EOF'd conns whose peer stopped reading (see
+      // HttpConn::stall_start).  O(conns) each wakeup; the 200 ms
+      // epoll timeout bounds the sweep cadence.
+      auto now = std::chrono::steady_clock::now();
+      std::vector<HttpConn*> stalled;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        for (auto& [fd, c] : s->conns) {
+          if (!c->saw_eof || c->out.size() <= c->out_off) continue;
+          if (c->stall_start == std::chrono::steady_clock::time_point{}) {
+            c->stall_start = now;
+          } else if (now - c->stall_start > kEofWriteStall) {
+            stalled.push_back(c);
+          }
+        }
+      }
+      for (auto* c : stalled) http_close_conn(s, c);
+    }
     // Stage responses Python produced since the last wake.
     {
       std::unique_lock<std::mutex> lk(s->mu);
@@ -1830,21 +1867,41 @@ void http_loop(HttpServer* s) {
       }
       if (!dead && (evs[i].events & EPOLLIN)) {
         char buf[65536];
+        bool eof = false;
         for (;;) {
           ssize_t r = read(fd, buf, sizeof buf);
           if (r > 0) {
             c->in.append(buf, (size_t)r);
             if (c->in.size() > kMaxHeaderBytes + kMaxBodyBytes) { dead = true; break; }
-          } else if (r == 0) { dead = true; break; }
+          } else if (r == 0) { eof = true; break; }
           else { if (errno != EAGAIN && errno != EWOULDBLOCK) dead = true; break; }
         }
+        // Frame BEFORE honoring EOF: request bytes and the FIN often
+        // arrive in one wakeup (a client that sends-and-closes, or
+        // half-closes with shutdown(SHUT_WR) and still reads).  Killing
+        // the conn on r==0 without draining would DROP fully-received
+        // requests — observed as lost hits under load.
         if (!dead && !http_drain_input(s, c)) dead = true;
+        if (!dead && eof) {
+          // Half-close semantics: serve what was fully received, flush
+          // any responses (the write side may still be open), then
+          // close — the generic want_close check below fires once
+          // everything is flushed, including on this same iteration
+          // when nothing is pending.
+          c->want_close = true;
+          c->saw_eof = true;
+        }
       }
       if (!dead && (evs[i].events & EPOLLOUT) && c->out.size() > c->out_off) {
-        ssize_t w = write(fd, c->out.data() + c->out_off, c->out.size() - c->out_off);
+        // MSG_NOSIGNAL: a peer that closed after its FIN must surface
+        // as EPIPE, not SIGPIPE (Python ignores SIGPIPE; a non-Python
+        // embedder would die).
+        ssize_t w = send(fd, c->out.data() + c->out_off,
+                         c->out.size() - c->out_off, MSG_NOSIGNAL);
         if (w > 0) {
           c->out_off += (size_t)w;
           if (c->out_off == c->out.size()) { c->out.clear(); c->out_off = 0; }
+          c->stall_start = {};  // progress: restart the stall clock
         } else if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
           dead = true;
         }

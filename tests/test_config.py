@@ -30,8 +30,12 @@ def test_env_overrides():
         "GUBER_GLOBAL_SYNC_WAIT": "50ms",
         "GUBER_STATIC_PEERS": "10.0.0.1:81,10.0.0.2:81",
         "GUBER_DEBUG": "true",
+        "GUBER_NATIVE_HTTP": "1",
+        "GUBER_NATIVE_WORKERS": "12",
     }
     conf = setup_daemon_config(env=env)
+    assert conf.native_http is True
+    assert conf.native_workers == 12
     assert conf.listen_address == "0.0.0.0:9090"
     assert conf.cache_size == 1234
     assert conf.back_cache_size == 99999

@@ -266,6 +266,34 @@ def test_disconnect_with_response_in_flight(edge_service):
     assert int(json.loads(rbody)["responses"][0]["remaining"]) == 0
 
 
+def test_invalid_worker_count_is_startup_error(frozen_clock):
+    svc = V1Service(ServiceConfig(cache_size=64, clock=frozen_clock,
+                                  advertise_address="127.0.0.1:9982"))
+    try:
+        with pytest.raises(ValueError, match="native_workers"):
+            NativeGatewayServer(svc, "127.0.0.1:0", n_workers=0)
+        with pytest.raises(ValueError, match="native_workers"):
+            NativeGatewayServer(svc, "127.0.0.1:0", n_workers=-1)
+    finally:
+        svc.close()
+
+
+def test_half_close_client_still_gets_response(edge_service):
+    """shutdown(SHUT_WR) after the request (FIN arrives with the data):
+    the server must frame + serve the request and deliver the response
+    on the still-open write side — not kill the connection on EOF."""
+    gw, _ = edge_service
+    host, _, port = gw.address.partition(":")
+    body = json.dumps({"requests": [_rl("halfclose", hits=4)]}).encode()
+    with socket.create_connection((host, int(port)), timeout=30) as s:
+        s.sendall(b"POST /v1/GetRateLimits HTTP/1.1\r\nHost: x\r\n"
+                  + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+        s.shutdown(socket.SHUT_WR)
+        status, rbody, _ = _read_response(s)
+    assert status == 200
+    assert json.loads(rbody)["responses"][0]["remaining"] == "6"
+
+
 def test_header_names_case_insensitive(edge_service):
     gw, _ = edge_service
     host, _, port = gw.address.partition(":")
