@@ -72,7 +72,12 @@ class GrpcServer:
         service: V1Service,
         listen_address: str = "127.0.0.1:0",
         tls_conf=None,  # Optional[tls.TLSConfig] (file paths already resolved)
-        max_workers: int = 32,
+        # Handlers BLOCK on device rounds, so this pool caps in-flight
+        # RPCs — and therefore how many concurrent callers one
+        # coalescing window can merge (the convoy measured on the HTTP
+        # edge, RESULTS.md round-5 A/B).  128 covers the reference's
+        # 100-way benchmark fan-in; idle-blocked threads are cheap.
+        max_workers: int = 128,
         max_conn_age_s: int = 0,
     ):
         self.service = service
