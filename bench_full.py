@@ -799,8 +799,77 @@ def _config8_measure(d):
     return rows
 
 
+def config9():
+    """The reference's own headline bench shape over gRPC
+    (BenchmarkServer_ThunderingHeard, benchmark_test.go:109-138): ONE
+    shared gRPC client into a cluster daemon, 100 concurrent in-flight
+    single-key requests with RANDOM keys — every request creates a
+    fresh bucket — at limit 10 / duration 5s / 1 hit.  Single-lane
+    requests ride the columnar coalescer (_submit_single_local), so the
+    100-way fanout merges into shared pipelined dispatches; the gRPC
+    handler pool (128 workers) must not convoy the fanout."""
+    import threading as _th
+
+    from gubernator_tpu.client import dial_v1_server, random_string
+    from gubernator_tpu.cluster import Cluster, fast_test_behaviors
+    from gubernator_tpu.types import GetRateLimitsRequest, RateLimitRequest
+
+    cl = Cluster().start_with([""], behaviors=fast_test_behaviors())
+    try:
+        client = dial_v1_server(
+            cl.daemons[0].peer_info.grpc_address, timeout_s=60.0
+        )
+        n_fan = 100
+        per = max(int(40 * SCALE), 2)
+
+        def req():
+            return GetRateLimitsRequest(requests=[RateLimitRequest(
+                name="get_rate_limit_benchmark",
+                unique_key=random_string(n=10),
+                hits=1, limit=10, duration=5_000,
+            )])
+
+        lock = _th.Lock()
+        totals = [0]
+        errs: list = []
+
+        def fan_worker(warm):
+            c = 0
+            for _ in range(2 if warm else per):
+                try:
+                    resp = client.get_rate_limits(req())
+                    if resp.responses[0].error:
+                        raise RuntimeError(resp.responses[0].error)
+                    c += 1
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errs.append(e)
+            with lock:
+                totals[0] += c
+
+        for warm in (True, False):
+            if not warm:
+                totals[0] = 0
+                errs.clear()  # warm-pass hiccups are not timed-run errors
+                t0 = time.perf_counter()
+            ts = [_th.Thread(target=fan_worker, args=(warm,))
+                  for _ in range(n_fan)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        dt = time.perf_counter() - t0
+        _emit("9_grpc_thundering_heard", totals[0], dt,
+              daemons=1, concurrency=n_fan, keys="random",
+              errors=len(errs))
+        if errs:
+            raise RuntimeError(f"cfg9: {len(errs)} errors, first: {errs[0]}")
+    finally:
+        cl.stop()
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config8}
+           6: config6, 7: config7, 8: config8, 9: config9}
 
 
 def main():

@@ -232,6 +232,28 @@ class _ColumnsPlan:
     hash_keys: object  # List[str] | PackedKeys
 
 
+class _SingleLaneWait:
+    """One single-key BATCHING request riding the columnar coalescer
+    (V1Service._submit_single_local): .result() resolves the SHARED
+    dispatch handle — concurrent waiters overlap their readbacks — and
+    builds this lane's response from the packed output."""
+
+    __slots__ = ("_fut",)
+
+    def __init__(self, fut: "Future"):
+        self._fut = fut
+
+    def result(self) -> RateLimitResponse:
+        handle, lo, _hi = self._fut.result()
+        out = handle.result()
+        return RateLimitResponse(
+            status=int(out["status"][lo]),
+            limit=int(out["limit"][lo]),
+            remaining=int(out["remaining"][lo]),
+            reset_time=int(out["reset_time"][lo]),
+        )
+
+
 def _deliver_future(callback, fut) -> None:
     """Bridge a concurrent Future to the callback(result, exc) shape,
     calling it exactly once (a raising callback must not re-enter)."""
@@ -596,8 +618,13 @@ class V1Service:
         # inner tasks queued behind them (round-5 review finding).  Leaf
         # tasks never submit further work, so outer-on-_slow_pool /
         # inner-on-_forward_pool cannot cycle.
+        # 128, not 64: async single-lane requests (native edge n==1
+        # fallback) park one slow-pool thread each for a window+RTT, so
+        # the pool size caps single-key fan-in exactly like the gRPC
+        # handler pool — keep the two caps equal (both cover the
+        # reference's 100-way bench shape).
         self._slow_pool = ThreadPoolExecutor(
-            max_workers=64, thread_name_prefix="columns-slow"
+            max_workers=128, thread_name_prefix="columns-slow"
         )
         self._drainer: "Optional[_HandleDrainer]" = None
         self._drainer_lock = threading.Lock()
@@ -974,7 +1001,10 @@ class V1Service:
                 for i, resp in zip(local, resps):
                     out[i] = resp
             else:
-                futs = [(i, self.local_batcher.submit(r)) for i, r in zip(local, local_reqs)]
+                futs = [
+                    (i, self._submit_single_local(r))
+                    for i, r in zip(local, local_reqs)
+                ]
                 for i, fut in futs:
                     # Per-item error conversion, like the forward path
                     # (_forward_one): a batcher failure must not 500 the
@@ -1009,6 +1039,46 @@ class V1Service:
         return GetRateLimitsResponse(
             responses=[r if r is not None else RateLimitResponse() for r in out]
         )
+
+    def _submit_single_local(self, r: RateLimitRequest):
+        """Locally-owned single-item BATCHING request: ride the
+        COLUMNAR coalescer when eligible.  Its flush only dispatches —
+        waiters resolve the shared handle themselves, overlapping
+        readbacks via ColumnarPipeline — so concurrent single-key
+        clients pipeline device rounds.  The dataclass LocalBatcher's
+        flush calls store.apply, which holds the store lock across the
+        whole dispatch+readback: on a high-latency device that
+        serializes single-key traffic at one window per RTT (the
+        measured cfg9 ThunderingHeard ceiling, benchmark_test.go:109-138
+        topology).  GLOBAL lanes (replica-cache semantics) and
+        Store-SPI deployments keep the LocalBatcher."""
+        if (
+            has_behavior(r.behavior, Behavior.GLOBAL)
+            or not getattr(self.store, "supports_columns", False)
+        ):
+            return self.local_batcher.submit(r)
+        ge_arr = gd_arr = None
+        if has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN):
+            from .models.shard import GregResolver
+            from .utils import gregorian as _greg
+
+            cached = GregResolver(self.clock.now_ms()).resolve(int(r.duration))
+            if isinstance(cached, _greg.GregorianError):
+                done: Future = Future()
+                done.set_result(RateLimitResponse(error=str(cached)))
+                return done
+            ge_arr = np.array([cached[0]], np.int64)
+            gd_arr = np.array([cached[1]], np.int64)
+        fut = self.columnar_batcher.submit(
+            [r.hash_key()],
+            np.array([int(r.algorithm)], np.int32),
+            np.array([int(r.behavior)], np.int32),
+            np.array([int(r.hits)], np.int64),
+            np.array([int(r.limit)], np.int64),
+            np.array([int(r.duration)], np.int64),
+            ge_arr, gd_arr,
+        )
+        return _SingleLaneWait(fut)
 
     def _pick_ready_peer(self, key: str):
         """GetPeer for routing; the not-ready re-pick loop

@@ -470,12 +470,20 @@ def test_ingress_batching_coalesces_concurrent_requests():
         store = d.service.store
         calls = []
         orig_apply = store.apply
+        orig_cols = store.apply_columns_async
 
         def counting_apply(reqs, now, **kw):
             calls.append(len(reqs))
             return orig_apply(reqs, now, **kw)
 
+        def counting_cols(keys, *a, **kw):
+            # Single-key BATCHING requests ride the columnar coalescer
+            # (service._submit_single_local); count those dispatches too.
+            calls.append(len(keys))
+            return orig_cols(keys, *a, **kw)
+
         store.apply = counting_apply
+        store.apply_columns_async = counting_cols
         client = V1Client(d.gateway.address)
         results = []
         lock = threading.Lock()
