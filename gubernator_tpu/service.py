@@ -997,9 +997,29 @@ class V1Service:
             if len(local_reqs) > 1 or any(
                 has_behavior(r.behavior, Behavior.NO_BATCHING) for r in local_reqs
             ):
-                resps = self.store.apply(local_reqs, now)
-                for i, resp in zip(local, resps):
-                    out[i] = resp
+                if len(local_reqs) == 1 and self._single_columnar_eligible(
+                    local_reqs[0]
+                ):
+                    # Single NO_BATCHING lane: direct columnar dispatch
+                    # (no window).  Same eligibility as the batched
+                    # rider; keeps the latency-optimized flag FASTER
+                    # than the windowed path, not slower (the object
+                    # path's per-request dataclass machinery costs more
+                    # than the 500 µs window it skips — cfg8).
+                    i = local[0]
+                    try:
+                        out[i] = self._submit_single_local(
+                            local_reqs[0], direct=True
+                        ).result()
+                    except Exception as e:  # noqa: BLE001
+                        key = local_reqs[0].hash_key()
+                        out[i] = RateLimitResponse(
+                            error=f"while applying rate limit '{key}' - '{e}'"
+                        )
+                else:
+                    resps = self.store.apply(local_reqs, now)
+                    for i, resp in zip(local, resps):
+                        out[i] = resp
             else:
                 futs = [
                     (i, self._submit_single_local(r))
@@ -1040,22 +1060,26 @@ class V1Service:
             responses=[r if r is not None else RateLimitResponse() for r in out]
         )
 
-    def _submit_single_local(self, r: RateLimitRequest):
-        """Locally-owned single-item BATCHING request: ride the
-        COLUMNAR coalescer when eligible.  Its flush only dispatches —
-        waiters resolve the shared handle themselves, overlapping
-        readbacks via ColumnarPipeline — so concurrent single-key
-        clients pipeline device rounds.  The dataclass LocalBatcher's
-        flush calls store.apply, which holds the store lock across the
-        whole dispatch+readback: on a high-latency device that
-        serializes single-key traffic at one window per RTT (the
-        measured cfg9 ThunderingHeard ceiling, benchmark_test.go:109-138
-        topology).  GLOBAL lanes (replica-cache semantics) and
-        Store-SPI deployments keep the LocalBatcher."""
-        if (
-            has_behavior(r.behavior, Behavior.GLOBAL)
-            or not getattr(self.store, "supports_columns", False)
-        ):
+    def _single_columnar_eligible(self, r: RateLimitRequest) -> bool:
+        return not has_behavior(r.behavior, Behavior.GLOBAL) and getattr(
+            self.store, "supports_columns", False
+        )
+
+    def _submit_single_local(self, r: RateLimitRequest, direct: bool = False):
+        """Locally-owned single-item request: ride the COLUMNAR path
+        when eligible.  Windowed (default): the coalescer's flush only
+        dispatches — waiters resolve the shared handle themselves,
+        overlapping readbacks via ColumnarPipeline — so concurrent
+        single-key clients pipeline device rounds.  The dataclass
+        LocalBatcher's flush calls store.apply, which holds the store
+        lock across the whole dispatch+readback: on a high-latency
+        device that serializes single-key traffic at one window per RTT
+        (the measured cfg9 ThunderingHeard ceiling,
+        benchmark_test.go:109-138 topology).  direct=True (NO_BATCHING)
+        dispatches immediately with no window.  GLOBAL lanes
+        (replica-cache semantics) and Store-SPI deployments keep the
+        dataclass path."""
+        if not self._single_columnar_eligible(r):
             return self.local_batcher.submit(r)
         ge_arr = gd_arr = None
         if has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN):
@@ -1069,15 +1093,22 @@ class V1Service:
                 return done
             ge_arr = np.array([cached[0]], np.int64)
             gd_arr = np.array([cached[1]], np.int64)
-        fut = self.columnar_batcher.submit(
+        cols = (
             [r.hash_key()],
             np.array([int(r.algorithm)], np.int32),
             np.array([int(r.behavior)], np.int32),
             np.array([int(r.hits)], np.int64),
             np.array([int(r.limit)], np.int64),
             np.array([int(r.duration)], np.int64),
-            ge_arr, gd_arr,
         )
+        if direct:
+            handle = self.store.apply_columns_async(
+                *cols, self.clock.now_ms(), ge_arr, gd_arr
+            )
+            fut: Future = Future()
+            fut.set_result((handle, 0, 1))
+        else:
+            fut = self.columnar_batcher.submit(*cols, ge_arr, gd_arr)
         return _SingleLaneWait(fut)
 
     def _pick_ready_peer(self, key: str):
