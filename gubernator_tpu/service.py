@@ -232,6 +232,18 @@ class _ColumnsPlan:
     hash_keys: object  # List[str] | PackedKeys
 
 
+def _lane_response(out: dict, lo: int) -> RateLimitResponse:
+    """One lane of a resolved columnar dispatch as a dataclass response
+    (shared by the blocking _SingleLaneWait and the async fast path so
+    the two cannot diverge on the packed-output schema)."""
+    return RateLimitResponse(
+        status=int(out["status"][lo]),
+        limit=int(out["limit"][lo]),
+        remaining=int(out["remaining"][lo]),
+        reset_time=int(out["reset_time"][lo]),
+    )
+
+
 class _SingleLaneWait:
     """One single-key BATCHING request riding the columnar coalescer
     (V1Service._submit_single_local): .result() resolves the SHARED
@@ -245,13 +257,7 @@ class _SingleLaneWait:
 
     def result(self) -> RateLimitResponse:
         handle, lo, _hi = self._fut.result()
-        out = handle.result()
-        return RateLimitResponse(
-            status=int(out["status"][lo]),
-            limit=int(out["limit"][lo]),
-            remaining=int(out["remaining"][lo]),
-            reset_time=int(out["reset_time"][lo]),
-        )
+        return _lane_response(handle.result(), lo)
 
 
 def _deliver_future(callback, fut) -> None:
@@ -1292,11 +1298,14 @@ class V1Service:
                 callback(result, None)
                 return
             if n == 1 or not getattr(self.store, "supports_columns", False):
+                if n == 1 and self._try_single_async(cols, callback):
+                    return
                 # Dataclass fallback blocks (LocalBatcher / peer RPCs):
                 # run it on the slow pool (NOT _forward_pool — _route
                 # submits leaf forwards there and blocks; sharing the
                 # pool deadlocks at saturation).  Per-REQUEST thread
-                # use, but only for single-key / exotic-store shapes.
+                # use, but only for remotely-owned / multi-peer /
+                # exotic-store single-key shapes the fast path declines.
                 fut = self._slow_pool.submit(
                     self.get_rate_limits_columns, cols
                 )
@@ -1310,6 +1319,91 @@ class V1Service:
             callback(result, None)
             return
         _ColumnsJoin(self, plan, result, callback).start()
+
+    def _try_single_async(self, cols, callback) -> bool:
+        """Zero-extra-thread completion for the dominant async
+        single-key shape: a standalone (single self-owner) daemon with
+        the columnar store.  Submits through the same
+        _submit_single_local rider the sync path uses and completes via
+        the drainer (columnar) or the batcher flush thread (dataclass),
+        so no slow-pool thread parks per request.  Returns False to
+        decline — multi-peer rings, empty pools, and validation
+        subtleties stay on the sync router via the slow pool."""
+        if not getattr(self.store, "supports_columns", False):
+            return False
+        with self._peer_mutex:
+            if self.local_picker.size() != 1:
+                return False
+            (only,) = self.local_picker.peers()
+            if not only.info.is_owner:
+                return False
+        r = cols.request_at(0)
+        if not r.unique_key or not r.name:
+            return False  # sync router owns the validation wording
+        if has_behavior(r.behavior, Behavior.GLOBAL) and has_behavior(
+            r.behavior, Behavior.NO_BATCHING
+        ):
+            # Sync parity: this shape takes store.apply directly (no
+            # window); riding the LocalBatcher here would add the very
+            # window NO_BATCHING opts out of.
+            return False
+        result = ColumnarResult.empty(1)
+
+        def deliver_resp(resp: RateLimitResponse) -> None:
+            result.overrides[0] = resp
+            callback(result, None)
+
+        def to_error(e: BaseException) -> RateLimitResponse:
+            return RateLimitResponse(
+                error=f"while applying rate limit '{r.hash_key()}' - '{e}'"
+            )
+
+        if has_behavior(r.behavior, Behavior.MULTI_REGION):
+            self.multi_region_mgr.queue_hits(r)
+        try:
+            w = self._submit_single_local(
+                r, direct=has_behavior(r.behavior, Behavior.NO_BATCHING)
+            )
+        except Exception as e:  # noqa: BLE001
+            # Per-lane error, not a transport exc — sync-router parity
+            # (_route converts the same failure per item).
+            deliver_resp(to_error(e))
+            return True
+
+        if isinstance(w, _SingleLaneWait):
+            drainer = self._get_drainer()
+
+            def on_out(lo, out, exc):
+                deliver_resp(
+                    to_error(exc) if exc is not None
+                    else _lane_response(out, lo)
+                )
+
+            def on_dispatched(fut):
+                try:
+                    handle, lo, _hi = fut.result()
+                except Exception as e:  # noqa: BLE001
+                    deliver_resp(to_error(e))
+                    return
+                drainer.register(handle, partial(on_out, lo))
+
+            w._fut.add_done_callback(on_dispatched)
+        else:
+            # LocalBatcher future (GLOBAL lane) / resolved Gregorian
+            # error: resolves to a RateLimitResponse on the flush
+            # thread; per-item error conversion like _route's.  The
+            # future resolves INSIDE the try and delivery happens once
+            # outside it — a raising edge callback must not re-enter
+            # (the _deliver_future invariant).
+            def on_done(fut):
+                try:
+                    resp = fut.result()
+                except Exception as e:  # noqa: BLE001
+                    resp = to_error(e)
+                deliver_resp(resp)
+
+            w.add_done_callback(on_done)
+        return True
 
     def get_peer_rate_limits_columns_async(
         self, cols: IngressColumns, callback: "Callable"

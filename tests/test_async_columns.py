@@ -214,8 +214,12 @@ def test_async_single_lane_saturation_makes_progress(service):
     round-5 review found the original fallback shared _forward_pool
     with _route's inner leaf forwards — 64 outer tasks could fill the
     pool and block forever on inner tasks queued behind them; the
-    dedicated _slow_pool keeps outer and inner work on disjoint pools."""
-    n_reqs = 80  # > _slow_pool max_workers would deadlock the old way
+    dedicated _slow_pool keeps outer and inner work on disjoint pools.
+    GLOBAL|NO_BATCHING is the one single-key shape that still DECLINES
+    the zero-thread fast path (sync parity: it takes store.apply with
+    no window), so this pins the slow-pool route specifically."""
+    n_reqs = 140  # > _slow_pool max_workers would deadlock the old way
+    beh = int(Behavior.GLOBAL) | int(Behavior.NO_BATCHING)
     done = threading.Event()
     results = []
     lock = threading.Lock()
@@ -228,10 +232,63 @@ def test_async_single_lane_saturation_makes_progress(service):
 
     for i in range(n_reqs):
         service.get_rate_limits_columns_async(
-            make_cols(1, prefix=f"sat{i}", limit=1000), cb
+            make_cols(1, prefix=f"sat{i}", limit=1000, behavior=beh), cb
         )
     assert done.wait(60), f"only {len(results)}/{n_reqs} completed"
     assert all(e is None for e in results)
+
+
+def test_async_single_lane_fast_path_no_thread_parked(service):
+    """Plain single-key async requests on a standalone daemon take the
+    zero-extra-thread fast path (_try_single_async): many more
+    concurrent requests than ANY pool has threads all complete with
+    exact accounting on a shared key."""
+    n_reqs = 300
+    done = threading.Event()
+    results = []
+    lock = threading.Lock()
+
+    def cb(result, exc):
+        with lock:
+            results.append((result, exc))
+            if len(results) == n_reqs:
+                done.set()
+
+    for i in range(n_reqs):
+        service.get_rate_limits_columns_async(
+            make_cols(1, prefix="fastone", limit=100_000), cb
+        )
+    assert done.wait(60), f"only {len(results)}/{n_reqs} completed"
+    assert all(exc is None for _, exc in results)
+    assert all(r.response_at(0).error == "" for r, _ in results)
+    final, exc = run_async(
+        service.get_rate_limits_columns_async,
+        make_cols(1, prefix="fastone", hits=0, limit=100_000),
+    )
+    assert exc is None
+    assert final.response_at(0).remaining == 100_000 - n_reqs
+
+
+def test_async_single_lane_global_completes(service):
+    """GLOBAL single-key async (owner-local): rides the LocalBatcher
+    branch of the fast path — the batcher flush thread completes it."""
+    res, exc = run_async(
+        service.get_rate_limits_columns_async,
+        make_cols(1, prefix="gfast", behavior=int(Behavior.GLOBAL)),
+    )
+    assert exc is None
+    assert res.response_at(0).status == int(Status.UNDER_LIMIT)
+    assert res.response_at(0).remaining == 9
+
+
+def test_async_single_lane_empty_key_validates(service):
+    """Empty unique_key declines the fast path; the sync router's exact
+    validation wording must come back through the slow pool."""
+    cols = make_cols(1, prefix="v")
+    cols.unique_keys[0] = ""
+    res, exc = run_async(service.get_rate_limits_columns_async, cols)
+    assert exc is None
+    assert "unique_key" in res.response_at(0).error
 
 
 def test_async_after_close_reports_error(service):
