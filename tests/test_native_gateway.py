@@ -491,6 +491,36 @@ def test_hostname_listen_address_resolves(frozen_clock):
         d.close()
 
 
+def test_single_key_forwarded_between_native_daemons():
+    """Single-key requests through BOTH native-edge daemons of a
+    2-node cluster drain ONE shared bucket: the async n==1 path must
+    DECLINE its standalone fast path on a multi-peer ring and route
+    through the sync router (owner-local or forwarded), whichever
+    daemon receives the request."""
+    from gubernator_tpu.cluster import Cluster
+
+    cl = Cluster().start_with(["", ""], native_http=True)
+    try:
+        addrs = [d.gateway.address for d in cl.daemons]
+        hits_per, rounds = 2, 6
+        for i in range(rounds):
+            status, body, _ = _post(
+                addrs[i % 2], "/v1/GetRateLimits",
+                {"requests": [_rl("fwd-shared", hits=hits_per, limit=1000)]},
+            )
+            assert status == 200, body
+            resp = json.loads(body)["responses"][0]
+            assert resp.get("error", "") == "", resp
+        status, body, _ = _post(
+            addrs[0], "/v1/GetRateLimits",
+            {"requests": [_rl("fwd-shared", hits=0, limit=1000)]},
+        )
+        remaining = int(json.loads(body)["responses"][0]["remaining"])
+        assert remaining == 1000 - hits_per * rounds
+    finally:
+        cl.stop()
+
+
 @pytest.mark.slow
 def test_native_edge_soak_with_shutdown_under_load():
     """The two-phase teardown under real load: mixed-behavior traffic
