@@ -260,6 +260,19 @@ class _SingleLaneWait:
         return _lane_response(handle.result(), lo)
 
 
+def _attach_done(fut: "Future", fn) -> None:
+    """add_done_callback that cannot re-raise into the attacher: on an
+    ALREADY-resolved future the stdlib invokes fn inline and lets its
+    exception propagate — here that exception can only have come from
+    inside the consumer's delivery callback (delivery was already
+    attempted), so re-raising would trigger a second delivery through
+    the caller's error path."""
+    try:
+        fut.add_done_callback(fn)
+    except Exception:  # noqa: BLE001
+        logger.exception("async delivery callback failed")
+
+
 def _deliver_future(callback, fut) -> None:
     """Bridge a concurrent Future to the callback(result, exc) shape,
     calling it exactly once (a raising callback must not re-enter)."""
@@ -395,15 +408,15 @@ class _ColumnsJoin:
             # slow_fn runs _route / store.apply, which block on (and for
             # _route, submit to) _forward_pool — the slow pool keeps the
             # outer task off the pool its inner tasks need.
-            svc._slow_pool.submit(plan.slow_fn).add_done_callback(
-                self._on_slow
+            _attach_done(
+                svc._slow_pool.submit(plan.slow_fn), self._on_slow
             )
         for addr, fut in plan.group_futs.items():
-            fut.add_done_callback(partial(self._on_group, addr))
+            _attach_done(fut, partial(self._on_group, addr))
         for pending, fast_idx in plan.pendings:
             if isinstance(pending, Future):
-                pending.add_done_callback(
-                    partial(self._on_dispatched, fast_idx, drainer)
+                _attach_done(
+                    pending, partial(self._on_dispatched, fast_idx, drainer)
                 )
             else:
                 handle, lo, hi = pending
@@ -1309,7 +1322,7 @@ class V1Service:
                 fut = self._slow_pool.submit(
                     self.get_rate_limits_columns, cols
                 )
-                fut.add_done_callback(partial(_deliver_future, callback))
+                _attach_done(fut, partial(_deliver_future, callback))
                 return
             plan = self._submit_columns(cols, result)
         except Exception as e:  # noqa: BLE001
@@ -1387,7 +1400,7 @@ class V1Service:
                     return
                 drainer.register(handle, partial(on_out, lo))
 
-            w._fut.add_done_callback(on_dispatched)
+            _attach_done(w._fut, on_dispatched)
         else:
             # LocalBatcher future (GLOBAL lane) / resolved Gregorian
             # error: resolves to a RateLimitResponse on the flush
@@ -1402,7 +1415,7 @@ class V1Service:
                     resp = to_error(e)
                 deliver_resp(resp)
 
-            w.add_done_callback(on_done)
+            _attach_done(w, on_done)
         return True
 
     def get_peer_rate_limits_columns_async(
@@ -1426,7 +1439,7 @@ class V1Service:
                 fut = self._slow_pool.submit(
                     self.get_peer_rate_limits_columns, cols
                 )
-                fut.add_done_callback(partial(_deliver_future, callback))
+                _attach_done(fut, partial(_deliver_future, callback))
                 return
             plan = self._submit_peer_columns(cols, result)
         except Exception as e:  # noqa: BLE001
