@@ -300,6 +300,53 @@ def test_async_after_close_reports_error(service):
         assert res.response_at(0).error != ""
 
 
+def test_handle_drainer_contract():
+    """_HandleDrainer alone: value delivery, exception conversion,
+    stop() draining already-registered work, and fail-fast on late
+    registration."""
+    from gubernator_tpu.peer_client import PeerError
+    from gubernator_tpu.service import _HandleDrainer
+
+    class Handle:
+        def __init__(self, value=None, exc=None, delay=0.0):
+            self._v, self._e, self._delay = value, exc, delay
+
+        def result(self):
+            if self._delay:
+                time.sleep(self._delay)
+            if self._e is not None:
+                raise self._e
+            return self._v
+
+    d = _HandleDrainer()
+    d.start()
+    got = []
+    ev = threading.Event()
+    d.register(Handle(value={"x": 1}), lambda v, e: (got.append((v, e)), ev.set()))
+    assert ev.wait(10) and got == [({"x": 1}, None)]
+
+    got.clear(); ev.clear()
+    boom = RuntimeError("boom")
+    d.register(Handle(exc=boom), lambda v, e: (got.append((v, e)), ev.set()))
+    assert ev.wait(10) and got == [(None, boom)]
+
+    # Work registered BEFORE stop is resolved by the draining workers.
+    got.clear()
+    slow_done = threading.Event()
+    d.register(Handle(value=7, delay=0.2),
+               lambda v, e: (got.append((v, e)), slow_done.set()))
+    d.stop()
+    assert slow_done.wait(10) and got == [(7, None)]
+
+    # Late registration fails fast with the closed error, still exactly
+    # once, on the caller thread.
+    late = []
+    d.register(Handle(value=9), lambda v, e: late.append((v, e)))
+    assert len(late) == 1
+    v, e = late[0]
+    assert v is None and isinstance(e, PeerError)
+
+
 def test_async_callback_exception_does_not_wedge(service):
     """A raising callback must not kill the drainer pool: subsequent
     requests still complete."""
