@@ -62,6 +62,15 @@ def test_concurrent_requests_during_shutdown(daemon, behavior):
     for t in threads:
         t.start()
     time.sleep(0.15)  # past the lazy connect, into the request stream
+    # Under full-suite load the fixed sleep can elapse before ANY
+    # request completes (1-core host); the mid-flight property needs at
+    # least one success to exist, so wait (bounded) for it.
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        with lock:
+            if ok:
+                break
+        time.sleep(0.01)
     client.shutdown()  # mid-flight
     for t in threads:
         t.join(timeout=30)
