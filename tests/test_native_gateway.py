@@ -164,6 +164,46 @@ def test_pipelined_requests_answer_in_order(edge_service):
     assert json.loads(body2)["responses"][0]["limit"] == "200"
 
 
+def test_pipelined_mixed_sizes_stay_ordered_under_async(edge_service):
+    """Eight pipelined requests alternating 200-lane (slow) and 1-lane
+    (fast): with async completion the fast ones finish internally
+    FIRST, so the per-connection token-ordered done-queue is what keeps
+    the wire order correct.  Each response is tagged by its batch size."""
+    gw, _ = edge_service
+    host, _, port = gw.address.partition(":")
+    sizes = [200, 1, 200, 1, 200, 1, 200, 1]
+    raw = b""
+    for i, sz in enumerate(sizes):
+        body = json.dumps(
+            {"requests": [_rl(f"ord{i}", limit=10000 + i)] * sz}
+        ).encode()
+        raw += (b"POST /v1/GetRateLimits HTTP/1.1\r\nHost: x\r\n"
+                + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+    with socket.create_connection((host, int(port)), timeout=60) as s:
+        s.sendall(raw)
+        leftover = b""
+        for i, sz in enumerate(sizes):
+            data = leftover
+            while b"\r\n\r\n" not in data:
+                chunk = s.recv(65536)
+                assert chunk, f"EOF before response {i}"
+                data += chunk
+            head, _, rest = data.partition(b"\r\n\r\n")
+            assert head.split(b" ", 2)[1] == b"200", head[:60]
+            clen = next(int(l.split(b":", 1)[1]) for l in head.split(b"\r\n")
+                        if l.lower().startswith(b"content-length:"))
+            while len(rest) < clen:
+                chunk = s.recv(65536)
+                assert chunk, f"EOF mid-body {i}"
+                rest += chunk
+            payload = json.loads(rest[:clen])
+            leftover = rest[clen:]
+            resps = payload["responses"]
+            # Response i must be THIS request's: right size, right tag.
+            assert len(resps) == sz, f"response {i}: {len(resps)} != {sz}"
+            assert int(resps[0]["limit"]) == 10000 + i, (i, resps[0])
+
+
 def test_connection_close_honored(edge_service):
     gw, _ = edge_service
     status, body, _ = _post(gw.address, "/v1/GetRateLimits",
