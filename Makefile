@@ -1,10 +1,22 @@
 # Reference Makefile:1-35 equivalents for the TPU build.
-.PHONY: test bench proto certs docker release clean
+.PHONY: test tier1 chaos bench proto certs docker release clean
 
 # The whole suite on the virtual 8-device CPU mesh (conftest.py forces
 # it); -p no:cacheprovider keeps runs hermetic like -count=1.
 test:
 	python -m pytest tests/ -q -p no:cacheprovider
+
+# The ROADMAP verify command: fast deterministic tests only.
+tier1:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+# Fault-injection suite (pytest.ini `chaos` marker): breaker /
+# backoff / degraded-eval behavior under seeded fault plans,
+# including the slow soaks tier-1 skips.
+chaos:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
+		-p no:cacheprovider
 
 # One JSON line: {"metric", "value", "unit", "vs_baseline", ...},
 # then the failing regression gate on the stable device rows

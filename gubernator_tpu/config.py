@@ -46,6 +46,25 @@ class BehaviorConfig:
     multi_region_sync_wait_s: float = 0.1
     multi_region_batch_limit: int = 1000
 
+    # -- peer fault tolerance (faults.py) ------------------------------
+    # Per-peer circuit breaker: this many consecutive transport
+    # failures open the circuit; while open, calls to the peer fail
+    # fast and forwarded keys degrade to local evaluation.  After the
+    # open interval one half-open probe decides re-close vs re-open.
+    circuit_threshold: int = 5  # GUBER_CIRCUIT_THRESHOLD
+    circuit_open_interval_s: float = 2.0  # GUBER_CIRCUIT_OPEN_INTERVAL
+    # Forward re-pick loop: attempt budget (the reference hardcodes 5,
+    # gubernator.go:154-162) and the jittered-backoff envelope slept
+    # between attempts (full jitter, so a herd that saw one peer die
+    # does not retry in lockstep).
+    forward_retry_limit: int = 5  # GUBER_FORWARD_RETRY_LIMIT
+    retry_backoff_base_s: float = 0.02  # GUBER_RETRY_BACKOFF_BASE
+    retry_backoff_max_s: float = 1.0  # GUBER_RETRY_BACKOFF_MAX
+    # Host-tier GLOBAL / multi-region send loops: retries per peer send
+    # per tick (0 = one attempt, no retry).  Kept small — a failed peer
+    # is the breaker's job across ticks, not this budget's.
+    global_send_retries: int = 1  # GUBER_GLOBAL_SEND_RETRIES
+
 
 @dataclass
 class DaemonConfig:
@@ -116,6 +135,14 @@ class DaemonConfig:
     k8s_mechanism: str = "endpoints"  # endpoints | pods
     store: object = None
     loader: object = None
+    # Deterministic chaos harness: a faults.FaultPlan consulted by every
+    # PeerClient this daemon creates and by the gossip prober (None =
+    # honor the process-wide faults.install() plan instead).
+    fault_plan: object = None  # Optional[faults.FaultPlan]
+    # Seed for the SWIM probe-order RNG (gossip.py) so suspect/confirm
+    # transitions replay deterministically in chaos tests.  None = a
+    # fresh unseeded RNG per node.  Env: GUBER_GOSSIP_SEED.
+    gossip_seed: "int | None" = None
     debug: bool = False
     # TLS (reference tls.go); wraps the gateway listener and the peer
     # transport when set.  See gubernator_tpu.tls.TLSConfig.
@@ -321,6 +348,27 @@ def setup_daemon_config(
     b.multi_region_batch_limit = _env_int(
         merged, "GUBER_MULTI_REGION_BATCH_LIMIT", b.multi_region_batch_limit
     )
+    b.circuit_threshold = _env_int(
+        merged, "GUBER_CIRCUIT_THRESHOLD", b.circuit_threshold
+    )
+    if b.circuit_threshold < 1:
+        raise ValueError("GUBER_CIRCUIT_THRESHOLD must be >= 1")
+    b.circuit_open_interval_s = _env_float_ms(
+        merged, "GUBER_CIRCUIT_OPEN_INTERVAL", b.circuit_open_interval_s
+    )
+    b.forward_retry_limit = _env_int(
+        merged, "GUBER_FORWARD_RETRY_LIMIT", b.forward_retry_limit
+    )
+    b.retry_backoff_base_s = _env_float_ms(
+        merged, "GUBER_RETRY_BACKOFF_BASE", b.retry_backoff_base_s
+    )
+    b.retry_backoff_max_s = _env_float_ms(
+        merged, "GUBER_RETRY_BACKOFF_MAX", b.retry_backoff_max_s
+    )
+    b.global_send_retries = _env_int(
+        merged, "GUBER_GLOBAL_SEND_RETRIES", b.global_send_retries
+    )
+    conf.gossip_seed = _env_int(merged, "GUBER_GOSSIP_SEED", conf.gossip_seed)
 
     # Static peers: GUBER_STATIC_PEERS=grpcAddr[|httpAddr],... (our
     # addition for the zero-dependency mode; the reference's equivalent

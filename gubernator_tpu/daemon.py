@@ -68,6 +68,7 @@ class Daemon:
             devices=self.conf.devices,
             peer_tls_context=tls_conf.client_ctx if tls_conf else None,
             peer_channel_credentials=peer_creds,
+            fault_plan=self.conf.fault_plan,
         )
         self.service = V1Service(svc_conf)
         # Compile the device programs BEFORE accepting traffic: a cold

@@ -185,15 +185,24 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes):
     Returns (http_status, content_type, body_bytes)."""
     try:
         if method == "GET":
-            if path == "/v1/HealthCheck":
+            # /healthz is an alias so stock k8s liveness/readiness
+            # probes work without a rewrite rule; the payload includes
+            # breakerOpenCount (peers currently fast-failed by their
+            # circuit breaker, faults.py).
+            if path in ("/v1/HealthCheck", "/healthz"):
                 with service.metrics.observe_rpc("/pb.gubernator.V1/HealthCheck"):
                     hc = service.health_check()
                 return 200, "application/json", _json_bytes(hc.to_json())
             if path == "/metrics":
                 # Collect-on-scrape: refresh the cache gauges from the
                 # store (the reference's prometheus Collector pattern,
-                # cache.go:205-218).
+                # cache.go:205-218) and the per-peer circuit-breaker
+                # state gauges from the live PeerClients.
                 service.metrics.observe_cache(service.store)
+                service.metrics.observe_peers(
+                    service.get_peer_list()
+                    + list(service.get_region_picker().peers())
+                )
                 return (200, "text/plain; version=0.0.4",
                         service.metrics.render())
             return 404, "application/json", _json_bytes(

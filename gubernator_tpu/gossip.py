@@ -41,6 +41,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from . import faults as faults_mod
 from .types import PeerInfo
 
 log = logging.getLogger("gubernator.gossip")
@@ -110,12 +111,22 @@ class Gossip:
         suspect_timeout_s: float = 3.0,
         sync_interval_s: float = 10.0,
         k_indirect: int = 3,
+        seed: Optional[int] = None,
+        faults: Optional["faults_mod.FaultPlan"] = None,
     ):
         host, _, port = bind_address.partition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port or 7946)
         self.meta = dict(meta or {})
         self.on_change = on_change
+        # Probe-order / helper-pick / sync-pick RNG.  Seeded, the SWIM
+        # probe schedule replays deterministically, so chaos tests of
+        # suspect/confirm transitions are reproducible (faults.py).
+        # None keeps the historical per-node unseeded behavior.
+        self._rng = random.Random(seed)
+        # Fault-injection hook (faults.FaultPlan, op "gossip.probe"):
+        # None = honor the process-wide faults.install() plan.
+        self.faults = faults
         self.probe_interval_s = probe_interval_s
         self.probe_timeout_s = probe_timeout_s
         self.suspect_timeout_s = suspect_timeout_s
@@ -317,6 +328,25 @@ class Gossip:
             self._send(asker, {"t": "ack", "seq": seq})
 
     def _ping(self, addr: Tuple[str, int], timeout_s: Optional[float] = None) -> bool:
+        # Fault-injection point (faults.OP_GOSSIP_PROBE): a DROP/ERROR
+        # rule makes the ping count as lost — the caller proceeds to
+        # indirect probe / suspicion exactly as if the packet vanished
+        # on the wire.  DELAY models a slow link, so it EATS the ack
+        # budget: an injected delay >= the probe timeout is a timed-out
+        # probe (returned lost immediately, no real sleep — chaos tests
+        # of latency-induced suspicion stay deterministic-fast), and a
+        # smaller delay leaves only the remainder for the ack wait.
+        timeout = timeout_s or self.probe_timeout_s
+        fp = self.faults if self.faults is not None else faults_mod.active()
+        if fp is not None:
+            act = fp.intercept(f"{addr[0]}:{addr[1]}", faults_mod.OP_GOSSIP_PROBE)
+            if act is not None:
+                if act.kind != faults_mod.DELAY:
+                    return False
+                if act.delay_s >= timeout:
+                    return False
+                time.sleep(act.delay_s)
+                timeout -= act.delay_s
         with self._lock:
             self._seq += 1
             seq = self._seq
@@ -324,7 +354,7 @@ class Gossip:
         self._acks[seq] = ev
         try:
             self._send(addr, {"t": "ping", "seq": seq})
-            return ev.wait(timeout_s or self.probe_timeout_s)
+            return ev.wait(timeout)
         finally:
             self._acks.pop(seq, None)
 
@@ -345,7 +375,7 @@ class Gossip:
                     m for m in self._members.values()
                     if m.state == ALIVE and m.name not in (self.name, target.name)
                 ]
-            helpers = random.sample(others, min(self.k_indirect, len(others)))
+            helpers = self._rng.sample(others, min(self.k_indirect, len(others)))
             with self._lock:
                 self._seq += 1
                 seq = self._seq
@@ -376,7 +406,7 @@ class Gossip:
                 n for n, m in self._members.items()
                 if m.state in (ALIVE, SUSPECT) and n != self.name
             ]
-            random.shuffle(names)
+            self._rng.shuffle(names)
             self._probe_ring = names
             if not self._probe_ring:
                 return None
@@ -504,7 +534,7 @@ class Gossip:
                           if m.state == ALIVE and m.name != self.name]
             if not others:
                 continue
-            pick = random.choice(others)
+            pick = self._rng.choice(others)
             try:
                 self._push_pull(pick.addr)
             except (OSError, json.JSONDecodeError):
@@ -552,6 +582,8 @@ class GossipPool:
         probe_timeout_s: float = 0.5,
         suspect_timeout_s: float = 3.0,
         sync_interval_s: float = 10.0,
+        seed: Optional[int] = None,
+        faults: Optional["faults_mod.FaultPlan"] = None,
     ):
         self.on_update = on_update
         self.gossip = Gossip(
@@ -563,6 +595,8 @@ class GossipPool:
             probe_timeout_s=probe_timeout_s,
             suspect_timeout_s=suspect_timeout_s,
             sync_interval_s=sync_interval_s,
+            seed=seed,
+            faults=faults,
         )
         # Normalize seeds (default port 7946) BEFORE the self-filter: a
         # portless seed naming this host would otherwise pass the string

@@ -52,6 +52,31 @@ class Metrics:
             "The duration of GLOBAL broadcasts to peers in seconds.",
             registry=self.registry,
         )
+        # -- peer fault tolerance (faults.py) --------------------------
+        self.circuit_state = Gauge(
+            "gubernator_circuit_breaker_state",
+            "Per-peer circuit breaker state (0 closed, 1 half-open, 2 open).",
+            ["peer"],
+            registry=self.registry,
+        )
+        self.circuit_transitions = Counter(
+            "gubernator_circuit_breaker_transitions",
+            "Circuit breaker state transitions per peer.",
+            ["peer", "to"],
+            registry=self.registry,
+        )
+        self.peer_retries = Counter(
+            "gubernator_peer_retry_count",
+            "Retries of peer sends after a transport failure, by loop.",
+            ["op"],  # forward | global_hits | global_broadcast | multi_region
+            registry=self.registry,
+        )
+        self.degraded_evals = Counter(
+            "gubernator_degraded_local_evals",
+            "Forwarded keys served by degraded local evaluation because "
+            "the owner's circuit breaker was open.",
+            registry=self.registry,
+        )
 
     @contextmanager
     def observe_rpc(self, method: str):
@@ -85,6 +110,21 @@ class Metrics:
         # Counters are monotonic: set via inc of the delta.
         self._bump(self.cache_access_count.labels(type="hit"), hits)
         self._bump(self.cache_access_count.labels(type="miss"), misses)
+
+    def observe_peers(self, peers) -> None:
+        """Refresh the per-peer breaker state gauge from live
+        PeerClients (collect-on-scrape, like observe_cache).  Rebuilt
+        from scratch each scrape: a peer that left the cluster must
+        drop off the gauge, not freeze at its last state forever."""
+        self.circuit_state.clear()
+        for p in peers:
+            breaker = getattr(p, "breaker", None)
+            info = getattr(p, "info", None)
+            if breaker is None or info is None:
+                continue
+            self.circuit_state.labels(peer=info.grpc_address).set(
+                breaker.state_code
+            )
 
     def _bump(self, counter, absolute: float) -> None:
         current = counter._value.get()  # noqa: SLF001

@@ -215,6 +215,17 @@ def _write_row_jit(state, s, slot, rows):
 
 _SYNC_FN_CACHE: dict = {}
 
+# Process-wide serialization of the GLOBAL sync collective — the mesh's
+# ONLY cross-device rendezvous program (psum aggregate -> owner apply ->
+# psum broadcast).  Two MeshBucketStores sharing one device set (the
+# multi-daemon in-process test cluster on the 8-device virtual CPU
+# mesh) can otherwise enqueue their sync programs in different per-
+# device orders, and two interleaved rendezvous deadlock every device
+# queue behind them.  Held from dispatch through the blocking readback;
+# non-collective programs never rendezvous, so they need no ordering.
+# Production runs one daemon (one store) per process: zero contention.
+_SYNC_COLLECTIVE_LOCK = threading.Lock()
+
 
 def _get_sync_fn(mesh: Mesh, axis: str):
     """One compiled GLOBAL-sync collective program per (mesh, axis)."""
@@ -998,12 +1009,12 @@ class MeshBucketStore(ColumnarPipeline):
             greg_expire=jnp.asarray(self.gtable.greg_expire),
             greg_duration=jnp.asarray(self.gtable.greg_duration),
         )
-        dirty_dev = jax.device_put(jnp.asarray(self.dirty), self._sharding)
-        self.state, self.gcols, packed = self._sync_fn(
-            self.state, self.gcols, cfg, dirty_dev, now_ms
-        )
-
-        packed_np = np.asarray(packed)  # [S, 8, G] — the one blocking transfer
+        with _SYNC_COLLECTIVE_LOCK:
+            dirty_dev = jax.device_put(jnp.asarray(self.dirty), self._sharding)
+            self.state, self.gcols, packed = self._sync_fn(
+                self.state, self.gcols, cfg, dirty_dev, now_ms
+            )
+            packed_np = np.asarray(packed)  # [S, 8, G] — the one blocking transfer
         out_rm = (packed_np[:, 0] & 1).astype(bool)
         out_exp = packed_np[:, 1]
         # psum results are replicated across shards; read shard 0's copy.
@@ -1155,13 +1166,14 @@ class MeshBucketStore(ColumnarPipeline):
                 )
                 return packed
 
-            packed = one()
-            np.asarray(packed[:1, :1, :1])  # drain queue + honest mode
-            t0 = _time.perf_counter()
-            for _ in range(iters):
+            with _SYNC_COLLECTIVE_LOCK:
                 packed = one()
-            np.asarray(packed[:1, :1, :1])
-            return (_time.perf_counter() - t0) / iters
+                np.asarray(packed[:1, :1, :1])  # drain queue + honest mode
+                t0 = _time.perf_counter()
+                for _ in range(iters):
+                    packed = one()
+                np.asarray(packed[:1, :1, :1])
+                return (_time.perf_counter() - t0) / iters
         finally:
             self._lock.release()
 

@@ -25,8 +25,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import grpc
 
+from . import faults as faults_mod
 from . import wire
 from .config import BehaviorConfig
+from .faults import CircuitBreaker, FaultPlan
 from .utils.batch_window import BatchWindow
 from .proto import PEERS_V1_SERVICE
 from .proto import peers_pb2 as peers_pb
@@ -52,9 +54,14 @@ _NOT_READY_CODES = (grpc.StatusCode.UNAVAILABLE,)
 
 
 class PeerError(Exception):
-    def __init__(self, message: str, not_ready: bool = False):
+    def __init__(self, message: str, not_ready: bool = False,
+                 circuit_open: bool = False):
         super().__init__(message)
         self.not_ready = not_ready
+        # The call never left this host: the peer's circuit breaker was
+        # open.  Routers degrade to local evaluation instead of
+        # retrying (faults.py; service._forward_one).
+        self.circuit_open = circuit_open
 
 
 def is_not_ready(err: Exception) -> bool:
@@ -62,8 +69,17 @@ def is_not_ready(err: Exception) -> bool:
     return isinstance(err, PeerError) and err.not_ready
 
 
+def is_circuit_open(err: Exception) -> bool:
+    """True when the failure is a breaker fast-fail — the RPC was never
+    attempted, so degraded local evaluation is safe (no double-count
+    risk) and retrying the same peer is pointless until the breaker's
+    half-open probe succeeds."""
+    return isinstance(err, PeerError) and err.circuit_open
+
+
 class PeerClient:
     LAST_ERR_TTL_S = 300.0  # peer_client.go:77 (5 minute TTL)
+    LAST_ERR_MAX = 100  # bounded LRU like the reference (peer_client.go:77)
 
     def __init__(
         self,
@@ -72,11 +88,20 @@ class PeerClient:
         tls_context: Optional[ssl.SSLContext] = None,
         channel_credentials: Optional[grpc.ChannelCredentials] = None,
         transport: str = "",  # "" = auto, "grpc", "http"
+        metrics: object = None,  # Optional[Metrics]: breaker transition counts
+        faults: Optional[FaultPlan] = None,  # None = honor faults.install()
     ):
         self.info = info
         self.behaviors = behaviors or BehaviorConfig()
         self.tls_context = tls_context
         self.channel_credentials = channel_credentials
+        self.faults = faults
+        self._metrics = metrics
+        self.breaker = CircuitBreaker(
+            failure_threshold=self.behaviors.circuit_threshold,
+            open_interval_s=self.behaviors.circuit_open_interval_s,
+            on_transition=self._on_breaker_transition,
+        )
         if not transport:
             # insecure_skip_verify TLS has no gRPC equivalent: the ssl
             # context fallback is the only transport that can honor it.
@@ -128,8 +153,26 @@ class PeerClient:
         `_draining` lets the shutdown drain flush already-queued
         requests through the still-open connection
         (peer_client.go:351-385) after new requests are refused."""
+        n = len(req.requests)
+
+        def _count_check(got: int) -> None:
+            # Runs inside the _guarded_call region: a peer that
+            # consistently returns the wrong number of rate limits
+            # (version skew, corruption) trips its breaker like any
+            # transport failure would.
+            if got != n:
+                msg = (
+                    f"GetPeerRateLimits to peer {self.info.grpc_address} "
+                    f"returned {got} rate limits for {n} requests"
+                )
+                self._set_last_err(msg)
+                raise PeerError(msg)
+
         if self.transport == "http":
-            body = self._post("/v1/peer.GetPeerRateLimits", req.to_json(), timeout_s)
+            body = self._post(
+                "/v1/peer.GetPeerRateLimits", req.to_json(), timeout_s,
+                check=lambda b: _count_check(len(b.get("rateLimits", []))),
+            )
             resp = GetRateLimitsResponse.from_json(
                 {"responses": body.get("rateLimits", [])}
             )
@@ -139,10 +182,9 @@ class PeerClient:
                 wire.peer_rate_limits_req_to_pb(req),
                 timeout_s,
                 allow_closing=_draining,
+                check=lambda m: _count_check(len(m.rate_limits)),
             )
             resp = wire.peer_rate_limits_resp_from_pb(m)
-        if len(resp.responses) != len(req.requests):
-            raise PeerError("number of rate limits in peer response does not match request")
         return resp
 
     def update_peer_globals(
@@ -206,14 +248,82 @@ class PeerClient:
                 )
             return self._rpc_get_peer_rate_limits, self._rpc_update_peer_globals
 
+    # ------------------------------------------------------------------
+    # Fault-tolerance wrap: every transport call passes the breaker gate
+    # then the installed fault plan (faults.py) before touching the wire.
+    # ------------------------------------------------------------------
+    def _on_breaker_transition(self, state: str) -> None:
+        if self._metrics is not None:
+            self._metrics.circuit_transitions.labels(
+                peer=self.info.grpc_address, to=state
+            ).inc()
+
+    def _breaker_gate(self, op: str) -> None:
+        """Raise the circuit-open fast-fail, or reserve the call slot
+        (every non-raising return MUST be paired with exactly one
+        breaker.record_success/record_failure)."""
+        if not self.breaker.allow():
+            raise PeerError(
+                f"{op} to peer {self.info.grpc_address} rejected: "
+                f"circuit breaker open",
+                not_ready=True,
+                circuit_open=True,
+            )
+
+    def _fault_check(self, op: str) -> None:
+        """Consult the fault plan (instance-level, else the process-wide
+        installed one).  An injected ERROR/DROP raises the same
+        PeerError shape a real transport failure would — downstream
+        retry/breaker/health behavior is exercised for real."""
+        fp = self.faults if self.faults is not None else faults_mod.active()
+        if fp is None:
+            return
+        act = fp.intercept(self.info.grpc_address, op)
+        if act is None:
+            return
+        if act.kind == faults_mod.DELAY:
+            time.sleep(act.delay_s)
+            return
+        msg = f"{op} to peer {self.info.grpc_address} failed: {act.message}"
+        self._set_last_err(msg)
+        raise PeerError(msg, not_ready=act.not_ready)
+
+    def _guarded_call(self, op: str, fn, check=None):
+        """The breaker protocol, shared by BOTH transports: gate ->
+        injected-fault check -> fn() -> optional reply check -> record.
+        Every non-raising _breaker_gate() pairs with exactly one
+        record_success/record_failure (the half-open probe slot,
+        faults.CircuitBreaker).  `check` runs INSIDE the guarded region
+        so a structurally bad reply (wrong response count) counts as a
+        breaker failure like any transport error, instead of resetting
+        the failure streak before the caller notices."""
+        self._breaker_gate(op)
+        try:
+            self._fault_check(op)
+            out = fn()
+            if check is not None:
+                check(out)
+        except BaseException:
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
+        return out
+
     def _grpc_call(self, method: str, request, timeout_s: Optional[float],
-                   allow_closing: bool = False):
+                   allow_closing: bool = False, check=None):
         if self._shutdown.is_set() and not allow_closing:
             raise PeerError(ERR_CLOSING, not_ready=True)
-        get_rl, update_g = self._ensure_channel()
-        rpc = get_rl if method == "GetPeerRateLimits" else update_g
-        timeout = timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
+        return self._guarded_call(
+            method, lambda: self._grpc_inner(method, request, timeout_s), check
+        )
+
+    def _grpc_inner(self, method: str, request, timeout_s: Optional[float]):
         try:
+            get_rl, update_g = self._ensure_channel()
+            rpc = get_rl if method == "GetPeerRateLimits" else update_g
+            timeout = (
+                timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
+            )
             return rpc(request, timeout=timeout)
         except grpc.RpcError as e:
             code = e.code() if hasattr(e, "code") else None
@@ -238,7 +348,14 @@ class PeerClient:
     # ------------------------------------------------------------------
     # HTTP/JSON fallback transport (the peer's gateway surface)
     # ------------------------------------------------------------------
-    def _post(self, path: str, payload: dict, timeout_s: Optional[float]) -> dict:
+    def _post(self, path: str, payload: dict, timeout_s: Optional[float],
+              check=None) -> dict:
+        op = path.rpartition(".")[2]  # /v1/peer.GetPeerRateLimits -> op
+        return self._guarded_call(
+            op, lambda: self._post_inner(path, payload, timeout_s), check
+        )
+
+    def _post_inner(self, path: str, payload: dict, timeout_s: Optional[float]) -> dict:
         timeout = timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
         data = json.dumps(payload).encode("utf-8")
         host = self.info.http_address or self.info.grpc_address
@@ -284,11 +401,17 @@ class PeerClient:
     # ------------------------------------------------------------------
     def _set_last_err(self, msg: str) -> None:
         """Error LRU with TTL (peer_client.go:206-220); messages include
-        the peer address for HealthCheck reporting."""
+        the peer address for HealthCheck reporting.  Bounded at
+        LAST_ERR_MAX entries: a flood of distinct error messages evicts
+        the oldest instead of growing without bound between
+        get_last_err() calls (reference uses a fixed-size LRU)."""
         with self._err_lock:
-            self._last_err[f"{msg} (peer: {self.info.grpc_address})"] = (
-                time.monotonic() + self.LAST_ERR_TTL_S
-            )
+            key = f"{msg} (peer: {self.info.grpc_address})"
+            # Re-inserting moves the key to the end: recency order.
+            self._last_err.pop(key, None)
+            self._last_err[key] = time.monotonic() + self.LAST_ERR_TTL_S
+            while len(self._last_err) > self.LAST_ERR_MAX:
+                self._last_err.pop(next(iter(self._last_err)))
 
     def get_last_err(self) -> List[str]:
         now = time.monotonic()

@@ -146,6 +146,8 @@ def make_pool(kind: str, conf, on_update: OnUpdate, advertise: Optional[PeerInfo
             on_update=on_update,
             known_nodes=conf.member_list_known_nodes,
             node_name=conf.member_list_node_name,
+            seed=getattr(conf, "gossip_seed", None),
+            faults=getattr(conf, "fault_plan", None),
         )
     if kind == "k8s":
         from .k8s_pool import K8sPool

@@ -24,11 +24,12 @@ from .utils.logging import category_logger
 import numpy as np
 
 from .config import MAX_BATCH_SIZE, BehaviorConfig
+from .faults import Backoff
 from .metrics import Metrics
 from .parallel.hash_ring import ReplicatedConsistentHash
 from .parallel.mesh import MeshBucketStore
 from .parallel.region import RegionPicker
-from .peer_client import PeerClient, PeerError, is_not_ready
+from .peer_client import PeerClient, PeerError, is_circuit_open, is_not_ready
 from .types import (
     Behavior,
     GetRateLimitsRequest,
@@ -99,6 +100,10 @@ class ServiceConfig:
     # insecure channel, or — when peer_tls_context is set — the HTTP
     # fallback, which is the only transport able to skip verification).
     peer_channel_credentials: object = None
+    # Deterministic chaos harness: a faults.FaultPlan handed to every
+    # PeerClient this service creates (None = PeerClients honor the
+    # process-wide faults.install() plan instead).
+    fault_plan: object = None
 
 
 class LocalBatcher:
@@ -647,6 +652,13 @@ class V1Service:
         )
         self._drainer: "Optional[_HandleDrainer]" = None
         self._drainer_lock = threading.Lock()
+        # Jittered-backoff envelope shared by the forward re-pick loop
+        # and the host-tier send loops (one instance: full jitter means
+        # no cross-thread correlation to worry about).
+        self._retry_backoff = Backoff(
+            base_s=conf.behaviors.retry_backoff_base_s,
+            max_s=conf.behaviors.retry_backoff_max_s,
+        )
         self._closed = False
 
         if conf.loader is not None:
@@ -1143,9 +1155,11 @@ class V1Service:
         self, peer: PeerClient, reqs: List[RateLimitRequest]
     ) -> List[RateLimitResponse]:
         """Forward a whole owner-group in one GetPeerRateLimits RPC
-        (columnar ingress).  A not-ready peer degrades to the per-item
-        forward path, which owns the re-pick retry loop
-        (gubernator.go:154-162); other failures convert per lane."""
+        (columnar ingress).  An owner with an open circuit breaker
+        degrades the whole group to local evaluation; a not-ready peer
+        degrades to the per-item forward path, which owns the re-pick
+        retry loop (gubernator.go:154-162); other failures convert per
+        lane."""
         try:
             resp = peer.get_peer_rate_limits(
                 GetRateLimitsRequest(requests=reqs),
@@ -1158,6 +1172,10 @@ class V1Service:
                 r.metadata = {"owner": peer.info.grpc_address}
             return out
         except Exception as e:  # noqa: BLE001
+            if is_circuit_open(e):
+                # The RPC never left this host (breaker fast-fail), so
+                # local evaluation cannot double-count.
+                return self._degrade_local(reqs, peer)
             if is_not_ready(e):
                 return [self._forward_one(r, peer) for r in reqs]
             return [
@@ -1169,26 +1187,86 @@ class V1Service:
                 for r in reqs
             ]
 
+    def _degrade_local(
+        self, reqs: Sequence[RateLimitRequest], peer: PeerClient
+    ) -> List[RateLimitResponse]:
+        """The owner's circuit breaker is open: serve the hit from the
+        LOCAL shard instead of blocking the batch window behind a dead
+        peer.  Documented degraded semantics (architecture.md "Fault
+        tolerance"): during the open window each surviving daemon
+        enforces the key's full limit against its own share of the
+        traffic, so OVER_LIMIT is still enforced (per daemon) and state
+        converges back to owner-authoritative once the breaker's
+        half-open probe re-closes it.  Responses are stamped
+        degraded=true so callers/tests can observe the mode.
+
+        Singles ride _submit_single_local (the windowed columnar
+        coalescer): under exactly the load this path absorbs — a whole
+        batch window's waiters failing over at once — one raw
+        store.apply per waiter would serialize N device rounds at one
+        store-lock hold each (the ThunderingHeard ceiling the coalescer
+        exists to avoid).  Groups are already one batched apply."""
+        if len(reqs) == 1:
+            try:
+                resps = [self._submit_single_local(reqs[0]).result()]
+            except Exception as e:  # noqa: BLE001 (per-item, like _forward_one)
+                resps = [
+                    RateLimitResponse(
+                        error=(
+                            f"while applying rate limit "
+                            f"'{reqs[0].hash_key()}' - '{e}'"
+                        )
+                    )
+                ]
+        else:
+            resps = self.store.apply(list(reqs), self.clock.now_ms())
+        for resp in resps:
+            resp.metadata = {
+                "owner": peer.info.grpc_address,
+                "degraded": "true",
+            }
+        self.metrics.degraded_evals.inc(len(resps))
+        return resps
+
     def _forward_one(self, r: RateLimitRequest, peer: PeerClient) -> RateLimitResponse:
         """Forward to the owner (the BATCHING leg, gubernator.go:195-210),
-        retrying with a re-pick when the peer is not ready."""
+        retrying with a re-pick + jittered backoff when the peer is not
+        ready (budget: behaviors.forward_retry_limit).  An owner whose
+        circuit breaker was already open serves degraded local
+        evaluation instead; a breaker that opens MID-retry keeps the
+        error path — this request already burned its budget observing
+        real failures, and the caller sees the same not-connected error
+        the reference returns (the NEXT request gets the fast degraded
+        path)."""
         key = r.hash_key()
         attempts = 0
+        budget = self.conf.behaviors.forward_retry_limit
         while True:
             try:
                 resp = peer.get_peer_rate_limit(r)
                 resp.metadata = {"owner": peer.info.grpc_address}
                 return resp
             except Exception as e:  # noqa: BLE001
+                if is_circuit_open(e):
+                    if attempts == 0:
+                        return self._degrade_local([r], peer)[0]
+                    return RateLimitResponse(
+                        error=(
+                            "GetPeer() keeps returning peers that are not connected "
+                            f"for '{key}' - '{e}'"
+                        )
+                    )
                 if is_not_ready(e):
                     attempts += 1
-                    if attempts > 5:
+                    if attempts > budget:
                         return RateLimitResponse(
                             error=(
                                 "GetPeer() keeps returning peers that are not connected "
                                 f"for '{key}' - '{e}'"
                             )
                         )
+                    self.metrics.peer_retries.labels(op="forward").inc()
+                    self._retry_backoff.sleep(attempts - 1)
                     try:
                         peer = self.get_peer(key)
                     except PeerError as pe:
@@ -1199,6 +1277,27 @@ class V1Service:
                 return RateLimitResponse(
                     error=f"while fetching rate limit '{key}' from peer - '{e}'"
                 )
+
+    def _peer_send(self, op: str, fn: Callable[[], object]) -> bool:
+        """Host-tier peer send (GLOBAL hits/broadcast fan-out,
+        multi-region push) with jittered-backoff retries on not-ready
+        failures, replacing the bare try/except-pass hot loops that
+        were dominated by network timeouts under failure.  Circuit-open
+        fast-fails are skipped immediately (the breaker's open interval
+        IS the backoff across ticks); budgets come from
+        behaviors.global_send_retries.  Returns success."""
+        budget = self.conf.behaviors.global_send_retries
+        attempt = 0
+        while True:
+            try:
+                fn()
+                return True
+            except Exception as e:  # noqa: BLE001 (logged-and-continue in ref)
+                if is_circuit_open(e) or not is_not_ready(e) or attempt >= budget:
+                    return False
+                self.metrics.peer_retries.labels(op=op).inc()
+                self._retry_backoff.sleep(attempt)
+                attempt += 1
 
     # ------------------------------------------------------------------
     # PeersV1 surface
@@ -1461,14 +1560,19 @@ class V1Service:
 
     def _health_check(self) -> HealthCheckResponse:
         errs: List[str] = []
+        breaker_open = 0
         with self._peer_mutex:
-            for peer in self.local_picker.peers():
+            for peer in list(self.local_picker.peers()) + list(
+                self.region_picker.peers()
+            ):
                 errs.extend(peer.get_last_err())
-            for peer in self.region_picker.peers():
-                errs.extend(peer.get_last_err())
+                breaker = getattr(peer, "breaker", None)
+                if breaker is not None and breaker.is_open:
+                    breaker_open += 1
             self._health.status = HEALTHY
             self._health.message = ""
             self._health.peer_count = self.local_picker.size()
+            self._health.breaker_open_count = breaker_open
             if errs:
                 self._health.status = UNHEALTHY
                 self._health.message = "|".join(errs)
@@ -1476,6 +1580,7 @@ class V1Service:
                 status=self._health.status,
                 message=self._health.message,
                 peer_count=self._health.peer_count,
+                breaker_open_count=self._health.breaker_open_count,
             )
 
     # ------------------------------------------------------------------
@@ -1499,6 +1604,8 @@ class V1Service:
                         info, self.conf.behaviors,
                         tls_context=self.conf.peer_tls_context,
                         channel_credentials=self.conf.peer_channel_credentials,
+                        metrics=self.metrics,
+                        faults=self.conf.fault_plan,
                     )
                 client.info = info
                 new_local.add(info.grpc_address, client)
@@ -1510,6 +1617,8 @@ class V1Service:
                         info, self.conf.behaviors,
                         tls_context=self.conf.peer_tls_context,
                         channel_credentials=self.conf.peer_channel_credentials,
+                        metrics=self.metrics,
+                        faults=self.conf.fault_plan,
                     )
                 client.info = info
                 new_region.add(client)
@@ -1659,25 +1768,32 @@ class GlobalManager:
                 by_owner.setdefault(addr, []).append(r)
                 clients[addr] = peer
             for addr, reqs in by_owner.items():
-                try:
-                    clients[addr].get_peer_rate_limits(
+                # Jittered-backoff retry budget + circuit-breaker
+                # fast-fail (service._peer_send): a dead owner costs at
+                # most the breaker's open-interval probe per tick, not a
+                # full network timeout per send.
+                svc._peer_send(
+                    "global_hits",
+                    partial(
+                        clients[addr].get_peer_rate_limits,
                         GetRateLimitsRequest(requests=reqs),
                         timeout_s=svc.conf.behaviors.global_timeout_s,
-                    )
-                except Exception:  # noqa: BLE001 (logged-and-continue in ref)
-                    pass
+                    ),
+                )
             svc.metrics.async_durations.observe(time.perf_counter() - start)
         if res.broadcasts:
             start = time.perf_counter()
             for peer in svc.get_peer_list():
                 if peer.info.is_owner:
                     continue  # exclude ourselves (global.go:223-226)
-                try:
-                    peer.update_peer_globals(
-                        res.broadcasts, timeout_s=svc.conf.behaviors.global_timeout_s
-                    )
-                except Exception:  # noqa: BLE001
-                    pass
+                svc._peer_send(
+                    "global_broadcast",
+                    partial(
+                        peer.update_peer_globals,
+                        res.broadcasts,
+                        timeout_s=svc.conf.behaviors.global_timeout_s,
+                    ),
+                )
             svc.metrics.broadcast_durations.observe(time.perf_counter() - start)
         return bool(res.broadcasts or res.remote_hits)
 
@@ -1741,13 +1857,14 @@ class MultiRegionManager:
                 by_peer.setdefault(addr, []).append(wire)
                 clients[addr] = peer
         for addr, reqs in by_peer.items():
-            try:
-                clients[addr].get_peer_rate_limits(
+            svc._peer_send(
+                "multi_region",
+                partial(
+                    clients[addr].get_peer_rate_limits,
                     GetRateLimitsRequest(requests=reqs),
                     timeout_s=svc.conf.behaviors.multi_region_timeout_s,
-                )
-            except Exception:  # noqa: BLE001
-                pass
+                ),
+            )
 
     def stop(self) -> None:
         self._stopped = True

@@ -171,9 +171,18 @@ class HealthCheckResponse:
     status: str = "healthy"
     message: str = ""
     peer_count: int = 0
+    # Peers whose circuit breaker is currently open/half-open (not yet
+    # re-trusted); forwarded keys they own are served by degraded local
+    # evaluation (faults.py).  JSON-only extension: the reference proto
+    # has no such field, so the gRPC wire omits it.
+    breaker_open_count: int = 0
 
     def to_json(self) -> dict:
-        out = {"status": self.status, "peerCount": self.peer_count}
+        out = {
+            "status": self.status,
+            "peerCount": self.peer_count,
+            "breakerOpenCount": self.breaker_open_count,
+        }
         if self.message:
             out["message"] = self.message
         return out
@@ -184,6 +193,9 @@ class HealthCheckResponse:
             status=d.get("status", ""),
             message=d.get("message", ""),
             peer_count=_to_int(_pick(d, "peer_count", "peerCount", default=0)),
+            breaker_open_count=_to_int(
+                _pick(d, "breaker_open_count", "breakerOpenCount", default=0)
+            ),
         )
 
 

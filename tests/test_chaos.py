@@ -1,0 +1,304 @@
+"""Chaos tests: seeded fault plans against a real in-process cluster.
+
+The acceptance scenario for the fault-tolerance layer: partition a
+key's owner under sustained load and watch the full breaker cycle —
+consecutive failures open the circuit, traffic degrades to local
+evaluation without blocking the batch window, and the breaker re-closes
+once the peer returns (half-open probe succeeds).  Every test runs
+under explicit fault-plan seeds so failures replay bit-for-bit in CI
+(`make chaos` runs the marker; the fast ones also ride tier-1).
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from gubernator_tpu import faults
+from gubernator_tpu.cluster import Cluster, fast_test_behaviors
+from gubernator_tpu.faults import FaultPlan, FaultRule
+from gubernator_tpu.types import (
+    Algorithm,
+    GetRateLimitsRequest,
+    RateLimitRequest,
+    SECOND,
+)
+from gubernator_tpu.utils.clock import Clock
+
+T0 = 1_573_430_430_000
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(scope="module")
+def clock():
+    c = Clock()
+    c.freeze(T0)
+    return c
+
+
+@pytest.fixture(scope="module")
+def cluster(clock):
+    behaviors = fast_test_behaviors()
+    behaviors.circuit_threshold = 3
+    behaviors.circuit_open_interval_s = 1.0
+    behaviors.forward_retry_limit = 4
+    behaviors.retry_backoff_base_s = 0.002
+    behaviors.retry_backoff_max_s = 0.01
+    # No GLOBAL / MULTI_REGION traffic in these tests: park the sync
+    # intervals so the per-daemon sync ticks don't add device load (and
+    # sync-collective serialization waits, mesh._SYNC_COLLECTIVE_LOCK)
+    # under the already-heavy traffic the degraded-local-eval path
+    # generates on the shared 8-device CPU mesh.
+    behaviors.global_sync_wait_s = 3600.0
+    behaviors.multi_region_sync_wait_s = 3600.0
+    cl = Cluster().start_with(["", "", ""], clock=clock, behaviors=behaviors)
+    # Pre-compile the single-item store.apply shape the degraded path
+    # uses, so breaker-interval timing below never races a first-call
+    # device compile.
+    for d in cl.daemons:
+        d.service.store.apply([_mk("warmup", "w", hits=0)], clock.now_ms())
+    yield cl
+    cl.stop()
+
+
+def _mk(name, key, hits=1, limit=10):
+    return RateLimitRequest(
+        name=name, unique_key=key, hits=hits, limit=limit,
+        duration=9 * SECOND, algorithm=Algorithm.TOKEN_BUCKET,
+    )
+
+
+def _entry_and_owner(cluster, hash_key):
+    """A daemon that does NOT own `hash_key`, plus its PeerClient for
+    the daemon that does."""
+    for d in cluster.daemons:
+        peer = d.service.get_peer(hash_key)
+        if not peer.info.is_owner:
+            return d, peer
+    raise RuntimeError("no non-owner daemon found")
+
+
+def _one(daemon, req):
+    return daemon.service.get_rate_limits(
+        GetRateLimitsRequest(requests=[req])
+    ).responses[0]
+
+
+def _shape(resp):
+    if resp.error:
+        return "error"
+    if (resp.metadata or {}).get("degraded") == "true":
+        return "degraded"
+    return "ok"
+
+
+def _get_json(http_address, path):
+    host, _, port = http_address.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=5.0)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        return r.status, json.loads(r.read())
+    finally:
+        conn.close()
+
+
+def until_pass(fn, timeout_s=5.0, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+# ----------------------------------------------------------------------
+# The acceptance scenario, under two different fault-plan seeds
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("seed", [11, 23])
+def test_breaker_cycle_under_partition(cluster, seed):
+    req = _mk(f"chaos_breaker_{seed}", "k", limit=1000)
+    hk = req.hash_key()
+    entry, owner_peer = _entry_and_owner(cluster, hk)
+    owner_addr = owner_peer.info.grpc_address
+
+    plan = FaultPlan(seed=seed)
+    plan.partition(owner_addr)
+    with faults.injected(plan):
+        # Sustained load against the partitioned owner.  Request 1 burns
+        # the re-pick budget observing real failures (threshold=3 opens
+        # the breaker mid-retry) and errors; every request after that
+        # fast-fails at the breaker and degrades to local evaluation.
+        start = time.monotonic()
+        trace = [_shape(_one(entry, req)) for _ in range(8)]
+        elapsed = time.monotonic() - start
+        assert trace[0] == "error"
+        assert trace[1:] == ["degraded"] * 7, trace
+        assert owner_peer.breaker.is_open
+        # Degraded traffic never waits on the dead peer: 7 local evals
+        # plus one budgeted retry loop complete far inside the 5 s batch
+        # window the old code would have burned PER send.
+        assert elapsed < 4.0
+        # Degraded responses still enforce the limit from the local
+        # shard and name the unreachable owner.
+        resp = _one(entry, req)
+        assert resp.metadata["owner"] == owner_addr
+        assert int(resp.remaining) < 1000
+
+        # Health surfaces the open breaker, on the wire via /healthz.
+        assert entry.service.health_check().breaker_open_count >= 1
+        status, payload = _get_json(entry.peer_info.http_address, "/healthz")
+        assert status == 200
+        assert payload["breakerOpenCount"] >= 1
+
+        # The peer returns: heal the partition, let the open interval
+        # lapse — the half-open probe succeeds and re-closes the breaker.
+        plan.heal(owner_addr)
+        time.sleep(behavior_open_interval(cluster) + 0.05)
+
+        def recovered():
+            r = _one(entry, req)
+            return _shape(r) == "ok" and r.metadata.get("owner") == owner_addr
+
+        assert until_pass(recovered, timeout_s=5.0)
+        assert owner_peer.breaker.state == faults.CLOSED
+
+
+def behavior_open_interval(cluster):
+    return cluster.daemons[0].conf.behaviors.circuit_open_interval_s
+
+
+def test_metrics_export_breaker_and_degraded_counters(cluster):
+    """After a breaker cycle the scrape surface carries the new series."""
+    status, _ = _get_json(cluster.daemons[0].peer_info.http_address, "/healthz")
+    assert status == 200
+    host, _, port = cluster.daemons[0].peer_info.http_address.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=5.0)
+    try:
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+    finally:
+        conn.close()
+    assert "gubernator_circuit_breaker_state" in text
+    assert "gubernator_degraded_local_evals" in text
+
+
+# ----------------------------------------------------------------------
+# Forward re-pick under peer death: exactly-once
+# ----------------------------------------------------------------------
+def test_forward_repick_lands_exactly_once(cluster):
+    """Kill a key's owner mid-request: the re-pick loop must land the
+    hit on the re-picked peer exactly once — no double count on either
+    the dead owner or the survivor."""
+    req = _mk("chaos_repick", "k")
+    hk = req.hash_key()
+    entry, owner_peer = _entry_and_owner(cluster, hk)
+    owner_addr = owner_peer.info.grpc_address
+    behaviors = entry.service.conf.behaviors
+    old_budget = behaviors.forward_retry_limit
+    old_threshold = owner_peer.breaker.failure_threshold
+    # Keep the retry loop alive (no breaker trip, big budget) long
+    # enough for "discovery" to remove the dead node deterministically.
+    behaviors.forward_retry_limit = 200
+    owner_peer.breaker.failure_threshold = 10_000
+
+    plan = FaultPlan(seed=5)
+    plan.partition(owner_addr)
+    survivors = [p for p in cluster.peers if p.grpc_address != owner_addr]
+    resp_box = {}
+    try:
+        with faults.injected(plan):
+            t = threading.Thread(
+                target=lambda: resp_box.update(resp=_one(entry, req))
+            )
+            t.start()
+            # The owner is dead: wait until the loop has observed at
+            # least two connection-shaped failures mid-retry...
+            assert until_pass(
+                lambda: plan.calls(owner_addr, "GetPeerRateLimits") >= 2
+            )
+            # ...then membership drops the dead node and the re-pick
+            # resolves to a surviving owner.
+            entry.set_peers(survivors)
+            t.join(timeout=10.0)
+            assert not t.is_alive()
+    finally:
+        behaviors.forward_retry_limit = old_budget
+        owner_peer.breaker.failure_threshold = old_threshold
+        owner_peer.breaker.record_success()
+        entry.set_peers(cluster.peers)
+
+    resp = resp_box["resp"]
+    assert not resp.error
+    new_owner = resp.metadata["owner"]
+    assert new_owner != owner_addr
+    assert new_owner in {p.grpc_address for p in survivors}
+    # Applied exactly once on the re-picked peer...
+    assert int(resp.remaining) == req.limit - req.hits
+    # ...and never on the dead owner (the injected partition is
+    # connection-shaped, so the RPC never reached it).
+    probe = _mk("chaos_repick", "k", hits=0)
+    dead = cluster.daemon_for(owner_peer.info)
+    assert int(_one(dead, probe).remaining) == req.limit
+
+
+def test_timeout_shaped_fault_is_not_retried(cluster):
+    """The DEADLINE_EXCEEDED caveat (peer_client.py:44-49): a DROP
+    fault presents as a timeout, which may have executed server-side —
+    the forward loop must surface the error, not retry into a
+    double-count."""
+    req = _mk("chaos_drop", "k")
+    hk = req.hash_key()
+    entry, owner_peer = _entry_and_owner(cluster, hk)
+    owner_addr = owner_peer.info.grpc_address
+
+    plan = FaultPlan(seed=7)
+    plan.drop_nth(owner_addr, 1)
+    with faults.injected(plan):
+        resp = _one(entry, req)
+        assert resp.error and "injected drop" in resp.error
+        assert plan.calls(owner_addr, "GetPeerRateLimits") == 1  # no retry
+        # The next request (fault window over) succeeds and shows the
+        # dropped hit was never double-applied anywhere.
+        ok = _one(entry, req)
+        assert not ok.error
+        assert int(ok.remaining) == req.limit - req.hits
+
+
+# ----------------------------------------------------------------------
+# Gossip: seeded suspect -> confirm under a probe partition
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("seed", [3, 17])
+def test_gossip_suspect_confirm_deterministic(seed):
+    """Drop every SWIM probe between two nodes (both directions, so no
+    refutation path exists) and assert each confirms the other dead —
+    reproducibly under the plan seed, with the probe schedule pinned by
+    the gossip seed."""
+    from gubernator_tpu.gossip import Gossip
+
+    plan = FaultPlan(seed=seed)
+    plan.add(FaultRule(peer="*", op=faults.OP_GOSSIP_PROBE, kind=faults.ERROR))
+    a = Gossip("127.0.0.1:0", name="a", probe_interval_s=0.05,
+               probe_timeout_s=0.05, suspect_timeout_s=0.25,
+               sync_interval_s=3600, seed=seed, faults=plan)
+    b = Gossip("127.0.0.1:0", name="b", probe_interval_s=0.05,
+               probe_timeout_s=0.05, suspect_timeout_s=0.25,
+               sync_interval_s=3600, seed=seed, faults=plan)
+    try:
+        # Join over TCP push-pull (not a probe: unaffected by the plan).
+        b.join([a.address], timeout_s=5.0)
+        assert until_pass(lambda: len(a.members()) == 2, timeout_s=5.0)
+        # Probes all drop: suspicion, then confirmation, on both sides.
+        assert until_pass(
+            lambda: len(a.members()) == 1 and len(b.members()) == 1,
+            timeout_s=10.0,
+        )
+        assert [m.name for m in a.members()] == ["a"]
+        assert [m.name for m in b.members()] == ["b"]
+        assert plan.calls(b.address, faults.OP_GOSSIP_PROBE) >= 1
+    finally:
+        a.close()
+        b.close()

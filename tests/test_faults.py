@@ -1,0 +1,344 @@
+"""Unit tests for the peer fault-tolerance layer (gubernator_tpu.faults):
+circuit breaker state machine, jittered backoff, deterministic fault
+plans, the PeerClient integration (breaker gate + injected faults +
+bounded error LRU), config knobs, and seedable gossip probe ordering.
+
+Cluster-level chaos scenarios (peer kill / partition under load) live
+in tests/test_chaos.py.
+"""
+
+import random
+
+import pytest
+
+from gubernator_tpu import faults
+from gubernator_tpu.config import BehaviorConfig, setup_daemon_config
+from gubernator_tpu.faults import Backoff, CircuitBreaker, FaultPlan, FaultRule
+from gubernator_tpu.peer_client import (
+    PeerClient,
+    PeerError,
+    is_circuit_open,
+    is_not_ready,
+)
+from gubernator_tpu.types import GetRateLimitsRequest, PeerInfo, RateLimitRequest
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+# ----------------------------------------------------------------------
+# CircuitBreaker
+# ----------------------------------------------------------------------
+def test_breaker_opens_after_threshold():
+    clk = FakeClock()
+    b = CircuitBreaker(failure_threshold=3, open_interval_s=1.0, clock=clk)
+    for _ in range(2):
+        assert b.allow()
+        b.record_failure()
+    assert b.state == faults.CLOSED
+    assert b.allow()
+    b.record_failure()
+    assert b.state == faults.OPEN
+    assert not b.allow()  # fast-fail while open
+
+
+def test_breaker_success_resets_failure_count():
+    clk = FakeClock()
+    b = CircuitBreaker(failure_threshold=2, open_interval_s=1.0, clock=clk)
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    assert b.state == faults.CLOSED  # never reached 2 consecutive
+
+
+def test_breaker_half_open_single_probe_then_close():
+    clk = FakeClock()
+    b = CircuitBreaker(failure_threshold=1, open_interval_s=1.0, clock=clk)
+    b.record_failure()
+    assert b.state == faults.OPEN
+    clk.advance(1.0)
+    assert b.state == faults.HALF_OPEN  # observer view past the interval
+    assert b.allow()  # this caller is the probe
+    assert not b.allow()  # only one probe slot
+    b.record_success()
+    assert b.state == faults.CLOSED
+    assert b.allow()
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    clk = FakeClock()
+    b = CircuitBreaker(failure_threshold=1, open_interval_s=1.0, clock=clk)
+    b.record_failure()
+    clk.advance(1.0)
+    assert b.allow()
+    b.record_failure()
+    assert b.state == faults.OPEN
+    assert not b.allow()  # a fresh open interval started
+    clk.advance(1.0)
+    assert b.allow()
+    b.record_success()
+    assert b.state == faults.CLOSED
+
+
+def test_breaker_is_open_covers_half_open():
+    clk = FakeClock()
+    b = CircuitBreaker(failure_threshold=1, open_interval_s=1.0, clock=clk)
+    assert not b.is_open
+    b.record_failure()
+    assert b.is_open
+    clk.advance(1.0)
+    assert b.is_open  # half-open peers are not yet re-trusted
+
+
+def test_breaker_transition_callback():
+    clk = FakeClock()
+    seen = []
+    b = CircuitBreaker(
+        failure_threshold=1, open_interval_s=1.0, clock=clk,
+        on_transition=seen.append,
+    )
+    b.record_failure()
+    clk.advance(1.0)
+    b.allow()
+    b.record_success()
+    assert seen == [faults.OPEN, faults.HALF_OPEN, faults.CLOSED]
+
+
+# ----------------------------------------------------------------------
+# Backoff
+# ----------------------------------------------------------------------
+def test_backoff_cap_growth_and_ceiling():
+    bo = Backoff(base_s=0.1, max_s=0.5, multiplier=2.0)
+    assert bo.cap(0) == pytest.approx(0.1)
+    assert bo.cap(1) == pytest.approx(0.2)
+    assert bo.cap(2) == pytest.approx(0.4)
+    assert bo.cap(3) == pytest.approx(0.5)  # clamped
+    assert bo.cap(10) == pytest.approx(0.5)
+
+
+def test_backoff_full_jitter_within_envelope_and_seeded():
+    a = Backoff(base_s=0.1, max_s=1.0, rng=random.Random(7))
+    b = Backoff(base_s=0.1, max_s=1.0, rng=random.Random(7))
+    for attempt in range(6):
+        da, db = a.delay(attempt), b.delay(attempt)
+        assert da == db  # same seed, same jitter sequence
+        assert 0.0 <= da <= a.cap(attempt)
+
+
+# ----------------------------------------------------------------------
+# FaultPlan
+# ----------------------------------------------------------------------
+def test_plan_error_nth_window():
+    p = FaultPlan(seed=1)
+    p.error_nth("a:1", 2, count=2)
+    assert p.intercept("a:1", "Op") is None  # call 1
+    assert p.intercept("a:1", "Op").kind == faults.ERROR  # call 2
+    assert p.intercept("a:1", "Op").kind == faults.ERROR  # call 3
+    assert p.intercept("a:1", "Op") is None  # call 4: window over
+    assert p.calls("a:1", "Op") == 4
+
+
+def test_plan_drop_is_timeout_shaped():
+    p = FaultPlan(seed=1)
+    rule = p.drop_nth("a:1", 1)
+    act = p.intercept("a:1", "Op")
+    assert act.kind == faults.DROP
+    assert act.not_ready is False  # may have executed server-side: no retry
+    assert p.fired(rule) == 1
+
+
+def test_plan_counters_are_per_peer_and_op():
+    p = FaultPlan(seed=1)
+    p.error_nth("a:1", 2)
+    assert p.intercept("a:1", "X") is None
+    assert p.intercept("a:1", "Y") is None  # different op: own counter
+    assert p.intercept("b:1", "X") is None  # different peer: own counter
+    assert p.intercept("a:1", "X").kind == faults.ERROR
+
+
+def test_plan_rate_is_seed_deterministic():
+    def decisions(seed):
+        p = FaultPlan(seed=seed)
+        p.add(FaultRule(peer="*", op="*", kind=faults.ERROR, rate=0.5))
+        return [p.intercept("a:1", "Op") is not None for _ in range(64)]
+
+    d1, d2 = decisions(42), decisions(42)
+    assert d1 == d2  # same seed, same decision sequence
+    assert any(d1) and not all(d1)  # the rate actually gates
+
+
+def test_plan_heal_and_partition():
+    p = FaultPlan(seed=1)
+    p.partition("a:1")
+    assert p.intercept("a:1", "Op").kind == faults.ERROR
+    assert p.intercept("b:1", "Op") is None
+    assert p.heal("a:1") == 1
+    assert p.intercept("a:1", "Op") is None
+
+
+def test_install_uninstall_and_context_manager():
+    plan = FaultPlan(seed=1)
+    assert faults.active() is None
+    with faults.injected(plan) as got:
+        assert got is plan
+        assert faults.active() is plan
+    assert faults.active() is None
+
+
+# ----------------------------------------------------------------------
+# PeerClient integration
+# ----------------------------------------------------------------------
+def _client(plan=None, threshold=3):
+    behaviors = BehaviorConfig(
+        circuit_threshold=threshold, circuit_open_interval_s=60.0
+    )
+    info = PeerInfo(grpc_address="127.0.0.1:1", http_address="127.0.0.1:1")
+    return PeerClient(info, behaviors, transport="grpc", faults=plan)
+
+
+def _req():
+    return GetRateLimitsRequest(
+        requests=[RateLimitRequest(name="n", unique_key="k", hits=1, limit=1)]
+    )
+
+
+def test_peer_client_injected_fault_counts_toward_breaker():
+    plan = FaultPlan(seed=1)
+    plan.partition("127.0.0.1:1")
+    c = _client(plan, threshold=3)
+    for _ in range(3):
+        with pytest.raises(PeerError) as ei:
+            c.get_peer_rate_limits(_req())
+        assert is_not_ready(ei.value)
+        assert not is_circuit_open(ei.value)
+    assert c.breaker.state == faults.OPEN
+    # Breaker now fast-fails BEFORE the fault plan / wire is consulted.
+    before = plan.calls("127.0.0.1:1", "GetPeerRateLimits")
+    with pytest.raises(PeerError) as ei:
+        c.get_peer_rate_limits(_req())
+    assert is_circuit_open(ei.value)
+    assert is_not_ready(ei.value)
+    assert plan.calls("127.0.0.1:1", "GetPeerRateLimits") == before
+    # Injected transport errors land in the health error LRU.
+    assert any("injected" in e for e in c.get_last_err())
+    c.shutdown()
+
+
+def test_wrong_count_reply_trips_breaker():
+    """A peer that consistently returns the wrong number of rate limits
+    (version skew) must trip its breaker like any transport failure —
+    the count check runs INSIDE the guarded call, so the failure streak
+    is not reset by the transport-level success."""
+    behaviors = BehaviorConfig(circuit_threshold=2, circuit_open_interval_s=60.0)
+    info = PeerInfo(grpc_address="127.0.0.1:1", http_address="127.0.0.1:1")
+    c = PeerClient(info, behaviors, transport="http")
+    c._post_inner = lambda path, payload, timeout_s: {"rateLimits": []}
+    for _ in range(2):
+        with pytest.raises(PeerError) as ei:
+            c.get_peer_rate_limits(_req())
+        assert "returned 0 rate limits for 1" in str(ei.value)
+    assert c.breaker.state == faults.OPEN
+    with pytest.raises(PeerError) as ei:
+        c.get_peer_rate_limits(_req())
+    assert is_circuit_open(ei.value)
+    c.shutdown()
+
+
+def test_peer_client_last_err_is_bounded():
+    c = _client()
+    for i in range(2 * PeerClient.LAST_ERR_MAX):
+        c._set_last_err(f"error #{i}")
+    errs = c.get_last_err()
+    assert len(errs) == PeerClient.LAST_ERR_MAX
+    # Oldest evicted, newest kept.
+    assert any("error #0 " in e for e in errs) is False
+    assert any(f"error #{2 * PeerClient.LAST_ERR_MAX - 1}" in e for e in errs)
+    c.shutdown()
+
+
+# ----------------------------------------------------------------------
+# Config knobs
+# ----------------------------------------------------------------------
+def test_fault_tolerance_env_knobs():
+    conf = setup_daemon_config(env={
+        "GUBER_CIRCUIT_THRESHOLD": "9",
+        "GUBER_CIRCUIT_OPEN_INTERVAL": "500",  # bare number = ms
+        "GUBER_FORWARD_RETRY_LIMIT": "2",
+        "GUBER_RETRY_BACKOFF_BASE": "10ms",
+        "GUBER_RETRY_BACKOFF_MAX": "2s",
+        "GUBER_GLOBAL_SEND_RETRIES": "3",
+        "GUBER_GOSSIP_SEED": "1234",
+    })
+    b = conf.behaviors
+    assert b.circuit_threshold == 9
+    assert b.circuit_open_interval_s == pytest.approx(0.5)
+    assert b.forward_retry_limit == 2
+    assert b.retry_backoff_base_s == pytest.approx(0.01)
+    assert b.retry_backoff_max_s == pytest.approx(2.0)
+    assert b.global_send_retries == 3
+    assert conf.gossip_seed == 1234
+
+
+def test_circuit_threshold_must_be_positive():
+    with pytest.raises(ValueError):
+        setup_daemon_config(env={"GUBER_CIRCUIT_THRESHOLD": "0"})
+
+
+def test_gossip_seed_defaults_to_none():
+    assert setup_daemon_config(env={}).gossip_seed is None
+
+
+# ----------------------------------------------------------------------
+# Gossip: seedable probe ordering
+# ----------------------------------------------------------------------
+def test_gossip_probe_order_is_seed_deterministic():
+    from gubernator_tpu.gossip import Gossip, Member
+
+    def probe_sequence(seed, rounds=12):
+        g = Gossip("127.0.0.1:0", probe_interval_s=3600, sync_interval_s=3600,
+                   seed=seed)
+        try:
+            for i in range(6):
+                name = f"peer-{i}"
+                g._members[name] = Member(
+                    name=name, host="127.0.0.1", port=40000 + i
+                )
+            return [g._next_probe_target().name for _ in range(rounds)]
+        finally:
+            g.close()
+
+    s1, s2 = probe_sequence(99), probe_sequence(99)
+    assert s1 == s2  # same seed -> same SWIM probe schedule
+    # Every member is visited each full ring pass (shuffled round-robin).
+    assert set(s1[:6]) == {f"peer-{i}" for i in range(6)}
+
+
+def test_gossip_probe_delay_eats_ack_timeout():
+    """An injected DELAY >= the probe timeout is a lost probe (returned
+    immediately, no real sleep); a smaller delay leaves only the
+    remainder for the ack wait — injected latency can drive suspicion."""
+    import time as _time
+
+    from gubernator_tpu.gossip import Gossip
+
+    plan = FaultPlan(seed=1)
+    plan.delay("127.0.0.1:9", 10.0, op=faults.OP_GOSSIP_PROBE)
+    g = Gossip("127.0.0.1:0", probe_interval_s=3600, sync_interval_s=3600,
+               probe_timeout_s=0.3, faults=plan)
+    try:
+        t0 = _time.monotonic()
+        assert g._ping(("127.0.0.1", 9)) is False
+        # No 10s sleep AND no 0.3s ack wait: the oversized delay is an
+        # immediate loss.
+        assert _time.monotonic() - t0 < 0.2
+        assert plan.calls("127.0.0.1:9", faults.OP_GOSSIP_PROBE) == 1
+    finally:
+        g.close()
