@@ -1,5 +1,5 @@
 # Reference Makefile:1-35 equivalents for the TPU build.
-.PHONY: test tier1 chaos bench proto certs docker release clean
+.PHONY: test tier1 chaos bench bench-gate proto certs docker release clean
 
 # The whole suite on the virtual 8-device CPU mesh (conftest.py forces
 # it); -p no:cacheprovider keeps runs hermetic like -count=1.
@@ -23,6 +23,11 @@ chaos:
 # (benchmarks/gate_thresholds.json).
 bench:
 	python bench.py
+	python bench.py --gate
+
+# Just the regression gate (reuses rows a bench run saved <1h ago,
+# measures fresh otherwise): the one-command CI check.
+bench-gate:
 	python bench.py --gate
 
 # The five BASELINE.json configs (one JSON line each); --smoke for CI

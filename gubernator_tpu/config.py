@@ -24,6 +24,15 @@ from .types import PeerInfo
 
 MAX_BATCH_SIZE = 1000  # gubernator.go:36
 
+# Lane cap for ONE columnar peer RPC (wire.py "columnar peer hop").
+# The reference's 1000-item cap guards the CLIENT surface; the internal
+# columnar hop exists to coalesce many concurrent ingress batches into
+# one RPC, so it carries more — 16k lanes is ~600KB of frame/proto,
+# well under the 1MB gRPC receive cap, and 1/4 of the device's 64k-lane
+# dispatch ceiling.  Classic (pre-columns) peers still receive
+# MAX_BATCH_SIZE chunks.
+PEER_COLUMNS_MAX_LANES = 16_384
+
 
 @dataclass
 class BehaviorConfig:
@@ -32,6 +41,14 @@ class BehaviorConfig:
     batch_timeout_s: float = 0.5
     batch_wait_s: float = 0.0005
     batch_limit: int = 1000
+    # Columnar peer hop (wire.py "columnar peer hop"): forwarded batches
+    # travel as column arrays (proto columns on gRPC, the binary frame
+    # on HTTP) and are served from the columnar receive path.  False
+    # disables BOTH directions — the daemon neither sends nor serves
+    # columns, behaving exactly like a pre-columns peer (the
+    # mixed-version interop tests run one daemon in this mode).
+    # Env: GUBER_PEER_COLUMNS.
+    peer_columns: bool = True
 
     global_timeout_s: float = 0.5
     # None = AUTO: size the window from the measured device cost of one
@@ -330,6 +347,7 @@ def setup_daemon_config(
     b.batch_limit = _env_int(merged, "GUBER_BATCH_LIMIT", b.batch_limit)
     if b.batch_limit > MAX_BATCH_SIZE:
         raise ValueError(f"GUBER_BATCH_LIMIT cannot exceed '{MAX_BATCH_SIZE}'")
+    b.peer_columns = _env_bool(merged, "GUBER_PEER_COLUMNS", b.peer_columns)
     b.global_timeout_s = _env_float_ms(merged, "GUBER_GLOBAL_TIMEOUT", b.global_timeout_s)
     b.global_sync_wait_s = _env_float_ms(
         merged, "GUBER_GLOBAL_SYNC_WAIT", b.global_sync_wait_s

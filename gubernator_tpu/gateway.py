@@ -23,8 +23,12 @@ from typing import Optional
 import numpy as np
 
 from . import native as _native
+from . import wire
+from .config import MAX_BATCH_SIZE, PEER_COLUMNS_MAX_LANES
 from .service import ApiError, ColumnarResult, IngressColumns, V1Service
 from .types import Algorithm, RateLimitRequest, UpdatePeerGlobal, _parse_behavior
+
+
 
 _GRPC_CODES = {"InvalidArgument": 3, "OutOfRange": 11, "Internal": 13}
 
@@ -99,13 +103,32 @@ def parse_body_native(raw: bytes):
 
 def render_result_native(result: ColumnarResult):
     """Native response rendering; overrides pre-render in Python (they
-    carry metadata/errors).  None when the native runtime is absent."""
+    carry metadata/errors), forwarded lanes pre-render their
+    metadata.owner straight from the arrays (no per-lane dataclass).
+    None when the native runtime is absent."""
     ov = None
     if result.overrides:
         ov = {
             i: json.dumps(r.to_json(), separators=(",", ":")).encode("utf-8")
             for i, r in result.overrides.items()
         }
+    if result.owner_of is not None:
+        ov = ov or {}
+        owner_json = [json.dumps(a) for a in result.owner_addrs]
+        status, limit = result.status, result.limit
+        remaining, reset = result.remaining, result.reset_time
+        for i in np.nonzero(result.owner_of >= 0)[0]:
+            i = int(i)
+            if i in ov:
+                continue
+            ov[i] = (
+                '{"status":"%s","limit":"%d","remaining":"%d",'
+                '"resetTime":"%d","metadata":{"owner":%s}}'
+                % (
+                    _STATUS_NAMES[status[i]], limit[i], remaining[i],
+                    reset[i], owner_json[result.owner_of[i]],
+                )
+            ).encode("utf-8")
     return _native.render_json(
         result.status, result.limit, result.remaining, result.reset_time,
         ov or {},
@@ -161,20 +184,22 @@ def render_columns(result: ColumnarResult) -> dict:
     remaining = result.remaining
     reset = result.reset_time
     ov = result.overrides
+    owner_of = result.owner_of
     out = []
     for i in range(result.n):
         r = ov.get(i)
         if r is not None:
             out.append(r.to_json())
         else:
-            out.append(
-                {
-                    "status": _STATUS_NAMES[status[i]],
-                    "limit": str(limit[i]),
-                    "remaining": str(remaining[i]),
-                    "resetTime": str(reset[i]),
-                }
-            )
+            d = {
+                "status": _STATUS_NAMES[status[i]],
+                "limit": str(limit[i]),
+                "remaining": str(remaining[i]),
+                "resetTime": str(reset[i]),
+            }
+            if owner_of is not None and owner_of[i] >= 0:
+                d["metadata"] = {"owner": result.owner_addrs[owner_of[i]]}
+            out.append(d)
     return {"responses": out}
 
 
@@ -227,11 +252,24 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes):
                 if rendered is None:
                     rendered = _json_bytes(render_columns(result))
             return 200, "application/json", rendered
-        body = json.loads(raw) if raw else {}
         if path == "/v1/peer.GetPeerRateLimits":
+            # Body parsing happens INSIDE the metrics span on BOTH
+            # gateway paths: a malformed peer body counts as a
+            # status="1" request in request_counts here exactly like on
+            # the async edge (architecture.md "Columnar pipeline: the
+            # peer hop" documents the parity rule).
             with service.metrics.observe_rpc(
                 "/pb.gubernator.PeersV1/GetPeerRateLimits"
             ):
+                if service.serves_peer_columns and wire.is_columns_frame(raw):
+                    # Columnar peer hop: binary frame in, frame out.
+                    result = service.get_peer_rate_limits_columns(
+                        _decode_frame_or_400(raw),
+                        max_lanes=PEER_COLUMNS_MAX_LANES,
+                    )
+                    return (200, wire.COLUMNS_CONTENT_TYPE,
+                            wire.encode_result_frame(result))
+                body = json.loads(raw) if raw else {}
                 cols = parse_columns(body.get("requests", []))
                 result = service.get_peer_rate_limits_columns(cols)
             # PeersV1 response field is rate_limits (peers.proto:42-45).
@@ -242,6 +280,7 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes):
             with service.metrics.observe_rpc(
                 "/pb.gubernator.PeersV1/UpdatePeerGlobals"
             ):
+                body = json.loads(raw) if raw else {}
                 updates = [
                     UpdatePeerGlobal.from_json(u)
                     for u in body.get("globals", [])
@@ -259,6 +298,16 @@ def _json_bytes(payload) -> bytes:
     return json.dumps(payload).encode("utf-8")
 
 
+def _decode_frame_or_400(raw: bytes):
+    """Frame decode for the peer endpoint: a malformed/truncated frame
+    is the CLIENT's fault — surface it as a 400 (ApiError), not a 500,
+    on both gateway paths."""
+    try:
+        return wire.decode_columns_frame(raw)
+    except ValueError as e:
+        raise ApiError("InvalidArgument", f"invalid columns frame: {e}") from e
+
+
 def _error_triplet(e: BaseException):
     """Map a handler exception to (status, content_type, body) — the
     same arms as handle_request's except clauses, shared with the async
@@ -267,7 +316,11 @@ def _error_triplet(e: BaseException):
         return e.http_status, "application/json", _json_bytes(
             {"code": _GRPC_CODES.get(e.code, 2), "message": e.message}
         )
-    if isinstance(e, json.JSONDecodeError):
+    if isinstance(e, (json.JSONDecodeError, UnicodeDecodeError)):
+        # UnicodeDecodeError: json.loads auto-detects utf-16/32 from a
+        # leading NUL and raises it for binary garbage — a malformed
+        # REQUEST, not a server fault (and the columns-negotiation
+        # probe relies on old peers answering 4xx to non-JSON bodies).
         return 400, "application/json", _json_bytes(
             {"code": 3, "message": f"invalid JSON: {e}"}
         )
@@ -297,14 +350,21 @@ def handle_request_async(service: V1Service, method: str, path: str,
     )
     metrics = service.metrics
     start = time.perf_counter()
-    finished = [False]  # exactly-once guard: an inline callback that
-    # raised must not re-enter through the outer except and answer the
-    # same token twice (round-5 review finding)
+    # Exactly-once guard: an inline callback that raised must not
+    # re-enter through the outer except and answer the same token
+    # twice (round-5 review finding).  The check-then-set is LOCKED: a
+    # completion thread and the submitting thread can race into
+    # finish() concurrently (e.g. a drainer callback firing while the
+    # submit path converts a late exception), and an unlocked flag
+    # would let both pass the check and double-respond / double-count.
+    finished = [False]
+    finished_lock = threading.Lock()
 
     def finish(status_label: str, triplet) -> None:
-        if finished[0]:
-            return
-        finished[0] = True
+        with finished_lock:
+            if finished[0]:
+                return
+            finished[0] = True
         # Manual observe_rpc: the span covers parse -> response-ready,
         # like the sync context manager covers parse -> render.
         metrics.request_counts.labels(status=status_label, method=rpc).inc()
@@ -340,13 +400,21 @@ def handle_request_async(service: V1Service, method: str, path: str,
 
             service.get_rate_limits_columns_async(cols, cb)
         else:
-            body = json.loads(raw) if raw else {}
-            cols = parse_columns(body.get("requests", []))
+            frame = service.serves_peer_columns and wire.is_columns_frame(raw)
+            if frame:
+                cols = _decode_frame_or_400(raw)
+            else:
+                body = json.loads(raw) if raw else {}
+                cols = parse_columns(body.get("requests", []))
 
             def cb(result, exc):
                 try:
                     if exc is not None:
                         finish("1", _error_triplet(exc))
+                        return
+                    if frame:
+                        finish("0", (200, wire.COLUMNS_CONTENT_TYPE,
+                                     wire.encode_result_frame(result)))
                         return
                     finish("0", (200, "application/json", _json_bytes(
                         {"rateLimits": render_columns(result)["responses"]}
@@ -354,7 +422,10 @@ def handle_request_async(service: V1Service, method: str, path: str,
                 except Exception as e:  # noqa: BLE001
                     finish("1", _error_triplet(e))
 
-            service.get_peer_rate_limits_columns_async(cols, cb)
+            service.get_peer_rate_limits_columns_async(
+                cols, cb,
+                max_lanes=PEER_COLUMNS_MAX_LANES if frame else MAX_BATCH_SIZE,
+            )
     except Exception as e:  # noqa: BLE001 — parse/submit errors, before
         finish("1", _error_triplet(e))  # any callback was registered
 

@@ -19,8 +19,10 @@ from typing import Optional
 import grpc
 
 from . import wire
+from .config import PEER_COLUMNS_MAX_LANES
 from .proto import PEERS_V1_SERVICE, V1_SERVICE
 from .proto import gubernator_pb2 as pb
+from .proto import peers_columns_pb2 as pc_pb
 from .proto import peers_pb2 as peers_pb
 from .service import ApiError, V1Service
 
@@ -206,24 +208,48 @@ def _peers_v1_handler(service: V1Service) -> grpc.GenericRpcHandler:
         except ApiError as e:
             _abort_api_error(context, e)
 
+    def get_peer_rate_limits_columns(
+        request: pc_pb.PeerColumnsReq, context
+    ) -> pc_pb.PeerColumnsResp:
+        """The columnar peer hop (peers_columns.proto): proto columns
+        decode straight into IngressColumns and the result arrays
+        serialize straight back — no per-lane dataclasses either way."""
+        try:
+            result = service.get_peer_rate_limits_columns(
+                wire.ingress_from_peer_columns_pb(request),
+                max_lanes=PEER_COLUMNS_MAX_LANES,
+            )
+            return wire.result_to_peer_columns_pb(result)
+        except ApiError as e:
+            _abort_api_error(context, e)
+
     def update_peer_globals(
         request: peers_pb.UpdatePeerGlobalsReq, context
     ) -> peers_pb.UpdatePeerGlobalsResp:
         service.update_peer_globals(wire.update_globals_req_from_pb(request))
         return peers_pb.UpdatePeerGlobalsResp()
 
-    return grpc.method_handlers_generic_handler(
-        PEERS_V1_SERVICE,
-        {
-            "GetPeerRateLimits": grpc.unary_unary_rpc_method_handler(
-                get_peer_rate_limits,
-                request_deserializer=peers_pb.GetPeerRateLimitsReq.FromString,
-                response_serializer=peers_pb.GetPeerRateLimitsResp.SerializeToString,
-            ),
-            "UpdatePeerGlobals": grpc.unary_unary_rpc_method_handler(
-                update_peer_globals,
-                request_deserializer=peers_pb.UpdatePeerGlobalsReq.FromString,
-                response_serializer=peers_pb.UpdatePeerGlobalsResp.SerializeToString,
-            ),
-        },
-    )
+    methods = {
+        "GetPeerRateLimits": grpc.unary_unary_rpc_method_handler(
+            get_peer_rate_limits,
+            request_deserializer=peers_pb.GetPeerRateLimitsReq.FromString,
+            response_serializer=peers_pb.GetPeerRateLimitsResp.SerializeToString,
+        ),
+        "UpdatePeerGlobals": grpc.unary_unary_rpc_method_handler(
+            update_peer_globals,
+            request_deserializer=peers_pb.UpdatePeerGlobalsReq.FromString,
+            response_serializer=peers_pb.UpdatePeerGlobalsResp.SerializeToString,
+        ),
+    }
+    if service.serves_peer_columns:
+        # The shared advertisement rule (V1Service.serves_peer_columns):
+        # GUBER_PEER_COLUMNS=0 — or a store without columnar support —
+        # withholds the method entirely, so callers see UNIMPLEMENTED,
+        # exactly what a pre-columns daemon answers (the mixed-version
+        # interop mode).
+        methods["GetPeerRateLimitsColumns"] = grpc.unary_unary_rpc_method_handler(
+            get_peer_rate_limits_columns,
+            request_deserializer=pc_pb.PeerColumnsReq.FromString,
+            response_serializer=pc_pb.PeerColumnsResp.SerializeToString,
+        )
+    return grpc.method_handlers_generic_handler(PEERS_V1_SERVICE, methods)

@@ -77,6 +77,15 @@ class Metrics:
             "the owner's circuit breaker was open.",
             registry=self.registry,
         )
+        # -- columnar peer hop (wire.py, peer_client.py) ---------------
+        self.peer_columns_batches = Counter(
+            "gubernator_peer_columns_batches",
+            "Forwarded peer batches by negotiated wire encoding "
+            "(columns = zero-dataclass fast path, classic = per-request "
+            "JSON/protobuf fallback to a pre-columns peer).",
+            ["encoding"],
+            registry=self.registry,
+        )
 
     @contextmanager
     def observe_rpc(self, method: str):

@@ -1790,25 +1790,6 @@ void http_loop(HttpServer* s) {
   for (;;) {
     int n = epoll_wait(s->epfd, evs, 64, 200);
     if (s->stopping.load()) return;
-    {
-      // Reclaim EOF'd conns whose peer stopped reading (see
-      // HttpConn::stall_start).  O(conns) each wakeup; the 200 ms
-      // epoll timeout bounds the sweep cadence.
-      auto now = std::chrono::steady_clock::now();
-      std::vector<HttpConn*> stalled;
-      {
-        std::lock_guard<std::mutex> lk(s->mu);
-        for (auto& [fd, c] : s->conns) {
-          if (!c->saw_eof || c->out.size() <= c->out_off) continue;
-          if (c->stall_start == std::chrono::steady_clock::time_point{}) {
-            c->stall_start = now;
-          } else if (now - c->stall_start > kEofWriteStall) {
-            stalled.push_back(c);
-          }
-        }
-      }
-      for (auto* c : stalled) http_close_conn(s, c);
-    }
     // Stage responses Python produced since the last wake.
     {
       std::unique_lock<std::mutex> lk(s->mu);
@@ -1912,6 +1893,35 @@ void http_loop(HttpServer* s) {
       }
       if (dead) http_close_conn(s, c);
       else http_arm(s, c);
+    }
+    {
+      // Reclaim EOF'd conns whose peer stopped reading (see
+      // HttpConn::stall_start).  O(conns) each wakeup; the 200 ms
+      // epoll timeout bounds the sweep cadence.
+      //
+      // Runs AFTER the fetched event batch above, never before: a
+      // sweep close ahead of the loop would free an fd whose events
+      // are still queued in evs[], and an accept() later in the SAME
+      // batch can return that fd number for a brand-new conn — the
+      // stale EPOLLHUP/EPOLLERR entry would then kill the reused fd
+      // (round-5 advisor finding).  Sweeping here means every event
+      // consumed belongs to the conn it was fetched for, and any
+      // write progress in this batch has already reset stall_start
+      // before the deadline check.
+      auto now = std::chrono::steady_clock::now();
+      std::vector<HttpConn*> stalled;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        for (auto& [fd, c] : s->conns) {
+          if (!c->saw_eof || c->out.size() <= c->out_off) continue;
+          if (c->stall_start == std::chrono::steady_clock::time_point{}) {
+            c->stall_start = now;
+          } else if (now - c->stall_start > kEofWriteStall) {
+            stalled.push_back(c);
+          }
+        }
+      }
+      for (auto* c : stalled) http_close_conn(s, c);
     }
   }
 }

@@ -45,6 +45,12 @@ class ReplicatedConsistentHash:
         self._peers: Dict[str, object] = {}
         self._vnode_hashes = np.zeros(0, dtype=np.uint64)
         self._vnode_owner: List[str] = []
+        # Integer owner codes per vnode (peer insertion order), so
+        # get_batch_codes resolves a whole batch with one fancy index —
+        # no per-lane owner-id string handling (service.py
+        # _submit_columns routing).
+        self._vnode_code = np.zeros(0, dtype=np.int32)
+        self._code_ids: List[str] = []
 
     def new(self) -> "ReplicatedConsistentHash":
         """Fresh empty picker with the same config (replicated_hash.go:61-67)."""
@@ -75,6 +81,12 @@ class ReplicatedConsistentHash:
         order = np.argsort(all_hashes, kind="stable")
         self._vnode_hashes = all_hashes[order]
         self._vnode_owner = [all_owners[i] for i in order]
+        self._code_ids = list(self._peers.keys())
+        codes = {pid: c for c, pid in enumerate(self._code_ids)}
+        self._vnode_code = np.fromiter(
+            (codes[o] for o in self._vnode_owner), np.int32,
+            count=len(self._vnode_owner),
+        )
 
     def get(self, key: str) -> str:
         """Owner peer id for a key (replicated_hash.go:104-119)."""
@@ -101,6 +113,23 @@ class ReplicatedConsistentHash:
         idxs = np.searchsorted(self._vnode_hashes, hs, side="left")
         n = len(self._vnode_owner)
         return [self._vnode_owner[i if i < n else 0] for i in idxs]
+
+    def get_batch_codes(self, keys) -> "tuple[np.ndarray, List[str]]":
+        """Fully vectorized owner lookup: (codes i32[n], id_list) where
+        codes index id_list (one entry per peer, insertion order).
+        `keys` may be a list of strings or a native.PackedKeys — either
+        way no per-lane Python objects are created here."""
+        if not self._peers:
+            raise RuntimeError("unable to pick a peer; pool is empty")
+        if self.hash_fn in (_fnv1_str, _fnv1a_str):
+            from .. import native
+
+            hs = native.fnv1_batch(keys, variant_1a=self.hash_fn is _fnv1a_str)
+        else:
+            hs = np.array([self.hash_fn(k) for k in keys], dtype=np.uint64)
+        idxs = np.searchsorted(self._vnode_hashes, hs, side="left")
+        idxs[idxs == len(self._vnode_owner)] = 0
+        return self._vnode_code[idxs], self._code_ids
 
 
 def fnv1_hash() -> HashFn:

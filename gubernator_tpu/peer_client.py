@@ -1,4 +1,5 @@
-"""Peer transport client: lazy connections, request batching, error LRU.
+"""Peer transport client: lazy connections, columnar forward
+coalescing, error LRU.
 
 Parity with peer_client.go: per-peer request queue drained into one
 GetPeerRateLimits call when BatchLimit is reached or the BatchWait
@@ -6,9 +7,19 @@ window closes (peer_client.go:272-312); NO_BATCHING bypasses the queue
 (:143-152); last-error LRU with 5-minute TTL surfaced via HealthCheck
 (:206-235); graceful shutdown drains in-flight requests (:351-385).
 
+The forward queue is COLUMNAR (the peer half of the zero-dataclass
+hot path, wire.py "columnar peer hop"): submissions accumulate lanes
+into numpy-backed column buffers instead of per-request dataclasses,
+the adaptive BatchWindow flushes them as ONE columnar RPC per <=
+batch_limit lanes, and every waiter gets back a slice of the shared
+decoded response arrays.  Wire encoding negotiates per peer: proto
+columns (gRPC) / the binary frame (HTTP) first; a peer that answers
+UNIMPLEMENTED / HTTP 400 is remembered as classic-only and served the
+per-request encoding from then on.
+
 Default transport is gRPC against the peer's PeersV1 service — the
 same data plane as the reference (lazy channel = the reference's lazy
-`connect()`, peer_client.go:87-132).  An HTTP/JSON fallback speaks the
+`connect()`, peer_client.go:87-132).  An HTTP fallback speaks the
 peer's gateway, used when TLS is configured with insecure_skip_verify
 (gRPC channel credentials cannot skip verification) or on request.
 """
@@ -24,13 +35,15 @@ from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import grpc
+import numpy as np
 
 from . import faults as faults_mod
 from . import wire
-from .config import BehaviorConfig
+from .config import MAX_BATCH_SIZE, PEER_COLUMNS_MAX_LANES, BehaviorConfig
 from .faults import CircuitBreaker, FaultPlan
 from .utils.batch_window import BatchWindow
 from .proto import PEERS_V1_SERVICE
+from .proto import peers_columns_pb2 as pc_pb
 from .proto import peers_pb2 as peers_pb
 from .types import (
     Behavior,
@@ -55,13 +68,17 @@ _NOT_READY_CODES = (grpc.StatusCode.UNAVAILABLE,)
 
 class PeerError(Exception):
     def __init__(self, message: str, not_ready: bool = False,
-                 circuit_open: bool = False):
+                 circuit_open: bool = False, http_status: int = 0):
         super().__init__(message)
         self.not_ready = not_ready
         # The call never left this host: the peer's circuit breaker was
         # open.  Routers degrade to local evaluation instead of
         # retrying (faults.py; service._forward_one).
         self.circuit_open = circuit_open
+        # HTTP transport only: the peer's status code (0 = not an HTTP
+        # status failure).  The columns negotiation reads it — a 400 to
+        # a columns frame means "old peer, speak JSON".
+        self.http_status = http_status
 
 
 def is_not_ready(err: Exception) -> bool:
@@ -115,16 +132,48 @@ class PeerClient:
         self._conn: Optional[http.client.HTTPConnection] = None
         self._channel: Optional[grpc.Channel] = None
         self._rpc_get_peer_rate_limits = None
+        self._rpc_get_peer_rate_limits_columns = None
         self._rpc_update_peer_globals = None
         self._shutdown = threading.Event()
         self._err_lock = threading.Lock()
         self._last_err: Dict[str, float] = {}  # message -> expiry timestamp
+        # Columnar wire negotiation: None = untried (probe columns
+        # first), True = peer speaks columns, False = classic only
+        # (config opt-out, or the peer answered UNIMPLEMENTED / 400 to
+        # the probe).  Sticky for the client's lifetime — a peer that
+        # upgrades in place re-negotiates when churn rebuilds the
+        # client (service.set_peers).
+        self._columnar: Optional[bool] = (
+            None if self.behaviors.peer_columns else False
+        )
+        # Per-RPC lane caps.  The operator's GUBER_BATCH_LIMIT keeps
+        # meaning on both encodings: it is the classic per-RPC cap
+        # verbatim, and the columnar cap scales with it (16.384x at the
+        # default 1000) bounded by what the protocol allows.
+        self._classic_cap = min(self.behaviors.batch_limit, MAX_BATCH_SIZE)
+        self._columns_cap = max(
+            1, PEER_COLUMNS_MAX_LANES * self._classic_cap // MAX_BATCH_SIZE
+        )
         # Lazy worker: idle peers (never forwarded to) spawn no thread.
+        # Items are ((names, uks, algo, beh, hits, limit, dur), fut)
+        # COLUMN sub-batches; the limit counts LANES (weigh) and the
+        # window adapts its wait to the arrival rate (batch_window.py).
+        # A columns-capable peer accepts PEER_COLUMNS_MAX_LANES per
+        # RPC, so the window coalesces up to the columnar cap per flush
+        # (the whole point of the columnar hop: concurrent ingress
+        # batches to one owner merge into ONE RPC); _send_batch chunks
+        # down to what the negotiated encoding allows, and a peer that
+        # negotiates down to classic shrinks the window itself
+        # (_mark_classic) so flushes stop out-sizing its RPCs.
         self._window = BatchWindow(
             self._send_batch,
             self.behaviors.batch_wait_s,
-            self.behaviors.batch_limit,
+            self._columns_cap
+            if self.behaviors.peer_columns
+            else self._classic_cap,
             lazy=True,
+            adaptive=True,
+            weigh=lambda item: len(item[0][0]),
         )
 
     # ------------------------------------------------------------------
@@ -132,18 +181,50 @@ class PeerClient:
         self, req: RateLimitRequest, timeout_s: Optional[float] = None
     ) -> RateLimitResponse:
         """One rate limit from the owning peer; batched unless the
-        request asks NO_BATCHING (peer_client.go:141-154)."""
+        request asks NO_BATCHING (peer_client.go:141-154).  The batched
+        path rides the columnar coalescer as a 1-lane sub-batch."""
         if has_behavior(req.behavior, Behavior.NO_BATCHING):
             resp = self.get_peer_rate_limits(
                 GetRateLimitsRequest(requests=[req]), timeout_s=timeout_s
             )
             return resp.responses[0]
+        fut = self.forward_columns(
+            (
+                [req.name],
+                [req.unique_key],
+                np.array([int(req.algorithm)], np.int32),
+                np.array([int(req.behavior)], np.int32),
+                np.array([int(req.hits)], np.int64),
+                np.array([int(req.limit)], np.int64),
+                np.array([int(req.duration)], np.int64),
+            )
+        )
+        timeout = timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
+        rc, lo, _hi = fut.result(timeout=timeout + 1.0)
+        return rc.response_at(lo)
+
+    def forward_columns(self, cols: "wire.PeerColumns") -> Future:
+        """Submit a column sub-batch to the per-owner coalescing window
+        (peer_client.go:272-312 sendQueue, columnar).  The future
+        resolves to (result: service.ColumnarResult, lo, hi) — this
+        sub-batch's slice of the shared flushed batch — or raises the
+        transport/breaker failure."""
         if self._shutdown.is_set():
             raise PeerError(ERR_CLOSING, not_ready=True)
         fut: Future = Future()
-        self._window.submit((req, fut))
-        timeout = timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
-        return fut.result(timeout=timeout + 1.0)
+        self._window.submit((cols, fut))
+        return fut
+
+    def send_columns_direct(self, cols: "wire.PeerColumns",
+                            timeout_s: Optional[float] = None):
+        """One columnar GetPeerRateLimits RPC, no window (the
+        NO_BATCHING group forward).  Returns service.ColumnarResult."""
+        if self._shutdown.is_set():
+            raise PeerError(ERR_CLOSING, not_ready=True)
+        return self._send_columns(
+            cols,
+            timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s,
+        )
 
     def get_peer_rate_limits(
         self, req: GetRateLimitsRequest, timeout_s: Optional[float] = None,
@@ -200,32 +281,140 @@ class PeerClient:
             )
 
     # ------------------------------------------------------------------
-    def _send_batch(self, batch: List[Tuple[RateLimitRequest, Future]]) -> None:
-        """peer_client.go:316-348 sendQueue."""
+    def _send_batch(self, batch: List[tuple]) -> None:
+        """peer_client.go:316-348 sendQueue, columnar: concatenate the
+        queued column sub-batches and send ONE columnar RPC per chunk.
+        The chunk cap is what the peer is KNOWN to accept: a confirmed
+        columns speaker takes PEER_COLUMNS_MAX_LANES; an unconfirmed or
+        classic peer takes MAX_BATCH_SIZE (the probe that discovers an
+        old peer falls back to the classic encoding inside the same
+        call, so the probe chunk must already satisfy the classic cap).
+        Waiters get (shared result, lo, hi) slices."""
+        cap = (
+            self._columns_cap if self._columnar is True
+            else self._classic_cap
+        )
+        chunk: List[tuple] = []
+        lanes = 0
+        for item in batch:
+            n = len(item[0][0])
+            if chunk and lanes + n > cap:
+                self._send_chunk(chunk)
+                chunk, lanes = [], 0
+                # A probe chunk may just have confirmed columns
+                # support; later chunks of the same flush coalesce up
+                # to the full columnar cap right away.
+                cap = (
+                    self._columns_cap if self._columnar is True
+                    else self._classic_cap
+                )
+            chunk.append(item)
+            lanes += n
+        if chunk:
+            self._send_chunk(chunk)
+
+    def _mark_classic(self) -> None:
+        """The peer negotiated down to the classic encoding: remember,
+        and shrink the coalescing window to the classic per-RPC cap so
+        future flushes are ONE RPC each — without this, a 16k-lane
+        window against a classic peer becomes a train of sequential
+        chunk RPCs whose late waiters outlive their timeout budget."""
+        self._columnar = False
+        self._window.limit = self._classic_cap
+
+    def _classic_resend(self, cols: "wire.PeerColumns", send_chunk):
+        """Downgraded resend shared by both transports: re-chunk a
+        (possibly columnar-cap-sized) batch to the classic per-RPC cap
+        and send each chunk with `send_chunk(sub) -> ColumnarResult`,
+        concatenating the results lane-aligned."""
+        n_total = len(cols[0])
+        cap = self._classic_cap
+        parts = []
+        for lo in range(0, n_total, cap):
+            parts.append(
+                send_chunk(
+                    wire.peer_columns_slice(cols, lo, min(lo + cap, n_total))
+                )
+            )
+        return wire.concat_results(parts)
+
+    def _send_chunk(self, chunk: List[tuple]) -> None:
         try:
-            resp = self.get_peer_rate_limits(
-                GetRateLimitsRequest(requests=[r for r, _ in batch]),
-                timeout_s=self.behaviors.batch_timeout_s,
-                _draining=True,
+            if len(chunk) == 1:
+                cols = chunk[0][0]
+            else:
+                cols = (
+                    [s for c, _ in chunk for s in c[0]],
+                    [s for c, _ in chunk for s in c[1]],
+                    *(
+                        np.concatenate([c[i] for c, _ in chunk])
+                        for i in range(2, 7)
+                    ),
+                )
+            rc = self._send_columns(
+                cols, self.behaviors.batch_timeout_s, _draining=True
             )
         except Exception as e:  # noqa: BLE001
-            for _, fut in batch:
+            for _, fut in chunk:
                 if not fut.done():
                     fut.set_exception(e)
             return
-        for (_, fut), rl in zip(batch, resp.responses):
+        lo = 0
+        for c, fut in chunk:
+            hi = lo + len(c[0])
             if not fut.done():
-                fut.set_result(rl)
+                fut.set_result((rc, lo, hi))
+            lo = hi
+
+    def _send_columns(self, cols: "wire.PeerColumns",
+                      timeout_s: Optional[float], _draining: bool = False):
+        """One columnar GetPeerRateLimits over the configured transport
+        (negotiating the encoding, see _columnar).  Returns a decoded
+        service.ColumnarResult of exactly len(cols) lanes."""
+        n = len(cols[0])
+
+        def _count_check(rc) -> None:
+            # Inside the _guarded_call region: a wrong-count reply
+            # trips the breaker like any transport failure.
+            if rc.n != n:
+                msg = (
+                    f"GetPeerRateLimits to peer {self.info.grpc_address} "
+                    f"returned {rc.n} rate limits for {n} requests"
+                )
+                self._set_last_err(msg)
+                raise PeerError(msg)
+
+        if self.transport == "http":
+            if self._shutdown.is_set() and not _draining:
+                raise PeerError(ERR_CLOSING, not_ready=True)
+            rc = self._guarded_call(
+                "GetPeerRateLimits",
+                lambda: self._post_columns_inner(cols, timeout_s),
+                _count_check,
+            )
+        else:
+            if self._shutdown.is_set() and not _draining:
+                raise PeerError(ERR_CLOSING, not_ready=True)
+            rc = self._guarded_call(
+                "GetPeerRateLimits",
+                lambda: self._grpc_columns_inner(cols, timeout_s),
+                _count_check,
+            )
+        if self._metrics is not None:
+            self._metrics.peer_columns_batches.labels(
+                encoding="columns" if self._columnar else "classic"
+            ).inc()
+        return rc
 
     # ------------------------------------------------------------------
     # gRPC transport (lazy channel = peer_client.go:87-132 connect())
     # ------------------------------------------------------------------
     def _ensure_channel(self):
-        """Returns (get_peer_rate_limits, update_peer_globals) stubs,
-        building the channel lazily.  The stubs are captured and
-        returned under the lock: _reset_channel may null the attributes
-        concurrently (a racing thread observing a torn state must not
-        see None)."""
+        """Returns (get_peer_rate_limits, update_peer_globals,
+        get_peer_rate_limits_columns) stubs, building the channel
+        lazily.  The stubs are captured and returned under the lock:
+        _reset_channel may null the attributes concurrently (a racing
+        thread observing a torn state must not see None)."""
         with self._conn_lock:
             if self._channel is None:
                 target = self.info.grpc_address
@@ -241,12 +430,21 @@ class PeerClient:
                     request_serializer=peers_pb.GetPeerRateLimitsReq.SerializeToString,
                     response_deserializer=peers_pb.GetPeerRateLimitsResp.FromString,
                 )
+                self._rpc_get_peer_rate_limits_columns = self._channel.unary_unary(
+                    f"/{PEERS_V1_SERVICE}/GetPeerRateLimitsColumns",
+                    request_serializer=pc_pb.PeerColumnsReq.SerializeToString,
+                    response_deserializer=pc_pb.PeerColumnsResp.FromString,
+                )
                 self._rpc_update_peer_globals = self._channel.unary_unary(
                     f"/{PEERS_V1_SERVICE}/UpdatePeerGlobals",
                     request_serializer=peers_pb.UpdatePeerGlobalsReq.SerializeToString,
                     response_deserializer=peers_pb.UpdatePeerGlobalsResp.FromString,
                 )
-            return self._rpc_get_peer_rate_limits, self._rpc_update_peer_globals
+            return (
+                self._rpc_get_peer_rate_limits,
+                self._rpc_update_peer_globals,
+                self._rpc_get_peer_rate_limits_columns,
+            )
 
     # ------------------------------------------------------------------
     # Fault-tolerance wrap: every transport call passes the breaker gate
@@ -319,23 +517,81 @@ class PeerClient:
 
     def _grpc_inner(self, method: str, request, timeout_s: Optional[float]):
         try:
-            get_rl, update_g = self._ensure_channel()
+            get_rl, update_g, _ = self._ensure_channel()
             rpc = get_rl if method == "GetPeerRateLimits" else update_g
             timeout = (
                 timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
             )
             return rpc(request, timeout=timeout)
         except grpc.RpcError as e:
-            code = e.code() if hasattr(e, "code") else None
-            msg = f"{method} to peer {self.info.grpc_address} failed: {code}: {e.details() if hasattr(e, 'details') else e}"
-            self._set_last_err(msg)
-            # Drop the channel so the next call redials immediately
-            # instead of sitting in gRPC's reconnect backoff (the lazy
-            # reconnect of peer_client.go:87-132; a restarted peer at
-            # the same address must be reachable right away).
-            if code == grpc.StatusCode.UNAVAILABLE:
-                self._reset_channel()
-            raise PeerError(msg, not_ready=code in _NOT_READY_CODES) from e
+            raise self._wrap_grpc_error(method, e) from e
+        except ValueError as e:
+            raise self._wrap_value_error(method, e) from e
+
+    def _grpc_columns_inner(self, cols: "wire.PeerColumns",
+                            timeout_s: Optional[float]):
+        """Columnar GetPeerRateLimits over gRPC: proto columns against
+        the peer's GetPeerRateLimitsColumns method; an UNIMPLEMENTED
+        answer from an untried peer downgrades to the classic
+        per-request encoding (same guarded call — the negotiation miss
+        is not a breaker failure)."""
+        timeout = (
+            timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
+        )
+        try:
+            get_rl, _upd, get_cols = self._ensure_channel()
+            if self._columnar is not False:
+                try:
+                    m = get_cols(
+                        wire.peer_columns_req_to_pb(cols), timeout=timeout
+                    )
+                    self._columnar = True
+                    return wire.result_from_peer_columns_pb(m)
+                except grpc.RpcError as e:
+                    code = e.code() if hasattr(e, "code") else None
+                    if code == grpc.StatusCode.UNIMPLEMENTED:
+                        # Old (or in-place downgraded, even after a
+                        # confirmed columnar run) peer: UNIMPLEMENTED
+                        # means the method never executed, so the
+                        # classic resend below cannot double-count.
+                        self._mark_classic()
+                    else:
+                        raise
+            return self._classic_resend(
+                cols,
+                lambda sub: wire.result_from_classic_peer_pb(
+                    get_rl(wire.peer_columns_to_classic_pb(sub), timeout=timeout)
+                ),
+            )
+        except grpc.RpcError as e:
+            raise self._wrap_grpc_error("GetPeerRateLimits", e) from e
+        except ValueError as e:
+            raise self._wrap_value_error("GetPeerRateLimits", e) from e
+
+    def _wrap_grpc_error(self, method: str, e: grpc.RpcError) -> "PeerError":
+        code = e.code() if hasattr(e, "code") else None
+        msg = f"{method} to peer {self.info.grpc_address} failed: {code}: {e.details() if hasattr(e, 'details') else e}"
+        self._set_last_err(msg)
+        # Drop the channel so the next call redials immediately
+        # instead of sitting in gRPC's reconnect backoff (the lazy
+        # reconnect of peer_client.go:87-132; a restarted peer at
+        # the same address must be reachable right away).
+        if code == grpc.StatusCode.UNAVAILABLE:
+            self._reset_channel()
+        return PeerError(msg, not_ready=code in _NOT_READY_CODES)
+
+    def _wrap_value_error(self, method: str, e: ValueError) -> "PeerError":
+        """Two ValueError sources meet here: grpc's bare "Cannot invoke
+        RPC: Channel closed!" from a shutdown racing a call (presented
+        as the closing error, not a crash), and a reply that failed to
+        decode (mismatched column lengths, corrupt payload) — a peer
+        failure that must be recorded like any other so HealthCheck
+        surfaces the misbehaving peer."""
+        if "closed" in str(e).lower():
+            return PeerError(ERR_CLOSING, not_ready=True)
+        msg = f"{method} to peer {self.info.grpc_address} failed: {e}"
+        self._set_last_err(msg)
+        return PeerError(msg)
 
     def _reset_channel(self) -> None:
         with self._conn_lock:
@@ -356,8 +612,88 @@ class PeerClient:
         )
 
     def _post_inner(self, path: str, payload: dict, timeout_s: Optional[float]) -> dict:
+        body = self._http_roundtrip(
+            path, json.dumps(payload).encode("utf-8"), timeout_s,
+            "application/json",
+        )
+        return json.loads(body) if body else {}
+
+    def _post_columns_inner(self, cols: "wire.PeerColumns",
+                            timeout_s: Optional[float]):
+        """Columnar GetPeerRateLimits over HTTP: the binary frame
+        against the same /v1/peer.GetPeerRateLimits path (the receiver
+        sniffs the magic).  An old peer answers 400 (its JSON parse
+        fails) — remember and resend as classic per-request JSON inside
+        the same guarded call."""
+        if self._columnar is not False:
+            frame = wire.encode_columns_frame(cols)
+            try:
+                body = self._http_roundtrip(
+                    "/v1/peer.GetPeerRateLimits", frame, timeout_s,
+                    wire.COLUMNS_CONTENT_TYPE,
+                )
+            except PeerError as e:
+                # Downgrade when the frame was provably REJECTED, not
+                # applied (safe to resend classic): a 4xx, or the old
+                # gateway's 500 — pre-columns builds map the
+                # UnicodeDecodeError json.loads raises on the frame's
+                # binary columns to a 500 whose body names the codec
+                # failure, so that exact shape is a version answer too.
+                rejected = e.http_status in (400, 404, 415) or (
+                    e.http_status == 500 and "codec can't decode" in str(e)
+                )
+                if rejected:
+                    self._mark_classic()
+                    # A benign version probe, not a peer failure: it
+                    # must not leave HealthCheck unhealthy for 5 min.
+                    self._clear_last_err(str(e))
+                else:
+                    raise
+            else:
+                if wire.is_columns_frame(body):
+                    self._columnar = True
+                    try:
+                        return wire.decode_result_frame(body)
+                    except ValueError as e:
+                        msg = (
+                            f"GetPeerRateLimits to peer "
+                            f"{self.info.grpc_address} returned a "
+                            f"malformed columns frame: {e}"
+                        )
+                        self._set_last_err(msg)
+                        raise PeerError(msg) from e
+                # 200 with a non-frame body: the peer ANSWERED (it may
+                # well have applied the batch), so re-sending would
+                # double-count every hit.  Fail this batch, and speak
+                # classic from now on (whatever rewrote the response —
+                # proxy, exotic build — clearly doesn't pass frames).
+                self._mark_classic()
+                msg = (
+                    f"GetPeerRateLimits to peer {self.info.grpc_address} "
+                    f"answered a columns frame with a non-frame 200 body"
+                )
+                self._set_last_err(msg)
+                raise PeerError(msg)
+        def _send_json_chunk(sub):
+            body = self._http_roundtrip(
+                "/v1/peer.GetPeerRateLimits",
+                json.dumps(
+                    wire.peer_columns_to_classic_json(sub)
+                ).encode("utf-8"),
+                timeout_s, "application/json",
+            )
+            return wire.result_from_classic_peer_json(
+                json.loads(body) if body else {}
+            )
+
+        return self._classic_resend(cols, _send_json_chunk)
+
+    def _http_roundtrip(self, path: str, data: bytes,
+                        timeout_s: Optional[float], content_type: str) -> bytes:
+        """One POST over the persistent peer connection; returns the
+        raw response body.  Non-200 raises PeerError carrying the
+        status (the columns negotiation reads it)."""
         timeout = timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
-        data = json.dumps(payload).encode("utf-8")
         host = self.info.http_address or self.info.grpc_address
         with self._conn_lock:
             try:
@@ -373,13 +709,17 @@ class PeerClient:
                             hostname, int(port or 80), timeout=timeout
                         )
                 self._conn.request(
-                    "POST", path, body=data, headers={"Content-Type": "application/json"}
+                    "POST", path, body=data,
+                    headers={"Content-Type": content_type},
                 )
                 r = self._conn.getresponse()
                 body = r.read()
                 if r.status != 200:
-                    raise PeerError(f"peer returned HTTP {r.status}: {body[:200]!r}")
-                return json.loads(body) if body else {}
+                    raise PeerError(
+                        f"peer returned HTTP {r.status}: {body[:200]!r}",
+                        http_status=r.status,
+                    )
+                return body
             except PeerError as e:
                 self._set_last_err(str(e))
                 self._reset_conn()
@@ -412,6 +752,10 @@ class PeerClient:
             self._last_err[key] = time.monotonic() + self.LAST_ERR_TTL_S
             while len(self._last_err) > self.LAST_ERR_MAX:
                 self._last_err.pop(next(iter(self._last_err)))
+
+    def _clear_last_err(self, msg: str) -> None:
+        with self._err_lock:
+            self._last_err.pop(f"{msg} (peer: {self.info.grpc_address})", None)
 
     def get_last_err(self) -> List[str]:
         now = time.monotonic()

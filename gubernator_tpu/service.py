@@ -187,8 +187,15 @@ class IngressColumns:
 @dataclass
 class ColumnarResult:
     """Column-form GetRateLimits responses: arrays for the fast lanes
-    plus sparse per-lane overrides (validation errors, forwarded /
-    GLOBAL lanes that carry metadata or error strings)."""
+    plus sparse per-lane overrides (validation errors, degraded /
+    GLOBAL lanes that carry metadata or error strings).
+
+    Forwarded fast lanes stay in the arrays: the owning peer's address
+    rides the `owner_of`/`owner_addrs` annotation (an i32 index per
+    lane into a per-batch address list) instead of a per-lane override,
+    so the render edges can emit the reference's metadata.owner
+    (gubernator.go:190,209) without materializing a dataclass per
+    forwarded lane."""
 
     n: int
     status: np.ndarray
@@ -196,6 +203,8 @@ class ColumnarResult:
     remaining: np.ndarray
     reset_time: np.ndarray
     overrides: Dict[int, RateLimitResponse] = field(default_factory=dict)
+    owner_addrs: List[str] = field(default_factory=list)
+    owner_of: Optional[np.ndarray] = None  # i32[n], -1 = local lane
 
     @classmethod
     def empty(cls, n: int) -> "ColumnarResult":
@@ -205,15 +214,33 @@ class ColumnarResult:
             remaining=z.copy(), reset_time=z.copy(),
         )
 
+    def set_owner(self, lanes, addr: str) -> None:
+        """Annotate `lanes` (index array) as forwarded to `addr`."""
+        if self.owner_of is None:
+            self.owner_of = np.full(self.n, -1, dtype=np.int32)
+        try:
+            k = self.owner_addrs.index(addr)
+        except ValueError:
+            self.owner_addrs.append(addr)
+            k = len(self.owner_addrs) - 1
+        self.owner_of[lanes] = k
+
+    def owner_at(self, i: int) -> Optional[str]:
+        if self.owner_of is None or self.owner_of[i] < 0:
+            return None
+        return self.owner_addrs[self.owner_of[i]]
+
     def response_at(self, i: int) -> RateLimitResponse:
         ov = self.overrides.get(i)
         if ov is not None:
             return ov
+        owner = self.owner_at(i)
         return RateLimitResponse(
             status=int(self.status[i]),
             limit=int(self.limit[i]),
             remaining=int(self.remaining[i]),
             reset_time=int(self.reset_time[i]),
+            metadata={"owner": owner} if owner is not None else {},
         )
 
     def to_response(self) -> GetRateLimitsResponse:
@@ -288,6 +315,55 @@ def _deliver_future(callback, fut) -> None:
     callback(value, exc)
 
 
+def _cols_to_requests(sub) -> List[RateLimitRequest]:
+    """Materialize a forwarded column sub-batch as dataclasses — the
+    FAILURE legs only (degraded local eval, per-item re-pick): the fast
+    path never calls this."""
+    names, uks, algo, beh, hits, limit, duration = sub
+    return [
+        RateLimitRequest(
+            name=names[i],
+            unique_key=uks[i],
+            hits=int(hits[i]),
+            limit=int(limit[i]),
+            duration=int(duration[i]),
+            algorithm=int(algo[i]),
+            behavior=int(beh[i]),
+        )
+        for i in range(len(names))
+    ]
+
+
+def _merge_group_result(result, idxs, addr, resps) -> None:
+    """Merge one owner-group forward outcome into `result` — the
+    shared body of the blocking _finalize_columns and the async
+    _ColumnsJoin.  ("cols", rc, lo, hi) scatters the decoded response
+    arrays (zero-dataclass); a list is the fallback legs' per-lane
+    dataclasses; an Exception converts per lane."""
+    if isinstance(resps, Exception):
+        for i in idxs:
+            result.overrides[int(i)] = RateLimitResponse(
+                error=f"while fetching rate limit from peer - '{resps}'"
+            )
+        return
+    if isinstance(resps, tuple):
+        _tag, rc, lo, hi = resps
+        idx = np.asarray(idxs, dtype=np.int64)
+        sl = slice(lo, hi)
+        result.status[idx] = rc.status[sl]
+        result.limit[idx] = rc.limit[sl]
+        result.remaining[idx] = rc.remaining[sl]
+        result.reset_time[idx] = rc.reset_time[sl]
+        result.set_owner(idx, addr)
+        for lane, r in rc.overrides.items():
+            if lo <= lane < hi:
+                r.metadata.setdefault("owner", addr)
+                result.overrides[int(idxs[lane - lo])] = r
+        return
+    for i, r in zip(idxs, resps):
+        result.overrides[int(i)] = r
+
+
 def _merge_fast_result(result, hash_keys, fast_idx, out, sl, exc) -> None:
     """Scatter one resolved fast dispatch into `result` (or convert a
     dispatch failure to per-lane errors) — the shared merge body of the
@@ -312,30 +388,45 @@ def _merge_fast_result(result, hash_keys, fast_idx, out, sl, exc) -> None:
 
 class _HandleDrainer:
     """Resolves columnar dispatch handles OFF the request thread: a
-    small pool blocks on handle.result() (the device readback) and
-    fires callbacks.  The pool size bounds concurrently-overlapping
-    readbacks — matching the store's dispatch-depth backstop
-    (ColumnarBatcher.MAX_INFLIGHT) — NOT the in-flight request count,
-    which is the point: the sync path parks one caller thread per
-    request for the whole device round; this parks one thread per
-    DISPATCH, so a 100-way storm coalescing into a handful of windows
-    costs a handful of blocked threads."""
+    pool blocks on handle.result() (the device readback) and fires
+    callbacks.  The pool size bounds concurrently-overlapping
+    readbacks — it tracks the ACTUAL dispatch depth, not the in-flight
+    request count, which is the point: the sync path parks one caller
+    thread per request for the whole device round; this parks one
+    thread per DISPATCH, so a 100-way storm coalescing into a handful
+    of windows costs a handful of blocked threads.
 
-    N_THREADS = 8
+    Sizing is demand-driven (a register() that finds no idle worker
+    spawns one, up to MAX_THREADS): steady single-window traffic runs
+    on MIN_THREADS, while a deep pipeline — many unresolved dispatches,
+    e.g. NO_BATCHING storms or a device stall backing up handles —
+    grows the pool to match instead of queueing callbacks behind a
+    fixed-width pool (the round-5 fixed 8 threads were simultaneously
+    too many idle for the common case and too few for a stall)."""
+
+    MIN_THREADS = 2
+    MAX_THREADS = 32
 
     def __init__(self):
         self._q: "deque" = deque()
         self._cv = threading.Condition()
         self._stopped = False
         self._threads: list = []
+        self._idle = 0
 
     def start(self) -> None:
-        for i in range(self.N_THREADS):
-            t = threading.Thread(
-                target=self._run, daemon=True, name=f"columns-drain-{i}"
-            )
-            t.start()
-            self._threads.append(t)
+        with self._cv:
+            for _ in range(self.MIN_THREADS):
+                self._spawn()
+
+    def _spawn(self) -> None:
+        # _cv held.
+        t = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"columns-drain-{len(self._threads)}",
+        )
+        t.start()
+        self._threads.append(t)
 
     def register(self, handle, cb) -> None:
         """cb(value, exc) fires exactly once from a drainer thread (or
@@ -343,6 +434,14 @@ class _HandleDrainer:
         with self._cv:
             if not self._stopped:
                 self._q.append((handle, cb))
+                # Backlog deeper than the idle workers that will drain
+                # it => the dispatch depth outgrew the pool; add one
+                # thread per register until they match (bounded).
+                if (
+                    len(self._q) > self._idle
+                    and len(self._threads) < self.MAX_THREADS
+                ):
+                    self._spawn()
                 self._cv.notify()
                 return
         cb(None, PeerError(ERR_BATCHER_CLOSED))
@@ -350,8 +449,10 @@ class _HandleDrainer:
     def _run(self) -> None:
         while True:
             with self._cv:
+                self._idle += 1
                 while not self._q and not self._stopped:
                     self._cv.wait()
+                self._idle -= 1
                 if not self._q:
                     return  # stopped and drained
                 handle, cb = self._q.popleft()
@@ -372,8 +473,9 @@ class _HandleDrainer:
         with self._cv:
             self._stopped = True
             self._cv.notify_all()
+            threads = list(self._threads)
         deadline = time.monotonic() + timeout_s
-        for t in self._threads:
+        for t in threads:
             t.join(timeout=max(deadline - time.monotonic(), 0.1))
 
 
@@ -446,8 +548,8 @@ class _ColumnsJoin:
     def _on_group(self, addr, fut) -> None:
         try:
             resps = fut.result()
-        except Exception as e:  # noqa: BLE001 — _forward_group converts
-            resps = e  # internally; this is pool-failure defensive
+        except Exception as e:  # noqa: BLE001 — _forward_group_columns
+            resps = e  # converts internally; this is pool-failure defensive
         with self._lock:
             self._group_res[addr] = resps
         self._countdown()
@@ -478,18 +580,9 @@ class _ColumnsJoin:
                     for i, r in zip(plan.slow_idx, self._slow_resps):
                         result.overrides[int(i)] = r
                 for addr, resps in self._group_res.items():
-                    idxs = plan.remote_groups[addr]
-                    if isinstance(resps, Exception):
-                        for i in idxs:
-                            result.overrides[int(i)] = RateLimitResponse(
-                                error=(
-                                    "while fetching rate limit from peer - "
-                                    f"'{resps}'"
-                                )
-                            )
-                    else:
-                        for i, r in zip(idxs, resps):
-                            result.overrides[int(i)] = r
+                    _merge_group_result(
+                        result, plan.remote_groups[addr], addr, resps
+                    )
                 for fast_idx, out, sl, exc in self._fast_outs:
                     _merge_fast_result(
                         result, plan.hash_keys, fast_idx, out, sl, exc
@@ -507,7 +600,11 @@ class ColumnarBatcher:
     resolve the handle themselves, so readbacks overlap across callers
     (ColumnarPipeline).  NO_BATCHING batches bypass the window."""
 
-    MAX_SUBMISSIONS = 64  # x 1000-lane cap each = device batch <= 64k lanes
+    # Lane budget per flush: the device batch ceiling.  Lane-weighted
+    # (a coalesced columnar peer RPC submits up to
+    # PEER_COLUMNS_MAX_LANES in ONE submission), equal to the previous
+    # 64-submissions x 1000-lane-cap bound.
+    MAX_LANES = 64_000
     # Overload backstop, NOT a pacing gate: the flush worker only blocks
     # when this many of ITS OWN dispatches are unresolved.  Round-5
     # probes showed a tight gate (depth 2) is actively harmful on a
@@ -527,7 +624,8 @@ class ColumnarBatcher:
         # flushes from another thread) — the backstop deque needs a lock.
         self._inflight_lock = threading.Lock()
         self._window = BatchWindow(
-            self._flush, behaviors.batch_wait_s, self.MAX_SUBMISSIONS
+            self._flush, behaviors.batch_wait_s, self.MAX_LANES,
+            weigh=lambda item: len(item[0][0]),
         )
 
     def submit(self, keys, algo, behavior, hits, limit, duration,
@@ -545,6 +643,23 @@ class ColumnarBatcher:
         return fut
 
     def _flush(self, batch) -> None:
+        # The window admits the submission that CROSSES the lane limit
+        # (it cannot un-take from the queue), so one flush can overshoot
+        # MAX_LANES by up to a submission; re-chunk so no single device
+        # dispatch exceeds the ceiling (an oversized dispatch would pad
+        # to a brand-new XLA bucket and compile mid-traffic).
+        chunk, lanes = [], 0
+        for item in batch:
+            n = len(item[0][0])
+            if chunk and lanes + n > self.MAX_LANES:
+                self._flush_chunk(chunk)
+                chunk, lanes = [], 0
+            chunk.append(item)
+            lanes += n
+        if chunk:
+            self._flush_chunk(chunk)
+
+    def _flush_chunk(self, batch) -> None:
         try:
             # Overload backstop (see MAX_INFLIGHT): block on the oldest
             # unresolved dispatch only when the pipeline is pathologically
@@ -674,6 +789,21 @@ class V1Service:
     @property
     def advertise_address(self) -> str:
         return self.conf.advertise_address
+
+    @property
+    def serves_peer_columns(self) -> bool:
+        """Whether this daemon ADVERTISES the columnar peer encodings —
+        the single rule both transport edges consult (gRPC method
+        registration, gateway frame sniff), so mixed-version
+        negotiation can never diverge per transport.  False under the
+        GUBER_PEER_COLUMNS opt-out (the pre-columns interop mode) and
+        for stores without columnar support: those fall back to the
+        dataclass path capped at MAX_BATCH_SIZE, which would
+        hard-reject the PEER_COLUMNS_MAX_LANES-sized batches the
+        columns advertisement invites."""
+        return getattr(self.conf.behaviors, "peer_columns", True) and getattr(
+            self.store, "supports_columns", False
+        )
 
     def get_peer(self, key: str) -> PeerClient:
         """Owner peer for a key (gubernator.go:440-449)."""
@@ -811,53 +941,86 @@ class V1Service:
                             )
                         )
                 return None
+            grouped_mask = np.zeros(n, dtype=bool)
             if not single_owner and psize >= 1:
-                if pre is not None and not isinstance(hash_keys, list):
-                    # Picker routing indexes by emptiness; materialize
-                    # with "" for error lanes (rare multi-peer + native
-                    # edge combination).
-                    packed = hash_keys
-                    hash_keys = [
-                        "" if errc[i] else packed[i] for i in range(n)
+                # Vectorized ownership: one batch hash + searchsorted,
+                # then one mask pass PER DISTINCT OWNER (not per lane)
+                # — the ring hands back integer owner codes, so no
+                # per-lane Python objects are touched here.  Works on
+                # plain string lists and PackedKeys alike.
+                valid = fast | slow  # validation-error lanes: both False
+                all_valid = bool(valid.all())
+                if all_valid:
+                    keys_for_ring = hash_keys
+                elif isinstance(hash_keys, list):
+                    keys_for_ring = [
+                        hash_keys[int(i)] for i in np.nonzero(valid)[0]
                     ]
-                owners = self.local_picker.get_batch(
-                    [k for k in hash_keys if k]
+                else:  # PackedKeys (native edge / peer frame decode)
+                    keys_for_ring = hash_keys.subset(np.nonzero(valid)[0])
+                codes, code_ids = self.local_picker.get_batch_codes(
+                    keys_for_ring
                 )
-                it = iter(owners)
-                for i in range(n):
-                    if not hash_keys[i]:
+                if all_valid:
+                    lane_code = codes
+                else:
+                    lane_code = np.full(n, -1, dtype=np.int32)
+                    lane_code[valid] = codes
+                for c, pid in enumerate(code_ids):
+                    peer = self.local_picker.get_by_peer_id(pid)
+                    if peer is not None and peer.info.is_owner:
                         continue
-                    peer = self.local_picker.get_by_peer_id(next(it))
-                    if peer is None or not peer.info.is_owner:
-                        fast[i] = False
-                        if peer is not None and not slow[i]:
-                            # Plain remote lane: group-forward.  A None
-                            # peer (churn mid-resolve) stays on the
-                            # dataclass router, which re-picks.
+                    lanes = np.nonzero(lane_code == c)[0]
+                    if not lanes.size:
+                        continue
+                    fast[lanes] = False
+                    if peer is not None:
+                        # Plain remote lanes: group-forward.  A None
+                        # peer (churn mid-resolve) stays on the
+                        # dataclass router, which re-picks; GLOBAL
+                        # lanes keep the replica-cache path.
+                        plain = lanes[np.logical_not(slow[lanes])]
+                        if plain.size:
                             addr = peer.info.grpc_address
-                            remote_groups.setdefault(addr, []).append(i)
+                            remote_groups[addr] = plain
                             remote_peers[addr] = peer
-                        slow[i] = True
+                            grouped_mask[plain] = True
+                    slow[lanes] = True
 
         self._queue_mr_fast(cols, beh, fast, hash_keys)
         pendings = self._dispatch_fast(cols, beh, fast, hash_keys, result)
 
-        # Plain remote lanes: ONE forwarded GetPeerRateLimits per owner,
-        # dispatched in parallel while the local fast dispatch is in
-        # flight (the batch-sized analogue of the per-item forward,
-        # gubernator.go:195-210).
+        # Plain remote lanes: ONE forwarded columnar sub-batch per
+        # owner, submitted in parallel while the local fast dispatch is
+        # in flight (the batch-sized analogue of the per-item forward,
+        # gubernator.go:195-210).  The lanes travel as COLUMN subsets —
+        # no per-lane dataclasses — and concurrent ingress batches to
+        # the same owner coalesce in the PeerClient window.  A group
+        # containing any NO_BATCHING lane sends direct (window
+        # bypassed), preserving the per-request opt-out.
         group_futs = {}
-        grouped: set = set()
         for addr, idxs in remote_groups.items():
-            grouped.update(idxs)
-            reqs = [cols.request_at(int(i)) for i in idxs]
+            idx = np.asarray(idxs, dtype=np.int64)
+            sub = (
+                [cols.names[int(i)] for i in idxs],
+                [cols.unique_keys[int(i)] for i in idxs],
+                np.asarray(cols.algorithm[idx], dtype=np.int32),
+                np.asarray(beh[idx], dtype=np.int32),
+                np.asarray(cols.hits[idx], dtype=np.int64),
+                np.asarray(cols.limit[idx], dtype=np.int64),
+                np.asarray(cols.duration[idx], dtype=np.int64),
+            )
+            direct = bool((beh[idx] & int(Behavior.NO_BATCHING)).any())
             group_futs[addr] = self._forward_pool.submit(
-                self._forward_group, remote_peers[addr], reqs
+                self._forward_group_columns, remote_peers[addr], sub, direct
             )
 
         # Remaining slow lanes (GLOBAL remote/local specials) ride the
         # dataclass router.
-        slow_idx = [int(i) for i in np.nonzero(slow)[0] if int(i) not in grouped]
+        slow_idx = [
+            int(i)
+            for i in np.nonzero(np.logical_and(slow, ~grouped_mask))[0]
+        ]
         slow_reqs = [cols.request_at(i) for i in slow_idx]
         return _ColumnsPlan(
             pendings=pendings,
@@ -878,9 +1041,9 @@ class V1Service:
             for i, r in zip(plan.slow_idx, resps):
                 result.overrides[int(i)] = r
         for addr, fut in plan.group_futs.items():
-            resps = fut.result()
-            for i, r in zip(plan.remote_groups[addr], resps):
-                result.overrides[int(i)] = r
+            _merge_group_result(
+                result, plan.remote_groups[addr], addr, fut.result()
+            )
         self._resolve_fast(plan.pendings, plan.hash_keys, result)
         return result
 
@@ -1151,40 +1314,44 @@ class V1Service:
         except PeerError as e:
             return None, e
 
-    def _forward_group(
-        self, peer: PeerClient, reqs: List[RateLimitRequest]
-    ) -> List[RateLimitResponse]:
-        """Forward a whole owner-group in one GetPeerRateLimits RPC
-        (columnar ingress).  An owner with an open circuit breaker
+    def _forward_group_columns(self, peer: PeerClient, sub, direct: bool):
+        """Forward a whole owner-group as ONE columnar sub-batch
+        (riding the peer's coalescing window; `direct` bypasses it for
+        NO_BATCHING groups).  Fast outcome: ("cols", result, lo, hi) —
+        this group's slice of the shared decoded response arrays,
+        scattered zero-dataclass by _merge_group_result.  Failure legs
+        keep the dataclass route: an owner with an open circuit breaker
         degrades the whole group to local evaluation; a not-ready peer
         degrades to the per-item forward path, which owns the re-pick
         retry loop (gubernator.go:154-162); other failures convert per
         lane."""
         try:
-            resp = peer.get_peer_rate_limits(
-                GetRateLimitsRequest(requests=reqs),
-                timeout_s=self.conf.behaviors.batch_timeout_s,
+            if direct:
+                rc = peer.send_columns_direct(
+                    sub, timeout_s=self.conf.behaviors.batch_timeout_s
+                )
+                return ("cols", rc, 0, len(sub[0]))
+            fut = peer.forward_columns(sub)
+            rc, lo, hi = fut.result(
+                timeout=self.conf.behaviors.batch_timeout_s + 1.0
             )
-            # PeerClient raises on any response-length mismatch, so the
-            # zip below is always aligned.
-            out = list(resp.responses)
-            for r in out:
-                r.metadata = {"owner": peer.info.grpc_address}
-            return out
+            return ("cols", rc, lo, hi)
         except Exception as e:  # noqa: BLE001
             if is_circuit_open(e):
                 # The RPC never left this host (breaker fast-fail), so
                 # local evaluation cannot double-count.
-                return self._degrade_local(reqs, peer)
+                return self._degrade_local(_cols_to_requests(sub), peer)
             if is_not_ready(e):
-                return [self._forward_one(r, peer) for r in reqs]
+                return [
+                    self._forward_one(r, peer) for r in _cols_to_requests(sub)
+                ]
             return [
                 RateLimitResponse(
                     error=(
-                        f"while fetching rate limit '{r.hash_key()}' from peer - '{e}'"
+                        f"while fetching rate limit '{nm}_{uk}' from peer - '{e}'"
                     )
                 )
-                for r in reqs
+                for nm, uk in zip(sub[0], sub[1])
             ]
 
     def _degrade_local(
@@ -1317,18 +1484,25 @@ class V1Service:
                 self.multi_region_mgr.queue_hits(r)
         return GetRateLimitsResponse(responses=resps)
 
-    def get_peer_rate_limits_columns(self, cols: IngressColumns) -> ColumnarResult:
+    def get_peer_rate_limits_columns(
+        self, cols: IngressColumns, max_lanes: int = MAX_BATCH_SIZE
+    ) -> ColumnarResult:
         """Column-form PeersV1 receive path: every lane is owned HERE
         (the sender already routed), so non-GLOBAL lanes go straight to
         the columnar kernel via the shared coalescing window —
         concurrent peers' sub-batches merge into one device dispatch.
         GLOBAL lanes keep the dataclass path (owner-side dirty marking
-        for the broadcast pipeline, gubernator.go:339-341)."""
+        for the broadcast pipeline, gubernator.go:339-341).
+
+        `max_lanes` is the ingress-encoding cap: classic (per-request)
+        receives keep the reference's MAX_BATCH_SIZE; the columnar
+        frame/proto edges pass PEER_COLUMNS_MAX_LANES (a coalesced RPC
+        carries many ingress batches)."""
         n = len(cols)
-        if n > MAX_BATCH_SIZE:
+        if n > max_lanes:
             raise ApiError(
                 "OutOfRange",
-                f"'PeerRequest.rate_limits' list too large; max size is '{MAX_BATCH_SIZE}'",
+                f"'PeerRequest.rate_limits' list too large; max size is '{max_lanes}'",
             )
         result = ColumnarResult.empty(n)
         if n == 0:
@@ -1349,9 +1523,17 @@ class V1Service:
         beh = cols.behavior
         slow = (beh & int(Behavior.GLOBAL)) != 0
         fast = np.logical_not(slow)
-        hash_keys = [
-            f"{nm}_{uk}" for nm, uk in zip(cols.names, cols.unique_keys)
-        ]
+        # A frame-decoded batch (wire.FrameIngressColumns) hands the
+        # hash keys over PACKED — the sender's ingress already
+        # validated them, so no per-lane strings are built here; other
+        # ingress shapes (classic JSON/pb decode) build the list.
+        pre = getattr(cols, "prevalidated", None)
+        if pre is not None:
+            hash_keys, _errc = pre
+        else:
+            hash_keys = [
+                f"{nm}_{uk}" for nm, uk in zip(cols.names, cols.unique_keys)
+            ]
         # MULTI_REGION queueing covers EVERY lane here (the reference
         # queues after applying each forwarded request,
         # gubernator.go:340-341 via GetPeerRateLimits); pass an all-True
@@ -1518,16 +1700,17 @@ class V1Service:
         return True
 
     def get_peer_rate_limits_columns_async(
-        self, cols: IngressColumns, callback: "Callable"
+        self, cols: IngressColumns, callback: "Callable",
+        max_lanes: int = MAX_BATCH_SIZE,
     ) -> None:
         """Async twin of get_peer_rate_limits_columns (the owner-side
         receive of forwarded batches — the OTHER device-bound endpoint a
         native-edge worker must not block on)."""
         try:
-            if len(cols) > MAX_BATCH_SIZE:
+            if len(cols) > max_lanes:
                 raise ApiError(
                     "OutOfRange",
-                    f"'PeerRequest.rate_limits' list too large; max size is '{MAX_BATCH_SIZE}'",
+                    f"'PeerRequest.rate_limits' list too large; max size is '{max_lanes}'",
                 )
             n = len(cols)
             result = ColumnarResult.empty(n)

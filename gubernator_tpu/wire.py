@@ -9,9 +9,14 @@ gRPC boundary (gubernator.pb.go / peers.pb.go).
 
 from __future__ import annotations
 
-from typing import Iterable, List
+import json
+import struct
+from typing import Iterable, List, Sequence, Tuple
+
+import numpy as np
 
 from .proto import gubernator_pb2 as pb
+from .proto import peers_columns_pb2 as pc_pb
 from .proto import peers_pb2 as peers_pb
 from .types import (
     GetRateLimitsRequest,
@@ -21,6 +26,13 @@ from .types import (
     RateLimitResponse,
     UpdatePeerGlobal,
 )
+
+# A forwarded batch as parallel columns — the peer-hop currency shared
+# by PeerClient (send) and wire codecs (both transports):
+# (names, unique_keys, algorithm i32, behavior i32, hits i64, limit
+# i64, duration i64), all length n.
+PeerColumns = Tuple[Sequence[str], Sequence[str], np.ndarray, np.ndarray,
+                    np.ndarray, np.ndarray, np.ndarray]
 
 
 # ---- RateLimitReq ----------------------------------------------------
@@ -133,20 +145,26 @@ def _columns_to_resp_list(result):
     limit = result.limit
     remaining = result.remaining
     reset = result.reset_time
+    owner_of = getattr(result, "owner_of", None)
+    owner_addrs = getattr(result, "owner_addrs", None)
     out = []
     for i in range(result.n):
         r = ov.get(i)
         if r is not None:
             out.append(resp_to_pb(r))
         else:
-            out.append(
-                pb.RateLimitResp(
-                    status=int(status[i]),
-                    limit=int(limit[i]),
-                    remaining=int(remaining[i]),
-                    reset_time=int(reset[i]),
-                )
+            m = pb.RateLimitResp(
+                status=int(status[i]),
+                limit=int(limit[i]),
+                remaining=int(remaining[i]),
+                reset_time=int(reset[i]),
             )
+            if owner_of is not None and owner_of[i] >= 0:
+                # Forwarded lane: the owner's address rides metadata
+                # (gubernator.go:190,209 parity) without a per-lane
+                # dataclass on the fast path.
+                m.metadata["owner"] = owner_addrs[owner_of[i]]
+            out.append(m)
     return out
 
 
@@ -159,6 +177,456 @@ def columns_to_peer_pb(result) -> peers_pb.GetPeerRateLimitsResp:
     """PeersV1 twin of columns_to_pb (field name rate_limits,
     peers.proto:42-45)."""
     return peers_pb.GetPeerRateLimitsResp(rate_limits=_columns_to_resp_list(result))
+
+
+# ---- columnar peer hop (zero-dataclass forwarded path) ---------------
+#
+# Two encodings of the same PeerColumns batch (architecture.md
+# "Columnar pipeline: the peer hop"):
+#   * proto columns (peers_columns.proto) for the gRPC transport —
+#     served as PeersV1/GetPeerRateLimitsColumns; old peers answer
+#     UNIMPLEMENTED and the sender falls back to the classic
+#     per-request GetPeerRateLimits encoding.
+#   * a compact binary frame for the HTTP transport — POSTed to the
+#     SAME /v1/peer.GetPeerRateLimits path; the receiver sniffs the
+#     magic (JSON bodies can never start with it), old receivers
+#     answer 400 and the sender falls back to per-request JSON.
+#
+# Neither direction materializes a RateLimitRequest/RateLimitResponse
+# per lane: requests decode straight into service.IngressColumns,
+# responses into a service.ColumnarResult whose sparse overrides
+# (error/metadata lanes) are the only per-lane objects.
+
+FRAME_MAGIC = b"GUBC"
+FRAME_VERSION = 1
+_FRAME_KIND_REQ = 1
+_FRAME_KIND_RESP = 2
+COLUMNS_CONTENT_TYPE = "application/x-gubernator-columns"
+
+
+_FRAME_HEADER_LEN = 10  # magic(4) + version(1) + kind(1) + n(4)
+
+
+def is_columns_frame(raw: bytes) -> bool:
+    return len(raw) >= _FRAME_HEADER_LEN and raw[:4] == FRAME_MAGIC
+
+
+def _pack_str_column(strs: Sequence[str]) -> bytes:
+    """u32 blob_len | u32 offsets[n+1] | utf-8 blob (byte offsets)."""
+    parts = [s.encode("utf-8") for s in strs]
+    offsets = np.zeros(len(parts) + 1, dtype=np.uint32)
+    if parts:
+        np.cumsum([len(p) for p in parts], out=offsets[1:])
+    blob = b"".join(parts)
+    return struct.pack("<I", len(blob)) + offsets.tobytes() + blob
+
+
+def _read_array(raw: bytes, pos: int, dtype, n: int):
+    try:
+        arr = np.frombuffer(raw, dtype=dtype, count=n, offset=pos)
+    except ValueError:
+        raise ValueError("columns frame truncated") from None
+    return arr, pos + arr.nbytes
+
+
+def encode_columns_frame(cols: PeerColumns) -> bytes:
+    """PeerColumns -> binary request frame (see architecture.md for the
+    byte-level spec)."""
+    names, uks, algo, beh, hits, limit, duration = cols
+    n = len(names)
+    parts = [
+        FRAME_MAGIC,
+        struct.pack("<BBI", FRAME_VERSION, _FRAME_KIND_REQ, n),
+        _pack_str_column(names),
+        _pack_str_column(uks),
+        np.ascontiguousarray(algo, dtype=np.int32).tobytes(),
+        np.ascontiguousarray(beh, dtype=np.int32).tobytes(),
+        np.ascontiguousarray(hits, dtype=np.int64).tobytes(),
+        np.ascontiguousarray(limit, dtype=np.int64).tobytes(),
+        np.ascontiguousarray(duration, dtype=np.int64).tobytes(),
+    ]
+    return b"".join(parts)
+
+
+def _read_str_blob(raw: bytes, pos: int, n: int):
+    """(offsets u32[n+1], blob bytes, next_pos) — no string decode."""
+    try:
+        (blob_len,) = struct.unpack_from("<I", raw, pos)
+        pos += 4
+        offsets = np.frombuffer(raw, dtype=np.uint32, count=n + 1, offset=pos)
+    except (struct.error, ValueError):
+        raise ValueError("columns frame truncated") from None
+    pos += 4 * (n + 1)
+    blob = raw[pos:pos + blob_len]
+    if len(blob) != blob_len or (n and int(offsets[-1]) != blob_len):
+        raise ValueError("columns frame string column truncated")
+    if n and (
+        int(offsets[0]) != 0
+        or bool(np.any(np.diff(offsets.astype(np.int64)) < 0))
+    ):
+        # Non-monotonic offsets would later surface as negative lengths
+        # deep inside the service (a 500); reject at the decode edge
+        # where the caller maps it to a 400.
+        raise ValueError("columns frame string offsets invalid")
+    return offsets, blob, pos + blob_len
+
+
+def _packed_hash_keys(nb: bytes, no, ub: bytes, uo):
+    """Build the per-lane hash keys (name + "_" + unique_key) as a
+    native.PackedKeys with ONE vectorized byte scatter — the owner's
+    planner consumes packed keys directly, so the receive path never
+    materializes n Python strings."""
+    from .native import PackedKeys
+
+    no64 = no.astype(np.int64)
+    uo64 = uo.astype(np.int64)
+    nlen = np.diff(no64)
+    ulen = np.diff(uo64)
+    n = len(nlen)
+    out_off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(nlen + 1 + ulen, out=out_off[1:])
+    buf = np.empty(int(out_off[-1]), dtype=np.uint8)
+    nb_a = np.frombuffer(nb, dtype=np.uint8)
+    ub_a = np.frombuffer(ub, dtype=np.uint8)
+    if nb_a.size:
+        buf[
+            np.arange(nb_a.size, dtype=np.int64)
+            + np.repeat(out_off[:-1] - no64[:-1], nlen)
+        ] = nb_a
+    buf[out_off[:-1] + nlen] = ord("_")
+    if ub_a.size:
+        buf[
+            np.arange(ub_a.size, dtype=np.int64)
+            + np.repeat(out_off[:-1] + nlen + 1 - uo64[:-1], ulen)
+        ] = ub_a
+    return PackedKeys(buf, out_off)
+
+
+class FrameIngressColumns:
+    """service.IngressColumns twin decoded LAZILY from a binary frame:
+    numeric columns are zero-copy views of the frame buffer, hash keys
+    come packed (prevalidated — forwarded lanes were validated at the
+    sender's ingress, so the error column is all-zero), and
+    name/unique_key strings only materialize for the lanes that need
+    dataclasses (GLOBAL / MULTI_REGION / slow legs)."""
+
+    __slots__ = ("algorithm", "behavior", "hits", "limit", "duration",
+                 "_n", "_nb", "_no", "_ub", "_uo", "_names", "_uks")
+
+    def __init__(self, n, nb, no, ub, uo, algo, beh, hits, limit, duration):
+        self._n = n
+        self._nb, self._no = nb, no
+        self._ub, self._uo = ub, uo
+        self.algorithm = algo
+        self.behavior = beh
+        self.hits = hits
+        self.limit = limit
+        self.duration = duration
+        self._names = None
+        self._uks = None
+
+    def __len__(self) -> int:
+        return self._n
+
+    @property
+    def prevalidated(self):
+        return (
+            _packed_hash_keys(self._nb, self._no, self._ub, self._uo),
+            np.zeros(self._n, dtype=np.uint8),
+        )
+
+    def _name_at(self, i: int) -> str:
+        return self._nb[self._no[i]:self._no[i + 1]].decode("utf-8")
+
+    def _uk_at(self, i: int) -> str:
+        return self._ub[self._uo[i]:self._uo[i + 1]].decode("utf-8")
+
+    @property
+    def names(self):
+        if self._names is None:
+            self._names = [self._name_at(i) for i in range(self._n)]
+        return self._names
+
+    @property
+    def unique_keys(self):
+        if self._uks is None:
+            self._uks = [self._uk_at(i) for i in range(self._n)]
+        return self._uks
+
+    def request_at(self, i: int) -> RateLimitRequest:
+        return RateLimitRequest(
+            name=self._name_at(i),
+            unique_key=self._uk_at(i),
+            hits=int(self.hits[i]),
+            limit=int(self.limit[i]),
+            duration=int(self.duration[i]),
+            algorithm=int(self.algorithm[i]),
+            behavior=int(self.behavior[i]),
+        )
+
+
+def decode_columns_frame(raw: bytes):
+    """Binary request frame -> ingress columns (the receiver half of
+    the zero-dataclass peer hop).  With the native runtime present the
+    result is a lazy FrameIngressColumns (packed hash keys for the
+    planner, no per-lane strings); otherwise an eager
+    service.IngressColumns.  Raises ValueError on a malformed/foreign
+    frame."""
+    from . import native
+    from .service import IngressColumns
+
+    if not is_columns_frame(raw):
+        raise ValueError("not a columns frame")
+    version, kind, n = struct.unpack_from("<BBI", raw, 4)
+    if version != FRAME_VERSION or kind != _FRAME_KIND_REQ:
+        raise ValueError(
+            f"unsupported columns frame (version={version}, kind={kind})"
+        )
+    pos = 10
+    no, nb, pos = _read_str_blob(raw, pos, n)
+    uo, ub, pos = _read_str_blob(raw, pos, n)
+    algo, pos = _read_array(raw, pos, np.int32, n)
+    beh, pos = _read_array(raw, pos, np.int32, n)
+    hits, pos = _read_array(raw, pos, np.int64, n)
+    limit, pos = _read_array(raw, pos, np.int64, n)
+    duration, pos = _read_array(raw, pos, np.int64, n)
+    if pos != len(raw):
+        raise ValueError("columns frame length mismatch")
+    if native.available():
+        return FrameIngressColumns(
+            n, nb, no, ub, uo, algo, beh, hits, limit, duration
+        )
+    return IngressColumns(
+        names=[nb[no[i]:no[i + 1]].decode("utf-8") for i in range(n)],
+        unique_keys=[ub[uo[i]:uo[i + 1]].decode("utf-8") for i in range(n)],
+        algorithm=algo, behavior=beh,
+        hits=hits, limit=limit, duration=duration,
+    )
+
+
+def encode_result_frame(result) -> bytes:
+    """service.ColumnarResult -> binary response frame.  Plain lanes
+    ride the arrays; overrides (error/metadata lanes) ride as sparse
+    (lane, json) pairs — the only per-lane encode work."""
+    parts = [
+        FRAME_MAGIC,
+        struct.pack("<BBI", FRAME_VERSION, _FRAME_KIND_RESP, result.n),
+        np.ascontiguousarray(result.status, dtype=np.int32).tobytes(),
+        np.ascontiguousarray(result.limit, dtype=np.int64).tobytes(),
+        np.ascontiguousarray(result.remaining, dtype=np.int64).tobytes(),
+        np.ascontiguousarray(result.reset_time, dtype=np.int64).tobytes(),
+        struct.pack("<I", len(result.overrides)),
+    ]
+    for lane, resp in result.overrides.items():
+        body = json.dumps(resp.to_json(), separators=(",", ":")).encode("utf-8")
+        parts.append(struct.pack("<II", int(lane), len(body)))
+        parts.append(body)
+    return b"".join(parts)
+
+
+def decode_result_frame(raw: bytes):
+    """Binary response frame -> service.ColumnarResult (client side:
+    the sender scatters these arrays into its own result arrays)."""
+    from .service import ColumnarResult
+
+    if not is_columns_frame(raw):
+        raise ValueError("not a columns frame")
+    version, kind, n = struct.unpack_from("<BBI", raw, 4)
+    if version != FRAME_VERSION or kind != _FRAME_KIND_RESP:
+        raise ValueError(
+            f"unsupported columns frame (version={version}, kind={kind})"
+        )
+    pos = 10
+    status, pos = _read_array(raw, pos, np.int32, n)
+    limit, pos = _read_array(raw, pos, np.int64, n)
+    remaining, pos = _read_array(raw, pos, np.int64, n)
+    reset_time, pos = _read_array(raw, pos, np.int64, n)
+    try:
+        (n_ov,) = struct.unpack_from("<I", raw, pos)
+        pos += 4
+        overrides = {}
+        for _ in range(n_ov):
+            lane, blen = struct.unpack_from("<II", raw, pos)
+            pos += 8
+            if pos + blen > len(raw):
+                raise ValueError("columns frame truncated")
+            overrides[int(lane)] = RateLimitResponse.from_json(
+                json.loads(raw[pos:pos + blen])
+            )
+            pos += blen
+    except struct.error:
+        raise ValueError("columns frame truncated") from None
+    if pos != len(raw):
+        raise ValueError("columns frame length mismatch")
+    return ColumnarResult(
+        n=n, status=status, limit=limit, remaining=remaining,
+        reset_time=reset_time, overrides=overrides,
+    )
+
+
+# -- proto columns (gRPC transport) ------------------------------------
+def peer_columns_req_to_pb(cols: PeerColumns) -> pc_pb.PeerColumnsReq:
+    names, uks, algo, beh, hits, limit, duration = cols
+    m = pc_pb.PeerColumnsReq()
+    m.names.extend(names)
+    m.unique_keys.extend(uks)
+    m.algorithm.extend(np.asarray(algo, dtype=np.int32).tolist())
+    m.behavior.extend(np.asarray(beh, dtype=np.int32).tolist())
+    m.hits.extend(np.asarray(hits, dtype=np.int64).tolist())
+    m.limit.extend(np.asarray(limit, dtype=np.int64).tolist())
+    m.duration.extend(np.asarray(duration, dtype=np.int64).tolist())
+    return m
+
+
+def ingress_from_peer_columns_pb(m: pc_pb.PeerColumnsReq):
+    from .service import IngressColumns
+
+    n = len(m.names)
+    return IngressColumns(
+        names=list(m.names),
+        unique_keys=list(m.unique_keys),
+        algorithm=np.fromiter(m.algorithm, np.int32, count=n),
+        behavior=np.fromiter(m.behavior, np.int32, count=n),
+        hits=np.fromiter(m.hits, np.int64, count=n),
+        limit=np.fromiter(m.limit, np.int64, count=n),
+        duration=np.fromiter(m.duration, np.int64, count=n),
+    )
+
+
+def result_to_peer_columns_pb(result) -> pc_pb.PeerColumnsResp:
+    m = pc_pb.PeerColumnsResp()
+    m.status.extend(np.asarray(result.status, dtype=np.int32).tolist())
+    m.limit.extend(np.asarray(result.limit, dtype=np.int64).tolist())
+    m.remaining.extend(np.asarray(result.remaining, dtype=np.int64).tolist())
+    m.reset_time.extend(np.asarray(result.reset_time, dtype=np.int64).tolist())
+    for lane, resp in result.overrides.items():
+        ov = m.overrides.add()
+        ov.lane = int(lane)
+        ov.resp.CopyFrom(resp_to_pb(resp))
+    return m
+
+
+def result_from_peer_columns_pb(m: pc_pb.PeerColumnsResp):
+    from .service import ColumnarResult
+
+    n = len(m.status)
+    return ColumnarResult(
+        n=n,
+        status=np.fromiter(m.status, np.int32, count=n),
+        limit=np.fromiter(m.limit, np.int64, count=n),
+        remaining=np.fromiter(m.remaining, np.int64, count=n),
+        reset_time=np.fromiter(m.reset_time, np.int64, count=n),
+        overrides={int(o.lane): resp_from_pb(o.resp) for o in m.overrides},
+    )
+
+
+def peer_columns_slice(cols: PeerColumns, lo: int, hi: int) -> PeerColumns:
+    """Lane slice of a PeerColumns batch (the classic-downgrade resend
+    must re-chunk an oversized columnar chunk to MAX_BATCH_SIZE)."""
+    names, uks, algo, beh, hits, limit, duration = cols
+    return (
+        names[lo:hi], uks[lo:hi], algo[lo:hi], beh[lo:hi],
+        hits[lo:hi], limit[lo:hi], duration[lo:hi],
+    )
+
+
+def concat_results(parts):
+    """Concatenate ColumnarResults lane-wise (the inverse of
+    peer_columns_slice for the classic-downgrade resend)."""
+    from .service import ColumnarResult
+
+    if len(parts) == 1:
+        return parts[0]
+    out = ColumnarResult.empty(sum(p.n for p in parts))
+    lo = 0
+    for p in parts:
+        sl = slice(lo, lo + p.n)
+        out.status[sl] = p.status
+        out.limit[sl] = p.limit
+        out.remaining[sl] = p.remaining
+        out.reset_time[sl] = p.reset_time
+        for lane, r in p.overrides.items():
+            out.overrides[lo + int(lane)] = r
+        lo += p.n
+    return out
+
+
+# -- classic fallback, built from columns ------------------------------
+# The mixed-version slow lane: a peer that doesn't speak columns still
+# receives a correct classic batch.  Per-lane pb/JSON objects are built
+# here (the wire format demands them), but still no dataclasses.
+def peer_columns_to_classic_pb(cols: PeerColumns) -> peers_pb.GetPeerRateLimitsReq:
+    names, uks, algo, beh, hits, limit, duration = cols
+    return peers_pb.GetPeerRateLimitsReq(
+        requests=[
+            pb.RateLimitReq(
+                name=names[i], unique_key=uks[i], hits=int(hits[i]),
+                limit=int(limit[i]), duration=int(duration[i]),
+                algorithm=int(algo[i]), behavior=int(beh[i]),
+            )
+            for i in range(len(names))
+        ]
+    )
+
+
+def result_from_classic_peer_pb(m: peers_pb.GetPeerRateLimitsResp):
+    """Classic per-request response -> ColumnarResult: plain lanes fill
+    the arrays, error/metadata lanes become overrides."""
+    from .service import ColumnarResult
+
+    items = m.rate_limits
+    n = len(items)
+    result = ColumnarResult.empty(n)
+    for i, r in enumerate(items):
+        if r.error or r.metadata:
+            result.overrides[i] = resp_from_pb(r)
+        else:
+            result.status[i] = r.status
+            result.limit[i] = r.limit
+            result.remaining[i] = r.remaining
+            result.reset_time[i] = r.reset_time
+    return result
+
+
+def peer_columns_to_classic_json(cols: PeerColumns) -> dict:
+    names, uks, algo, beh, hits, limit, duration = cols
+    from .types import Algorithm
+
+    return {
+        "requests": [
+            {
+                "name": names[i],
+                "uniqueKey": uks[i],
+                "hits": str(int(hits[i])),
+                "limit": str(int(limit[i])),
+                "duration": str(int(duration[i])),
+                "algorithm": Algorithm(int(algo[i])).name,
+                "behavior": int(beh[i]),
+            }
+            for i in range(len(names))
+        ]
+    }
+
+
+def result_from_classic_peer_json(body: dict):
+    """Classic {"rateLimits": [...]} JSON response -> ColumnarResult."""
+    from .service import ColumnarResult
+    from .types import Status, _parse_enum
+
+    items = body.get("rateLimits", [])
+    n = len(items)
+    result = ColumnarResult.empty(n)
+    for i, d in enumerate(items):
+        if d.get("error") or d.get("metadata"):
+            result.overrides[i] = RateLimitResponse.from_json(d)
+        else:
+            result.status[i] = int(_parse_enum(d.get("status", 0), Status))
+            result.limit[i] = int(d.get("limit", 0))
+            result.remaining[i] = int(d.get("remaining", 0))
+            result.reset_time[i] = int(
+                d.get("resetTime", d.get("reset_time", 0))
+            )
+    return result
 
 
 # ---- GLOBAL broadcast ------------------------------------------------

@@ -9,6 +9,10 @@ cd "$(dirname "$0")/../gubernator_tpu/proto"
 
 protoc --python_out=. gubernator.proto peers.proto etcd_kv.proto etcd_rpc.proto
 
+# peers_columns.proto has no protoc dependency: its pb2 is generated
+# programmatically (the build image ships no protoc).  Keep it in sync:
+python ../../scripts/gen_columns_proto.py
+
 # protoc emits an absolute sibling import; rewrite it for package use.
 sed -i 's/^import gubernator_pb2 as gubernator__pb2$/from gubernator_tpu.proto import gubernator_pb2 as gubernator__pb2/' peers_pb2.py
 sed -i 's/^import etcd_kv_pb2 as etcd__kv__pb2$/from gubernator_tpu.proto import etcd_kv_pb2 as etcd__kv__pb2/' etcd_rpc_pb2.py

@@ -237,12 +237,19 @@ def test_grpc_peer_transport_used(cluster):
 
 
 def _peer_rpc_count(daemon) -> float:
+    # Either PeersV1 data-plane method counts: columnar-speaking peers
+    # forward via GetPeerRateLimitsColumns, classic peers via
+    # GetPeerRateLimits (wire.py "columnar peer hop").
+    total = 0.0
     for metric in daemon.service.metrics.registry.collect():
         if metric.name == "gubernator_grpc_request_counts":
             for s in metric.samples:
-                if s.labels.get("method") == "/pb.gubernator.PeersV1/GetPeerRateLimits":
-                    return s.value
-    return 0.0
+                if s.labels.get("method") in (
+                    "/pb.gubernator.PeersV1/GetPeerRateLimits",
+                    "/pb.gubernator.PeersV1/GetPeerRateLimitsColumns",
+                ):
+                    total += s.value
+    return total
 
 
 def test_max_conn_age_option(monkeypatch):

@@ -158,7 +158,13 @@ def test_two_node_tls_cluster_peer_forwarding(certs):
         assert rl.error == "" and rl.remaining == 9
         oc = V1Client(d2.peer_info.http_address, tls_context=ctx)
         metrics = oc.metrics_text()
-        assert 'method="/pb.gubernator.PeersV1/GetPeerRateLimits"' in metrics
+        # Either PeersV1 data-plane method proves the forward crossed
+        # the TLS peer leg (columnar peers use GetPeerRateLimitsColumns,
+        # classic peers GetPeerRateLimits — wire.py "columnar peer hop").
+        assert (
+            'method="/pb.gubernator.PeersV1/GetPeerRateLimitsColumns"' in metrics
+            or 'method="/pb.gubernator.PeersV1/GetPeerRateLimits"' in metrics
+        )
     finally:
         d1.close()
         d2.close()

@@ -9,6 +9,7 @@ varints little-endian base-128.
 from gubernator_tpu.proto import etcd_kv_pb2 as kvpb
 from gubernator_tpu.proto import etcd_rpc_pb2 as etcd_rpc
 from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.proto import peers_columns_pb2 as pc_pb
 from gubernator_tpu.proto import peers_pb2 as peers_pb
 
 
@@ -73,6 +74,48 @@ def test_update_peer_globals_golden():
             0x0A, 0x01, ord("k"),    # 1: key
             0x12, 0x02, 0x18, 0x03,  # 2: status {remaining: 3}
             0x18, 0x01,              # 3: algorithm
+        ]
+    )
+
+
+def test_peer_columns_req_golden():
+    """peers_columns.proto: column arrays, proto3-packed numerics.
+    The descriptor is built without protoc (scripts/gen_columns_proto),
+    so these bytes pin that the hand-built schema encodes exactly what
+    protoc would."""
+    m = pc_pb.PeerColumnsReq(
+        names=["a"], unique_keys=["b"], algorithm=[1], behavior=[2],
+        hits=[3], limit=[4], duration=[5],
+    )
+    assert m.SerializeToString() == bytes(
+        [
+            0x0A, 0x01, ord("a"),  # 1: names[0]
+            0x12, 0x01, ord("b"),  # 2: unique_keys[0]
+            0x1A, 0x01, 0x01,      # 3: algorithm, packed
+            0x22, 0x01, 0x02,      # 4: behavior, packed
+            0x2A, 0x01, 0x03,      # 5: hits, packed
+            0x32, 0x01, 0x04,      # 6: limit, packed
+            0x3A, 0x01, 0x05,      # 7: duration, packed
+        ]
+    )
+
+
+def test_peer_columns_resp_golden():
+    m = pc_pb.PeerColumnsResp(
+        status=[1], limit=[10], remaining=[9], reset_time=[1000],
+    )
+    ov = m.overrides.add()
+    ov.lane = 0  # proto3 default: omitted on the wire
+    ov.resp.CopyFrom(pb.RateLimitResp(error="x"))
+    assert m.SerializeToString() == bytes(
+        [
+            0x0A, 0x01, 0x01,        # 1: status, packed
+            0x12, 0x01, 0x0A,        # 2: limit, packed
+            0x1A, 0x01, 0x09,        # 3: remaining, packed
+            0x22, 0x02, 0xE8, 0x07,  # 4: reset_time = 1000, packed
+            # 5: overrides[0] {resp: {error: "x"}}
+            0x2A, 0x05,
+            0x12, 0x03, 0x2A, 0x01, ord("x"),
         ]
     )
 
