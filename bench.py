@@ -11,12 +11,15 @@ mix (hot keys + long tail, mirroring BASELINE.json config 2).  The
 dataclass path (`apply`, what the HTTP daemon uses per request today)
 is measured too and reported inside the extra fields.
 
-`--gate` runs ONLY the tunnel-independent device rows (the stable
-numbers: device_batch_us / device_us_b1024, measured by differential
-in-jit chaining so RTT cancels) and FAILS (exit 1) when either
-regresses >1.5x against benchmarks/gate_thresholds.json — the failing
-regression gate the round-3 verdict asked for.  Best-of-N sampling
-keeps tunnel weather out of the verdict.
+`--gate` evaluates the stable rows — the device kernels (differential
+in-jit chaining so RTT cancels), the dispatch_overlap_ratio (how much
+of the dispatch path's fixed cost the overlapped pipeline hides behind
+device compute, a same-run ratio so device weather cancels), and the
+service/peer throughput floors — against
+benchmarks/gate_thresholds.json, with NOISE-ADJUSTED verdicts
+(gate_verdict) so timer noise yields "inconclusive", never a flipped
+verdict.  Exit 1 on regression; wired into `make bench` /
+`make bench-gate`.
 """
 
 import json
@@ -348,6 +351,93 @@ def measure_device_zipf(jax, now, samples: int = 5):
     }
 
 
+def measure_dispatch_pipeline(jax, now, samples: int = 5, fuse: int = 4):
+    """dispatch_batch_us_incl_tunnel: per-batch cost of the dispatch
+    path AS THE OVERLAPPED PIPELINE LAUNCHES IT — the single-buffer
+    packed dict wire (what _stage_columns uploads), launched in fused
+    groups of `fuse` when the gate is backlogged
+    (ColumnarPipeline._launch_group), enqueued back-to-back with
+    donated state and synced once.  The fixed per-dispatch cost (on a
+    tunnel device, a full RPC enqueue per program) amortizes over the
+    group, so this row approaches device_batch_us as the pipeline
+    hides host dispatch overhead — which is exactly what
+    dispatch_overlap_ratio = device_batch_us / THIS gates.
+
+    (Through round 5 this row measured one 11-array RequestBatch32
+    program per batch with no amortization: 9.5ms against 4.4ms of
+    compute, i.e. the dispatch path cost 2.2x the chip time.  The
+    pipeline exists to hide that; the row now measures the path it
+    actually takes.)  Also returns the solo (unfused) per-dispatch
+    cost for continuity."""
+    from gubernator_tpu.models.shard import make_columns
+    from gubernator_tpu.ops import buckets
+
+    dev_capacity = 262_144
+    dev_batch = 131_072
+    state = buckets.init_state(dev_capacity)
+    slot = np.arange(dev_batch, dtype=np.int32)
+    cols = make_columns(
+        (slot % 2).astype(np.int32), np.zeros(dev_batch, np.int32),
+        np.ones(dev_batch, np.int64), np.full(dev_batch, 1 << 30, np.int64),
+        np.full(dev_batch, 3_600_000, np.int64), dev_batch,
+    )
+    cfg_idx, table = buckets.build_config_dict(cols, now)
+
+    def wire_for(exists):
+        return buckets.pack_dict_wire(
+            slot[None, :],
+            np.full((1, dev_batch), exists, dtype=bool),
+            np.ones((1, dev_batch), dtype=bool),
+            cfg_idx[None, :].astype(np.uint8),
+            np.zeros((1, dev_batch), np.int32),
+            np.zeros((1, dev_batch), np.int32),
+            table,
+        )[0]
+
+    def sync(arr):
+        return np.asarray(arr[:1, :1] if arr.ndim == 2 else arr[:1, :1, :1])
+
+    create_w = jax.device_put(wire_for(False))
+    state, packed = buckets.apply_rounds_packed_jit(state, create_w, 1, now)
+    sync(packed)  # warmup: compile + create buckets + honest mode
+
+    steady = wire_for(True)
+    # donate_wires=False: the measurement reuses the same uploaded
+    # wires every call (production uploads fresh ones and donates).
+    fn = buckets.fused_packed_jit(fuse, wide=False, donate_wires=False)
+    wires = [jax.device_put(steady) for _ in range(fuse)]
+    nr = np.ones(fuse, np.int32)
+    nowv = np.full(fuse, now, np.int64)
+    state, stacked = fn(state, *wires, nr, nowv)
+    sync(stacked)  # compile + drain
+    calls, fused_us = 6, float("inf")
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            state, stacked = fn(state, *wires, nr, nowv)
+        sync(stacked)
+        dt = time.perf_counter() - t0
+        fused_us = min(fused_us, dt / (calls * fuse) * 1e6)
+
+    solo_w = jax.device_put(steady)
+    state, packed = buckets.apply_rounds_packed_jit(state, solo_w, 1, now)
+    sync(packed)
+    solo_us = float("inf")
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        for _ in range(calls * fuse):
+            state, packed = buckets.apply_rounds_packed_jit(
+                state, solo_w, 1, now
+            )
+        sync(packed)
+        solo_us = min(solo_us, (time.perf_counter() - t0) / (calls * fuse) * 1e6)
+    return {
+        "dispatch_batch_us": fused_us,
+        "dispatch_solo_batch_us": solo_us,
+        "dispatch_fuse": fuse,
+    }
+
+
 def measure_service_ingress(n_threads: int = 32, svc_iters: int = 10,
                             n_keys: int = 100_000):
     """The full V1Service request path (validation, ownership routing,
@@ -573,10 +663,16 @@ def _save_device_rows(dev, extra=None) -> None:
         "device_batch_us": dev["device_batch_us"],
         "device_us_b1024": dev["small_batch_us"][1024][0],
         "device_us_b256": dev["small_batch_us"][256][0],
-        # Below-floor rows are excluded from gating: their point
-        # estimate is tunnel noise, not chip cost.
         "below_floor": {
             f"device_us_b{sb}": dev["small_batch_us"][sb][2]
+            for sb in (256, 1024)
+        },
+        # Per-row measurement noise (us): the gate evaluates
+        # NOISE-ADJUSTED bounds, so a small-batch row whose point
+        # estimate is timer noise still yields a trustworthy verdict
+        # (value+noise under the limit = PASS) instead of a skip.
+        "noise": {
+            f"device_us_b{sb}": dev["small_batch_us"][sb][3]
             for sb in (256, 1024)
         },
     }
@@ -586,44 +682,75 @@ def _save_device_rows(dev, extra=None) -> None:
         json.dump(rows, f)
 
 
+def gate_verdict(value: float, spec: dict, noise: float = 0.0):
+    """Noise-adjusted gate verdict for one row: ("PASS"|"FAIL"|"SKIP",
+    limit).  fail_above rows pass when even value+noise is under the
+    limit and fail when even value-noise exceeds it; a noise band
+    straddling the limit is inconclusive (SKIP) — so timer noise can
+    never flip a verdict, which is what makes the row trustworthy
+    (round-5's b256 fired below_floor on noise_us 77 vs value 4.7;
+    4.7+77 is still far under the 250 limit, a clean PASS)."""
+    if "fail_above_us" in spec:
+        limit = spec["fail_above_us"]
+        if value + noise <= limit:
+            return "PASS", limit
+        if value - noise > limit:
+            return "FAIL", limit
+        return "SKIP", limit
+    limit = spec["fail_below"]
+    if value - noise >= limit:
+        return "PASS", limit
+    if value + noise < limit:
+        return "FAIL", limit
+    return "SKIP", limit
+
+
 def gate() -> int:
     """Failing regression gate on the stable device rows.
 
-    Evaluates device_batch_us (131k batch) and device_us_b1024 against
-    their pinned thresholds — 1.5x the best number recorded when the
-    threshold file was last updated; best-of-N differential chaining
-    keeps tunnel weather out of the verdict.  Reuses the rows a
+    Evaluates device_batch_us (131k batch), the small-batch rows, the
+    dispatch_overlap_ratio (device_batch_us /
+    dispatch_batch_us_incl_tunnel — how much of the dispatch path's
+    cost the overlapped pipeline hides behind device compute), and the
+    ingress/peer-forward throughput rows, against pinned thresholds.
+    Verdicts are NOISE-ADJUSTED (gate_verdict): a noise band straddling
+    the limit is inconclusive, never a flip.  Reuses the rows a
     bench-main run just measured (benchmarks/last_device_rows.json,
     <1h old) instead of re-measuring; measures fresh otherwise.  Exit
-    0 pass / 1 fail, wired into `make bench`.
+    0 pass / 1 fail, wired into `make bench` / `make bench-gate`.
     """
     with open(GATE_THRESHOLDS) as f:
         thresholds = json.load(f)
     rows = None
-    below_floor = {}
+    noise = {}
     try:
         with open(LAST_DEVICE_ROWS) as f:
             saved = json.load(f)
         if time.time() - saved["time"] < 3600:
-            below_floor = saved.get("below_floor", {})
-            rows = {
-                k: saved[k]
-                for k in thresholds
-                if k in saved and not below_floor.get(k, False)
-            }
+            noise = saved.get("noise", {})
+            rows = {k: saved[k] for k in thresholds if k in saved}
             print(f"gate: using rows from {LAST_DEVICE_ROWS}")
     except (OSError, KeyError, ValueError):
         pass
     if rows is None:
         jax = _jax_setup()
         dev = measure_device(jax, 1_700_000_000_000, samples=6)
-        ingress_cps, _, _ = measure_service_ingress()
+        disp = measure_dispatch_pipeline(jax, 1_700_000_000_000)
         rows = {
             "device_batch_us": dev["device_batch_us"],
             "device_us_b1024": dev["small_batch_us"][1024][0],
             "device_us_b256": dev["small_batch_us"][256][0],
-            "service_ingress_checks_per_sec": ingress_cps,
+            "dispatch_overlap_ratio": dev["device_batch_us"]
+            / max(disp["dispatch_batch_us"], 1e-9),
         }
+        try:
+            # Daemon-spawning rows measure separately-guarded: host
+            # weather (a corrupt compile cache, OOM) must cost a SKIP,
+            # not the whole verdict.
+            ingress_cps, _, _ = measure_service_ingress()
+            rows["service_ingress_checks_per_sec"] = ingress_cps
+        except Exception as e:  # noqa: BLE001
+            print(f"gate service_ingress_checks_per_sec: SKIP (measure failed: {e})")
         try:
             cols_cps = measure_peer_forward("columns")
             classic_cps = measure_peer_forward("classic")
@@ -634,30 +761,24 @@ def gate() -> int:
             rows["peer_forward_vs_classic"] = cols_cps / max(classic_cps, 1.0)
         except Exception as e:  # noqa: BLE001 — two-daemon spawn can fail
             print(f"gate peer_forward_checks_per_sec: SKIP (measure failed: {e})")
-        below_floor = {
-            f"device_us_b{sb}": dev["small_batch_us"][sb][2]
+        noise = {
+            f"device_us_b{sb}": dev["small_batch_us"][sb][3]
             for sb in (256, 1024)
         }
-        rows = {k: v for k, v in rows.items() if not below_floor.get(k, False)}
     failed = []
     for name, spec in thresholds.items():
         if name.startswith("_"):
             continue  # metadata keys (_comment, _updated)
         value = rows.get(name)
         if value is None:
-            why = ("below measurement floor"
-                   if below_floor.get(name) else "no fresh measurement")
-            print(f"gate {name}: SKIP ({why})")
+            print(f"gate {name}: SKIP (no fresh measurement)")
             continue
-        if "fail_above_us" in spec:
-            limit, ok = spec["fail_above_us"], value <= spec["fail_above_us"]
-            print(f"gate {name}: {value:.1f} us (fail above {limit:.1f}) "
-                  f"{'PASS' if ok else 'FAIL'}")
-        else:
-            limit, ok = spec["fail_below"], value >= spec["fail_below"]
-            print(f"gate {name}: {value:.1f} (fail below {limit:.1f}) "
-                  f"{'PASS' if ok else 'FAIL'}")
-        if not ok:
+        verdict, limit = gate_verdict(value, spec, noise.get(name, 0.0))
+        bound = "fail above" if "fail_above_us" in spec else "fail below"
+        n_txt = f" +-{noise[name]:.1f} noise" if noise.get(name) else ""
+        print(f"gate {name}: {value:.2f}{n_txt} ({bound} {limit:.2f}) {verdict}"
+              + (" (noise straddles the limit)" if verdict == "SKIP" else ""))
+        if verdict == "FAIL":
             failed.append(name)
     if failed:
         print(f"gate: REGRESSION in {failed} (see {GATE_THRESHOLDS})")
@@ -683,9 +804,12 @@ def main():
     pick_hot = rng.random(batch_size) < 0.8
     key_ids = np.where(pick_hot, hot, cold)
 
-    # ---- headline: pipelined columnar bulk path ----------------------
-    # apply_columns_async overlaps host planning + H2D of batch i+1 with
-    # device compute + D2H of batch i (depth-1 double buffering); values
+    # ---- headline: overlapped columnar dispatch pipeline -------------
+    # Two dispatcher threads ride apply_columns_async's three-stage
+    # pipeline: thread B's PREPARE (C++ plan, GIL released) overlaps
+    # thread A's fetch/commit, the launch stage fuses same-shape staged
+    # batches under backlog, and the launch-time async-copy request
+    # overlaps each readback with the next batch's host work.  Values
     # fit int32 so the narrow wire halves bytes both ways.
     store = ShardStore(capacity=300_000)
     keys = [f"bench_account:{k}" for k in key_ids]
@@ -702,23 +826,49 @@ def main():
 
     dispatch(0).result()  # warmup: compile + table fill
     dispatch(1).result()
+
+    import threading as _threading
+
+    n_disp, iters = 2, 4
+
+    def disp_worker(base):
+        from collections import deque as _dq
+
+        pending = _dq()
+        for i in range(iters):
+            pending.append(dispatch(base + i))
+            if len(pending) >= 2:
+                pending.popleft().result()
+        while pending:
+            pending.popleft().result()
+
+    def disp_epoch(base):
+        ts = [
+            _threading.Thread(target=disp_worker, args=(base + t * iters,))
+            for t in range(n_disp)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+    disp_epoch(2)  # warm the fused-launch programs this shape fuses into
     # Best of 3 epochs: the remote-device tunnel's throughput swings
     # ~2x between runs; the fastest epoch is the least-contended view
     # of the software's own cost.
-    iters, columnar_cps = 8, 0.0
-    step = 2
+    columnar_cps, step = 0.0, 2 + n_disp * iters
+    store.take_pipeline_stats()  # reset the depth high-water mark
     for _ in range(3):
         t0 = time.perf_counter()
-        pending = None
-        for i in range(iters):
-            h = dispatch(step + i)
-            if pending is not None:
-                pending.result()
-            pending = h
-        pending.result()
+        disp_epoch(step)
         dt = time.perf_counter() - t0
-        step += iters
-        columnar_cps = max(columnar_cps, batch_size * iters / dt)
+        step += n_disp * iters
+        columnar_cps = max(columnar_cps, batch_size * iters * n_disp / dt)
+    stage_stats, _, pipeline_depth_hwm = store.take_pipeline_stats()
+    pipeline_stage_ms = {
+        stage: round(total / max(count, 1) * 1000.0, 3)
+        for stage, (count, total, _mx) in stage_stats.items()
+    }
 
     # Sequential (non-pipelined) dispatch -> own-result round trips:
     # the latency one batch actually experiences.  Median of a few
@@ -733,14 +883,23 @@ def main():
 
     # ---- device-only kernel timing -----------------------------------
     dev = measure_device(jax, now)
-    _save_device_rows(dev)
-    zipf = measure_device_zipf(jax, now)
+    disp = measure_dispatch_pipeline(jax, now)
     device_batch_us = dev["device_batch_us"]
     device_cps = dev["device_cps"]
-    dispatch_batch_us = dev["dispatch_batch_us"]
     small_batch_us = dev["small_batch_us"]
     dispatch_p50 = dev["dispatch_p50"]
     dispatch_p99 = dev["dispatch_p99"]
+    # The dispatch row the pipeline actually pays per batch (staged
+    # packed wire, fused launch) vs the chip's own time: host dispatch
+    # cost is hidden when this ratio approaches 1.
+    dispatch_batch_us = disp["dispatch_batch_us"]
+    dispatch_overlap_ratio = device_batch_us / max(dispatch_batch_us, 1e-9)
+    # Save the device + overlap rows NOW: the service/peer measurements
+    # below spawn daemons and can die to host weather (a corrupt
+    # compile cache, OOM on a loaded box) — a crash there must not
+    # cost the gate its stable same-run rows.
+    _save_device_rows(dev, {"dispatch_overlap_ratio": dispatch_overlap_ratio})
+    zipf = measure_device_zipf(jax, now)
 
     # ---- service-tier columnar ingress -------------------------------
     service_cps, svc_p50, svc_p99 = measure_service_ingress()
@@ -758,6 +917,7 @@ def main():
         "peer_forward_vs_classic": (
             peer_forward_cps / max(peer_forward_classic_cps, 1.0)
         ),
+        "dispatch_overlap_ratio": dispatch_overlap_ratio,
     })
 
     # ---- secondary: request-object path ------------------------------
@@ -816,6 +976,14 @@ def main():
                 "device_zipf_write_fraction": round(zipf["zipf_write_fraction"], 4),
                 "device_zipf_n_rounds": zipf["zipf_n_rounds"],
                 "dispatch_batch_us_incl_tunnel": round(dispatch_batch_us, 1),
+                "dispatch_overlap_ratio": round(dispatch_overlap_ratio, 3),
+                "dispatch_solo_batch_us": round(
+                    disp["dispatch_solo_batch_us"], 1
+                ),
+                "dispatch_fuse": disp["dispatch_fuse"],
+                "dispatch_batch32_us": round(dev["dispatch_batch_us"], 1),
+                "dispatch_pipeline_depth_hwm": pipeline_depth_hwm,
+                "pipeline_stage_ms_mean": pipeline_stage_ms,
                 "device_us_b256": round(small_batch_us[256][0], 1),
                 "device_us_b256_worst": round(small_batch_us[256][1], 1),
                 "device_us_b256_below_floor": small_batch_us[256][2],

@@ -41,6 +41,20 @@ class BehaviorConfig:
     batch_timeout_s: float = 0.5
     batch_wait_s: float = 0.0005
     batch_limit: int = 1000
+    # Bounded ingress queue (lanes): the LocalBatcher/ColumnarBatcher
+    # coalescing windows admit at most this many queued LANES (a
+    # multi-item columnar submission counts every lane).  A submission
+    # that would exceed the cap is SHED with a 429-style
+    # ResourceExhausted error (NOT an OVER_LIMIT status — that is an
+    # answer about the client's limit, not about daemon overload) and
+    # counted in gubernator_ingress_shed_total.  Rationale: the queue
+    # was unbounded through BENCH_r05, where an ingress storm stretched
+    # service p99 to 4.5s — every queued caller pays the backlog, so
+    # past the point where queued work exceeds any useful deadline,
+    # shedding is strictly kinder than queueing.  The default admits
+    # ~4 full device dispatch ceilings (4 x 64k lanes); 0 disables the
+    # bound.  Env: GUBER_INGRESS_QUEUE_LANES.
+    ingress_queue_lanes: int = 262_144
     # Columnar peer hop (wire.py "columnar peer hop"): forwarded batches
     # travel as column arrays (proto columns on gRPC, the binary frame
     # on HTTP) and are served from the columnar receive path.  False
@@ -347,6 +361,9 @@ def setup_daemon_config(
     b.batch_limit = _env_int(merged, "GUBER_BATCH_LIMIT", b.batch_limit)
     if b.batch_limit > MAX_BATCH_SIZE:
         raise ValueError(f"GUBER_BATCH_LIMIT cannot exceed '{MAX_BATCH_SIZE}'")
+    b.ingress_queue_lanes = _env_int(
+        merged, "GUBER_INGRESS_QUEUE_LANES", b.ingress_queue_lanes
+    )
     b.peer_columns = _env_bool(merged, "GUBER_PEER_COLUMNS", b.peer_columns)
     b.global_timeout_s = _env_float_ms(merged, "GUBER_GLOBAL_TIMEOUT", b.global_timeout_s)
     b.global_sync_wait_s = _env_float_ms(

@@ -224,6 +224,7 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes):
                 # cache.go:205-218) and the per-peer circuit-breaker
                 # state gauges from the live PeerClients.
                 service.metrics.observe_cache(service.store)
+                service.metrics.observe_dispatch(service.store)
                 service.metrics.observe_peers(
                     service.get_peer_list()
                     + list(service.get_region_picker().peers())

@@ -86,6 +86,36 @@ class Metrics:
             ["encoding"],
             registry=self.registry,
         )
+        # -- bounded ingress queue (service._IngressGate) --------------
+        self.ingress_shed = Counter(
+            "gubernator_ingress_shed_total",
+            "Lanes shed by the bounded ingress queue "
+            "(GUBER_INGRESS_QUEUE_LANES) with a 429-style error.",
+            registry=self.registry,
+        )
+        # -- overlapped dispatch pipeline (models/shard.py) ------------
+        self.dispatch_inflight = Gauge(
+            "gubernator_dispatch_inflight",
+            "Columnar batches dispatched to the device but not yet "
+            "resolved (the dispatch pipeline's depth at scrape time).",
+            registry=self.registry,
+        )
+        self.dispatch_inflight_hwm = Gauge(
+            "gubernator_dispatch_inflight_hwm",
+            "High-water mark of the dispatch pipeline depth since the "
+            "previous scrape.",
+            registry=self.registry,
+        )
+        self.dispatch_stage_seconds = Gauge(
+            "gubernator_dispatch_stage_seconds",
+            "Per-stage dispatch pipeline timings since the previous "
+            "scrape (prepare/stage/launch/fetch/commit; stat = "
+            "count/sum/max).  Cleared and rebuilt per scrape like the "
+            "circuit-breaker gauges, so a quiet store reports nothing "
+            "rather than a stale distribution.",
+            ["stage", "stat"],
+            registry=self.registry,
+        )
 
     @contextmanager
     def observe_rpc(self, method: str):
@@ -134,6 +164,24 @@ class Metrics:
             self.circuit_state.labels(peer=info.grpc_address).set(
                 breaker.state_code
             )
+
+    def observe_dispatch(self, store) -> None:
+        """Refresh the dispatch-pipeline gauges from a store
+        (collect-on-scrape).  Per-stage series are cleared first — the
+        stats are deltas since the last scrape (the PR 1 breaker-gauge
+        convention), so departed stages drop off instead of freezing."""
+        take = getattr(store, "take_pipeline_stats", None)
+        if take is None:
+            return
+        stats, depth, hwm = take()
+        self.dispatch_inflight.set(depth)
+        self.dispatch_inflight_hwm.set(hwm)
+        self.dispatch_stage_seconds.clear()
+        for stage, (count, total_s, max_s) in stats.items():
+            lab = self.dispatch_stage_seconds.labels
+            lab(stage=stage, stat="count").set(count)
+            lab(stage=stage, stat="sum").set(total_s)
+            lab(stage=stage, stat="max").set(max_s)
 
     def _bump(self, counter, absolute: float) -> None:
         current = counter._value.get()  # noqa: SLF001

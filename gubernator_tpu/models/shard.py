@@ -14,9 +14,11 @@ from __future__ import annotations
 
 import datetime as _dt
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+from functools import partial
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -406,6 +408,86 @@ def make_columns(algorithm, behavior, hits, limit, duration, n,
     return cols
 
 
+def _wire_donate_ok(device) -> bool:
+    """Whether a freshly uploaded wire buffer is donatable on this
+    device.  CPU device_put zero-copies host numpy (the device array
+    ALIASES the staging buffer), so donation is unusable there and
+    would warn per compile; accelerators copy on upload, so donating
+    lets XLA recycle the wire's bytes into the outputs."""
+    try:
+        d = device if device is not None else jax.devices()[0]
+        return d.platform != "cpu"
+    except Exception:  # noqa: BLE001 — backend quirks: lose the optimization only
+        return False
+
+
+def _prefetch_async(arr) -> None:
+    """Start the device->host copy of `arr` without blocking (the
+    launch stage calls this right after the dispatch, so the readback
+    overlaps the NEXT batch's host work instead of serializing behind
+    it — on a remote device the transfer is a full network RTT)."""
+    try:
+        arr.copy_to_host_async()
+    except (AttributeError, NotImplementedError):  # pragma: no cover
+        pass  # backend without async host copies: fetch pays the wait
+
+
+class _FusedFetch:
+    """One shared readback for a FUSED launch group: the k batches'
+    packed results ride one stacked device array, transferred ONCE
+    (whichever waiter arrives first pays it); each handle reads its
+    slice.  Slicing per batch keeps the commit closures unchanged."""
+
+    __slots__ = ("_arr", "_lock", "_np")
+
+    def __init__(self, arr):
+        self._arr = arr
+        self._lock = threading.Lock()
+        self._np = None
+
+    def get(self, i: int):
+        with self._lock:
+            if self._np is None:
+                self._np = np.asarray(self._arr)
+                self._arr = None  # drop the device reference
+            return self._np[i]
+
+
+@dataclass
+class _Staged:
+    """A prepared batch between the stage and launch steps: the packed
+    wire's H2D upload is already in flight; `solo` launches it alone,
+    while same-`fuse_key` neighbors waiting at the launch gate can ride
+    one fused program instead (ColumnarPipeline._launch_in_order)."""
+
+    solo: "Callable"          # state -> (state, packed)
+    fuse_key: object = None   # None = not fuse-eligible (fallback wire)
+    wire_dev: object = None   # uploaded packed wire (dict-wire path)
+    n_rounds: int = 1
+    now_ms: int = 0
+    wide: bool = False
+
+
+@dataclass
+class _ShardPrep:
+    """Output of ShardStore's prepare stage: the plan columns plus the
+    commit closure, handed to the unlocked stage step."""
+
+    cols: "_Columns"
+    now_ms: int
+    force_wire: Optional[str]
+    n: int
+    padded: int
+    n_rounds: int
+    narrow: bool
+    slot_col: np.ndarray
+    rid_col: np.ndarray
+    ex_col: np.ndarray
+    occ_col: np.ndarray
+    wr_col: np.ndarray
+    commit: "Callable"
+
+
 class ColumnsHandle:
     """Deferred result of one pipelined columnar batch
     (ShardStore.apply_columns_async).  Commits apply strictly in
@@ -413,18 +495,38 @@ class ColumnsHandle:
     but the device->host READBACK runs outside the ordering locks:
     concurrent waiters overlap their transfers (on a remote device each
     readback is a full network RTT, so serializing them caps the whole
-    service at 1/RTT batches per second)."""
+    service at 1/RTT batches per second).
 
-    def __init__(self, store, fetch_fn, commit_fn, limit_col):
+    The handle is created at the END of the prepare stage (its `ticket`
+    is the batch's reservation in the plan-order journal) and becomes
+    fetchable once the launch stage ran: `_fetch` blocks on the launch
+    event, so a drain that overtakes a not-yet-launched batch simply
+    waits for its dispatcher thread to reach the launch gate."""
+
+    def __init__(self, store, commit_fn, limit_col):
         self._store = store
-        self._fetch_fn = fetch_fn
+        self._fetch_fn: "Optional[Callable]" = None  # set by the launch
         self._commit_fn = commit_fn
         self._fetched = None
         self._fetch_lock = threading.Lock()
+        self._launched = threading.Event()
+        self._launch_exc: "Optional[BaseException]" = None
+        self._exc: "Optional[BaseException]" = None
         self._limit = limit_col
         self._value = None
+        self.ticket = -1  # plan-order reservation (set by the pipeline)
         self.done = False
 
+    # -- launch side (dispatcher threads) ------------------------------
+    def _launch_ok(self, fetch_fn) -> None:
+        self._fetch_fn = fetch_fn
+        self._launched.set()
+
+    def _launch_fail(self, exc: BaseException) -> None:
+        self._launch_exc = exc
+        self._launched.set()
+
+    # -- resolve side --------------------------------------------------
     def _fetch(self):
         """Blocking device readback; idempotent and safe to call from
         any thread (no store/drain lock held).  Returns None when the
@@ -433,13 +535,28 @@ class ColumnsHandle:
             if self.done:
                 return None
             if self._fetched is None:
+                self._launched.wait()
+                if self._launch_exc is not None:
+                    raise self._launch_exc
                 self._fetched = self._fetch_fn()
                 self._fetch_fn = None
             return self._fetched
 
     def _do_resolve(self) -> None:
-        packed_np = self._fetch()
-        status, remaining, reset = self._commit_fn(packed_np)
+        t0 = time.perf_counter()
+        try:
+            packed_np = self._fetch()
+        except Exception as e:  # noqa: BLE001 — launch failure
+            self._finish_exc(e)
+            return
+        self._store._observe_stage("fetch", time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        try:
+            status, remaining, reset = self._commit_fn(packed_np)
+        except Exception as e:  # noqa: BLE001 — surfaced at result()
+            self._finish_exc(e)
+            return
+        self._store._observe_stage("commit", time.perf_counter() - t1)
         self._value = {
             "status": status,
             "limit": self._limit,
@@ -455,38 +572,264 @@ class ColumnsHandle:
             self._fetched = None
             self.done = True
 
+    def _finish_exc(self, exc: BaseException) -> None:
+        """Record a launch/commit failure as this handle's outcome so
+        the FIFO drain can keep resolving younger batches; result()
+        re-raises."""
+        self._exc = exc
+        self._commit_fn = None
+        with self._fetch_lock:
+            self._fetched = None
+            self.done = True
+
+    def prefetch(self) -> None:
+        """Nonblocking hint from the drainer's backlog path.  The
+        launch stage already requested the async device->host copy, so
+        there is nothing further to do without blocking; kept as an
+        explicit extension point for transports whose launch-side
+        prefetch is unavailable.  MUST NOT touch `_fetch_lock` — a
+        resolver holds it across the blocking readback, and this hint
+        fires from service threads that must never stall an RTT."""
+
     def result(self) -> dict:
         if not self.done:
-            self._fetch()  # overlap readbacks across waiter threads
+            try:
+                self._fetch()  # overlap readbacks across waiter threads
+            except Exception:  # noqa: BLE001
+                pass  # the ordered drain records it as this handle's outcome
             self._store._drain_until(self)
+        if self._exc is not None:
+            raise self._exc
         return self._value
 
 
 class ColumnarPipeline:
-    """Mixin: the FIFO of in-flight columnar batches plus the two-lock
-    discipline that lets INGRESS THREADS pipeline.
+    """Mixin: the three-stage overlapped dispatch pipeline for columnar
+    batches (architecture.md "Dispatch pipeline").
+
+    Each batch moves through:
+
+      1. PREPARE — slot-table planning (the only table-mutating step),
+         under `_plan_lock`.  The batch's position in the plan order is
+         its reservation TICKET; the `_inflight` FIFO appended here is
+         the reservation journal — commit order is defined at plan
+         time, before any device work.
+      2. STAGE — pack the wire and START the H2D upload.  No locks:
+         batch N+1's packing runs while batch N computes on device.
+      3. LAUNCH — ticket order, under `_lock`, reduced to the
+         state-threading jit call (state and wire donated).  Consecutive
+         same-shape batches already staged at the gate launch FUSED —
+         one program applies them sequentially — so the fixed
+         per-dispatch cost amortizes under backlog.
+      4. FETCH (no locks; the launch pre-requested the async copy) and
+         COMMIT (FIFO under `_drain_lock`, table writes guarded by the
+         per-table native mutex + `_lock` for host mirrors).
 
     Locks, in acquisition order (never the reverse):
+      * `_plan_lock` — serializes prepares; owns ticket assignment.
       * `_drain_lock` — serializes resolvers; held across the blocking
         device readback so results commit strictly in dispatch order.
-      * `_lock` (the store mutation RLock) — guards table/state/device
-        buffers; taken by dispatchers for planning+enqueue and by
-        resolvers ONLY for the post-readback decode/commit.
+      * `_lock` (the store mutation RLock) — guards the donated device
+        buffers; taken by launches and by resolvers ONLY for the
+        post-readback decode/commit.
 
-    The payoff: while one thread blocks on batch i's device->host
-    transfer (holding only `_drain_lock`), another thread can plan and
-    enqueue batch i+1 under `_lock`.  With a remote device every
-    readback is a full network RTT, so this overlap — not kernel speed —
-    decides service-tier throughput.  The pipelined staleness semantics
-    are unchanged from single-threaded async dispatch: planning reads
-    table expiry that may lag by the unresolved depth, and the kernel
-    revalidates expiry device-side.
+    Batch N+1's PREPARE overlaps batch N's COMMIT: the two hold
+    different Python locks, and the C++ slot tables carry their own
+    per-table mutex (host_runtime.cpp), so call-level interleaving is
+    safe.  The semantics are the pipelined-staleness contract unchanged:
+    planning reads table expiry that may lag by the unresolved depth,
+    the kernel revalidates expiry device-side, and per-slot
+    pending-write counts keep in-flight slots uneviction-able.
     """
+
+    # Launch-fusion cap: group sizes are restricted to {1, 2, 4} — each
+    # (size, wire shape) is a distinct XLA program, and on a remote
+    # device every program's first dispatch pays an executable load.
+    MAX_FUSE = 4
 
     def _init_pipeline(self) -> None:
         self._inflight: "deque[ColumnsHandle]" = deque()
         self._drain_lock = threading.Lock()
+        self._plan_lock = threading.Lock()
+        self._launch_cv = threading.Condition()
+        self._next_ticket = 0
+        self._next_launch = 0
+        self._launch_gate: "Dict[int, tuple]" = {}  # ticket -> (_Staged, handle)
+        self._launch_aborted: set = set()  # tombstoned tickets (abort path)
+        self._stage_stats: "Dict[str, list]" = {}
+        self._stats_lock = threading.Lock()
+        self._depth_hwm = 0
+        self._seen_wire_shapes: set = set()  # (W, narrow) staged so far
 
+    # -- observability (metrics.observe_dispatch scrapes these) --------
+    def _observe_stage(self, stage: str, dt: float) -> None:
+        with self._stats_lock:
+            st = self._stage_stats.setdefault(stage, [0, 0.0, 0.0])
+            st[0] += 1
+            st[1] += dt
+            st[2] = max(st[2], dt)
+
+    def pipeline_depth(self) -> int:
+        """Batches dispatched but not yet resolved (gauge value)."""
+        return len(self._inflight)
+
+    def take_pipeline_stats(self):
+        """Drain the per-stage timing aggregates accumulated since the
+        last call: ({stage: (count, total_s, max_s)}, depth, depth_hwm).
+        Cleared per scrape, like the breaker gauges (PR 1 convention)."""
+        with self._stats_lock:
+            out = {k: tuple(v) for k, v in self._stage_stats.items()}
+            self._stage_stats.clear()
+            hwm = self._depth_hwm
+            self._depth_hwm = len(self._inflight)
+        return out, len(self._inflight), hwm
+
+    # -- the three-stage dispatch driver -------------------------------
+    def _submit_pipelined(self, keys, cols, now_ms: int,
+                          force_wire: Optional[str] = None) -> "ColumnsHandle":
+        """Run prepare -> stage -> launch for one batch and return its
+        enqueued handle.  Subclasses provide `_prepare_columns` (table
+        planning, returns a prep object with a `.commit` closure),
+        `_stage_columns` (pack + upload, returns a _Staged), and
+        `_launch_group` (the locked jit call for 1..MAX_FUSE staged
+        batches)."""
+        t0 = time.perf_counter()
+        with self._plan_lock:
+            prep = self._prepare_columns(keys, cols, now_ms, force_wire)
+            handle = ColumnsHandle(self, prep.commit, cols.limit)
+            handle.ticket = self._next_ticket
+            self._next_ticket += 1
+            self._inflight.append(handle)
+            with self._stats_lock:
+                self._depth_hwm = max(self._depth_hwm, len(self._inflight))
+        self._observe_stage("prepare", time.perf_counter() - t0)
+        try:
+            t1 = time.perf_counter()
+            staged = self._stage_columns(prep)
+            self._observe_stage("stage", time.perf_counter() - t1)
+        except BaseException as e:
+            self._abort_launch_turn(handle, e)
+            raise
+        self._launch_in_order(handle, staged)
+        return handle
+
+    def _retire_aborted_locked(self) -> None:
+        """Advance past tombstoned (aborted) tickets; `_launch_cv` held."""
+        while self._next_launch in self._launch_aborted:
+            self._launch_aborted.discard(self._next_launch)
+            self._next_launch += 1
+        # Tombstones of already-passed tickets (a waiter aborted while
+        # a fusing launcher swept it up) can never retire: drop them.
+        self._launch_aborted = {
+            t for t in self._launch_aborted if t > self._next_launch
+        }
+
+    def _abort_launch_turn(self, group_or_handle, exc: BaseException) -> None:
+        """A failure after tickets were reserved — staging raised, or an
+        asynchronous exception (KeyboardInterrupt) landed while waiting
+        at the gate: mark the handle(s) failed and retire their launch
+        turns WITHOUT blocking.  If the turn is current it advances now;
+        otherwise a tombstone makes whichever launcher next advances
+        skip it — so an interrupted dispatcher can never wedge younger
+        tickets or the resolvers waiting on their launch events."""
+        handles = (
+            [h for _, h in group_or_handle]
+            if isinstance(group_or_handle, list) else [group_or_handle]
+        )
+        for h in handles:
+            h._launch_fail(exc)
+        with self._launch_cv:
+            for h in handles:
+                self._launch_gate.pop(h.ticket, None)
+                self._launch_aborted.add(h.ticket)
+            self._retire_aborted_locked()
+            self._launch_cv.notify_all()
+
+    def _launch_in_order(self, handle: "ColumnsHandle",
+                         staged: "_Staged") -> None:
+        ticket = handle.ticket
+        group = None
+        try:
+            with self._launch_cv:
+                if self._next_launch != ticket:
+                    self._launch_gate[ticket] = (staged, handle)
+                    while (self._next_launch != ticket
+                           and not handle._launched.is_set()):
+                        self._launch_cv.wait(0.1)
+                    self._launch_gate.pop(ticket, None)
+                    if handle._launched.is_set():
+                        return  # an older launcher fused this batch into its group
+                group = [(staged, handle)]
+                if staged.fuse_key is not None:
+                    # Collect contiguous already-staged successors of the
+                    # same wire shape/kind.  Contiguity is required — the
+                    # launch turn advances past exactly this group, so a
+                    # gap ticket must not be skipped.
+                    avail = []
+                    nt = ticket + 1
+                    while (len(avail) < self.MAX_FUSE - 1
+                           and nt in self._launch_gate
+                           and self._launch_gate[nt][0].fuse_key == staged.fuse_key):
+                        avail.append(nt)
+                        nt += 1
+                    take = 3 if len(avail) >= 3 else (1 if avail else 0)
+                    for t2 in avail[:take]:
+                        group.append(self._launch_gate.pop(t2))
+        except BaseException as e:  # async interrupt mid-wait/collect
+            self._abort_launch_turn(group or handle, e)
+            raise
+        exc: "Optional[BaseException]" = None
+        t0 = time.perf_counter()
+        try:
+            with self._lock:
+                self._launch_group(group)
+        except BaseException as e:  # noqa: BLE001
+            exc = e
+        self._observe_stage("launch", time.perf_counter() - t0)
+        if exc is not None:
+            for _, h in group:
+                h._launch_fail(exc)
+        with self._launch_cv:
+            self._next_launch = ticket + len(group)
+            self._retire_aborted_locked()
+            self._launch_cv.notify_all()
+        if exc is not None:
+            raise exc
+
+    # -- launch implementations (shared by ShardStore / MeshBucketStore)
+    def _pre_launch(self) -> None:
+        """Hook: device work that must precede the group's programs
+        (the mesh drains its queued tier moves here)."""
+
+    def _fused_launch_fn(self, k: int, wide: bool):
+        """Hook: the jitted K-batch fused program for this store's
+        device topology."""
+        raise NotImplementedError
+
+    def _launch_group(self, group) -> None:
+        """Stage 3 (ticket order, under `_lock`): just the
+        state-threading jit call.  A multi-batch group rides ONE fused
+        program; each handle's fetch reads its slice of the shared
+        stacked result, transferred once."""
+        self._pre_launch()
+        if len(group) == 1:
+            staged, h = group[0]
+            self.state, packed = staged.solo(self.state)
+            h._launch_ok(partial(np.asarray, packed))
+            _prefetch_async(packed)
+            return
+        fn = self._fused_launch_fn(len(group), group[0][0].wide)
+        nr = np.asarray([s.n_rounds for s, _ in group], np.int32)
+        nowv = np.asarray([s.now_ms for s, _ in group], np.int64)
+        self.state, stacked = fn(
+            self.state, *[s.wire_dev for s, _ in group], nr, nowv
+        )
+        shared = _FusedFetch(stacked)
+        for i, (_, h) in enumerate(group):
+            h._launch_ok(partial(shared.get, i))
+        _prefetch_async(stacked)
+
+    # -- resolve / drain ordering --------------------------------------
     def _drain_until(self, handle: "ColumnsHandle") -> None:
         with self._drain_lock:
             if handle.done:
@@ -505,17 +848,23 @@ class ColumnarPipeline:
                 self._inflight.popleft()._do_resolve()
 
     def _drain_then_lock(self) -> None:
-        """Acquire the store lock with the pipeline empty: non-columnar
-        mutators (dataclass apply, snapshot, loader, GLOBAL sync) must
-        observe every older batch's table commits first.  Loops because
-        a concurrent dispatcher can enqueue between the drain and the
-        acquire."""
+        """Acquire the plan + store locks with the pipeline empty:
+        non-columnar mutators (dataclass apply, snapshot, loader,
+        GLOBAL sync) must observe every older batch's table commits
+        first, and must block new prepares while they hold the state.
+        Release with `_unlock_drained`.  Loops defensively, though with
+        `_plan_lock` held no new handle can enter the FIFO."""
+        self._plan_lock.acquire()
         while True:
             self._drain_all()
             self._lock.acquire()
             if not self._inflight:
                 return
             self._lock.release()
+
+    def _unlock_drained(self) -> None:
+        self._lock.release()
+        self._plan_lock.release()
 
 
 def build_round_arrays(chunk: Sequence[_Prepared], padded: int) -> Tuple[np.ndarray, ...]:
@@ -603,7 +952,7 @@ class ShardStore(ColumnarPipeline):
                 self._run_round(chunk, now_ms, responses)
             return [r if r is not None else RateLimitResponse() for r in responses]
         finally:
-            self._lock.release()
+            self._unlock_drained()
 
     # ------------------------------------------------------------------
     # Native (C++) fast path: resolve + round-plan in host_runtime.cpp,
@@ -675,23 +1024,17 @@ class ShardStore(ColumnarPipeline):
         (buckets.apply_rounds), and all outputs come back in ONE packed
         device->host transfer.  Returns (status, remaining, reset_time)
         arrays aligned to keys."""
-        with self._lock:
-            handle = ColumnsHandle(
-                self, *self._dispatch_columns(keys, cols, now_ms), cols.limit
-            )
-            self._inflight.append(handle)
-        r = handle.result()
+        r = self._submit_pipelined(keys, cols, now_ms).result()
         return r["status"], r["remaining"], r["reset_time"]
 
-
-    def _dispatch_columns(self, keys: List[str], cols: "_Columns", now_ms: int,
-                          force_wire: Optional[str] = None):
-        """Plan + enqueue one columnar batch WITHOUT blocking on the
-        device, returning a resolve() closure that performs the one
-        blocking readback and the table commit.  The split is what
-        enables pipelining: the caller can plan/dispatch batch i+1 while
-        batch i's compute and transfer are still in flight.  Caller must
-        hold self._lock for the dispatch; resolve() re-acquires it."""
+    def _prepare_columns(self, keys: List[str], cols: "_Columns", now_ms: int,
+                         force_wire: Optional[str] = None) -> "_ShardPrep":
+        """Stage 1 (under `_plan_lock`): everything that touches the
+        slot table — the C++ grouped plan, the pass-through expiry
+        snapshot — plus the cheap padded plan-column scatters.  No
+        device work and no packing: those run unlocked in stage 2, so
+        batch N+1's planning starts the moment batch N's plan is done,
+        regardless of where batch N is in its flight."""
         n = len(keys)
         planner = native.NativeBatchPlanner(self.table, keys, now_ms)
         round_id, slots, exists, occ, write, n_rounds = planner.plan_grouped(
@@ -717,72 +1060,6 @@ class ShardStore(ColumnarPipeline):
         # would reconstruct a wrong reset_time for far-future
         # pass-through lanes.
         passthrough_exp = self.table.get_expire_bulk(slots) if narrow else None
-        dict_enc = None
-        if (force_wire is None and n_rounds <= 255
-                and int(occ_col.max(initial=0)) <= 65535):
-            # The dict wire carries values in its 256-row i64 table, so
-            # it works at ANY magnitude — wide batches (monthly/yearly
-            # Gregorian, big limits) only switch the OUTPUT width.
-            dict_enc = buckets.build_config_dict(cols, now_ms)
-        if dict_enc is not None:
-            cfg_idx, table = dict_enc
-            # Single-buffer wire: one host->device transfer per batch
-            # instead of 12 (per-call overhead dominates at service
-            # batch sizes).
-            wire = buckets.pack_dict_wire(
-                slot_col[None, :], ex_col[None, :], wr_col[None, :],
-                _pad(cfg_idx, padded, np.uint8)[None, :], occ_col[None, :],
-                rid_col[None, :], table,
-            )[0]
-            kern = (
-                buckets.apply_rounds_packed_jit
-                if narrow
-                else buckets.apply_rounds_packed_wide_jit
-            )
-            self.state, packed = kern(self.state, wire, n_rounds, now_ms)
-        elif narrow:
-            greg_delta = np.where(
-                cols.greg_duration != 0, cols.greg_expire - now_ms, 0
-            ).astype(np.int32)
-            batch = buckets.make_batch32(
-                slot_col,
-                ex_col,
-                _pad(cols.algo, padded, np.int32),
-                _pad(cols.behavior, padded, np.int32),
-                _pad(cols.hits, padded, np.int32),
-                _pad(cols.limit, padded, np.int32),
-                _pad(cols.duration, padded, np.int32),
-                _pad(greg_delta, padded, np.int32),
-                _pad(cols.greg_duration, padded, np.int32),
-                occ=occ_col,
-                write=wr_col,
-            )
-            self.state, packed = buckets.apply_rounds32_jit(
-                self.state, batch, rid_col, n_rounds, now_ms
-            )
-        else:
-            batch = buckets.make_batch(
-                slot_col,
-                ex_col,
-                _pad(cols.algo, padded, np.int32),
-                _pad(cols.behavior, padded, np.int32),
-                _pad(cols.hits, padded, np.int64),
-                _pad(cols.limit, padded, np.int64),
-                _pad(cols.duration, padded, np.int64),
-                _pad(cols.greg_expire, padded, np.int64),
-                _pad(cols.greg_duration, padded, np.int64),
-                occ=occ_col,
-                write=wr_col,
-            )
-            self.state, packed = buckets.apply_rounds_jit(
-                self.state, batch, rid_col, n_rounds, now_ms
-            )
-
-        def fetch():
-            # The blocking readback: runs with NO store/drain lock held,
-            # so concurrent waiters overlap transfers and dispatchers
-            # keep planning (ColumnarPipeline).
-            return np.asarray(packed)
 
         def commit(packed_np):
             with self._lock:
@@ -799,7 +1076,104 @@ class ShardStore(ColumnarPipeline):
                 self.algo_mirror[slots] = cols.algo
                 return status, remaining, reset
 
-        return fetch, commit
+        return _ShardPrep(
+            cols=cols, now_ms=now_ms, force_wire=force_wire, n=n,
+            padded=padded, n_rounds=n_rounds, narrow=narrow,
+            slot_col=slot_col, rid_col=rid_col, ex_col=ex_col,
+            occ_col=occ_col, wr_col=wr_col, commit=commit,
+        )
+
+    def _stage_columns(self, prep: "_ShardPrep") -> "_Staged":
+        """Stage 2 (no locks): encode the wire and START the H2D
+        upload.  The dict-wire path uploads ONE buffer and is
+        fuse-eligible; the fallback array wires launch solo."""
+        cols, now_ms, padded = prep.cols, prep.now_ms, prep.padded
+        n_rounds, narrow = prep.n_rounds, prep.narrow
+        dict_enc = None
+        if (prep.force_wire is None and n_rounds <= 255
+                and int(prep.occ_col.max(initial=0)) <= 65535):
+            # The dict wire carries values in its 256-row i64 table, so
+            # it works at ANY magnitude — wide batches (monthly/yearly
+            # Gregorian, big limits) only switch the OUTPUT width.
+            dict_enc = buckets.build_config_dict(cols, now_ms)
+        if dict_enc is not None:
+            cfg_idx, table = dict_enc
+            # Single-buffer wire: one host->device transfer per batch
+            # instead of 12 (per-call overhead dominates at service
+            # batch sizes).
+            wire = buckets.pack_dict_wire(
+                prep.slot_col[None, :], prep.ex_col[None, :],
+                prep.wr_col[None, :],
+                _pad(cfg_idx, padded, np.uint8)[None, :],
+                prep.occ_col[None, :], prep.rid_col[None, :], table,
+            )[0]
+            wire_dev = (
+                jax.device_put(wire, self.device)
+                if self.device is not None else jax.device_put(wire)
+            )
+            if _wire_donate_ok(self.device):
+                kern = (
+                    buckets.apply_rounds_packed_donated
+                    if narrow
+                    else buckets.apply_rounds_packed_wide_donated
+                )
+            else:
+                kern = (
+                    buckets.apply_rounds_packed_jit
+                    if narrow
+                    else buckets.apply_rounds_packed_wide_jit
+                )
+            return _Staged(
+                solo=lambda state: kern(state, wire_dev, n_rounds, now_ms),
+                fuse_key=("dict", narrow, wire.shape[0]),
+                wire_dev=wire_dev, n_rounds=n_rounds, now_ms=now_ms,
+                wide=not narrow,
+            )
+        if narrow:
+            greg_delta = np.where(
+                cols.greg_duration != 0, cols.greg_expire - now_ms, 0
+            ).astype(np.int32)
+            batch = buckets.make_batch32(
+                prep.slot_col,
+                prep.ex_col,
+                _pad(cols.algo, padded, np.int32),
+                _pad(cols.behavior, padded, np.int32),
+                _pad(cols.hits, padded, np.int32),
+                _pad(cols.limit, padded, np.int32),
+                _pad(cols.duration, padded, np.int32),
+                _pad(greg_delta, padded, np.int32),
+                _pad(cols.greg_duration, padded, np.int32),
+                occ=prep.occ_col,
+                write=prep.wr_col,
+            )
+            return _Staged(
+                solo=lambda state: buckets.apply_rounds32_jit(
+                    state, batch, prep.rid_col, n_rounds, now_ms
+                )
+            )
+        batch = buckets.make_batch(
+            prep.slot_col,
+            prep.ex_col,
+            _pad(cols.algo, padded, np.int32),
+            _pad(cols.behavior, padded, np.int32),
+            _pad(cols.hits, padded, np.int64),
+            _pad(cols.limit, padded, np.int64),
+            _pad(cols.duration, padded, np.int64),
+            _pad(cols.greg_expire, padded, np.int64),
+            _pad(cols.greg_duration, padded, np.int64),
+            occ=prep.occ_col,
+            write=prep.wr_col,
+        )
+        return _Staged(
+            solo=lambda state: buckets.apply_rounds_jit(
+                state, batch, prep.rid_col, n_rounds, now_ms
+            )
+        )
+
+    def _fused_launch_fn(self, k: int, wide: bool):
+        return buckets.fused_packed_jit(
+            k, wide, donate_wires=_wire_donate_ok(self.device)
+        )
 
     @property
     def supports_columns(self) -> bool:
@@ -830,14 +1204,7 @@ class ShardStore(ColumnarPipeline):
         """
         cols = self._make_columns(algorithm, behavior, hits, limit, duration,
                                   len(keys), greg_expire, greg_duration)
-        with self._lock:
-            handle = ColumnsHandle(
-                self,
-                *self._dispatch_columns(keys, cols, now_ms, force_wire),
-                cols.limit,
-            )
-            self._inflight.append(handle)
-        return handle.result()
+        return self._submit_pipelined(keys, cols, now_ms, force_wire).result()
 
     def apply_columns_async(
         self,
@@ -866,14 +1233,7 @@ class ShardStore(ColumnarPipeline):
         slightly old expire times."""
         cols = self._make_columns(algorithm, behavior, hits, limit, duration,
                                   len(keys), greg_expire, greg_duration)
-        with self._lock:
-            handle = ColumnsHandle(
-                self,
-                *self._dispatch_columns(keys, cols, now_ms, force_wire),
-                cols.limit,
-            )
-            self._inflight.append(handle)
-        return handle
+        return self._submit_pipelined(keys, cols, now_ms, force_wire)
 
     def _make_columns(self, algorithm, behavior, hits, limit, duration, n,
                       greg_expire, greg_duration) -> "_Columns":
@@ -906,7 +1266,7 @@ class ShardStore(ColumnarPipeline):
             slot, _ = self.table.lookup_or_assign(item.key, 0)
             self._inject(slot, item)
         finally:
-            self._lock.release()
+            self._unlock_drained()
 
     def snapshot_items(self):
         """Loader.Save path: every mapped slot as a CacheItem
@@ -921,7 +1281,7 @@ class ShardStore(ColumnarPipeline):
             rows = buckets.read_rows(self.state, np.asarray(slots, np.int32))
             return _rows_to_items(keys, rows)
         finally:
-            self._lock.release()
+            self._unlock_drained()
 
 
 

@@ -691,9 +691,11 @@ class NativeMeshPlanner:
     (fnv1a % S), per-shard grouped round planning into padded [S, P]
     arrays, and post-dispatch decode + slot-table commit + original-
     order response scatter (gt_mesh_*).  Replaces the round-3 Python
-    loop over shards in parallel/mesh.py::_dispatch_columns.
+    loop over shards in parallel/mesh.py's columnar dispatch.
 
-    Lifecycle (all calls under the owning store's lock):
+    Lifecycle (plan under the store's `_plan_lock`; finish from the
+    FIFO resolver — the per-table C++ mutex makes a finish safe
+    against the NEXT batch's concurrent plan):
         mp = NativeMeshPlanner(tables, keys, now_ms)   # begin: counts
         plan = mp.plan_grouped(cols, reset_mask)       # padded arrays
         ... device dispatch ...

@@ -12,12 +12,24 @@
 // so the device kernel, not the host, is the bottleneck.
 //
 // Exposed as a plain C ABI for ctypes (no pybind11 in this image).
-// Thread-safety contract: one ShardStore lock guards each table, same
-// as the Python twin; no internal locking here.
+// Thread-safety contract: each Table carries its own recursive mutex,
+// taken by every extern-C entry that touches it.  This is what lets the
+// overlapped dispatch pipeline run batch N+1's PLANNING concurrently
+// with batch N's in-flight DECODE/COMMIT (models/shard.py
+// ColumnarPipeline): the two stages hold different Python locks, and
+// ctypes releases the GIL for the call's duration, so without internal
+// locking they would race on the same hash map.  Interleaving at call
+// granularity is safe by the same argument as pipelined planning
+// itself — a plan that runs before an older batch's commit observes
+// expiry lagging by the unresolved depth (revalidated device-side),
+// and pending_write refcounts keep in-flight slots uneviction-able.
+// Cross-batch ORDERING is the Python tier's job (plan-order tickets +
+// the FIFO drain); this mutex only makes each call atomic.
 
 #include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <string_view>
 #include <unordered_map>
@@ -47,6 +59,10 @@ inline uint64_t fnv1_64(const char* p, const char* end) {
 }
 
 struct Table {
+  // Guards every member below against concurrent extern-C calls
+  // (recursive: gt_mesh_* entries call gt_batch_* entries on the same
+  // table).  See the thread-safety contract at the top of the file.
+  std::recursive_mutex mu;
   int64_t capacity;
   // slot -> key (empty string + mapped=false when free)
   std::vector<std::string> slot_key;
@@ -418,31 +434,46 @@ struct Batch {
   size_t key_len(int64_t i) const { return (size_t)(offsets[i + 1] - offsets[i]); }
 };
 
+// Per-table lock for the extern-C surface (see the thread-safety
+// contract at the top of the file).
+#define GT_LOCK(tp) std::lock_guard<std::recursive_mutex> _gt_guard((tp)->mu)
+
 }  // namespace
 
 extern "C" {
 
 void* gt_table_new(int64_t capacity) { return new Table(capacity); }
 void gt_table_free(void* t) { delete (Table*)t; }
-int64_t gt_table_len(void* t) { return (int64_t)((Table*)t)->key_to_slot.size(); }
+int64_t gt_table_len(void* t) {
+  GT_LOCK((Table*)t);
+  return (int64_t)((Table*)t)->key_to_slot.size();
+}
 
 void gt_table_stats(void* tv, int64_t* out) {  // hits, misses, evictions
   Table* t = (Table*)tv;
+  GT_LOCK(t);
   out[0] = t->hits; out[1] = t->misses; out[2] = t->evictions;
 }
 
 // Single-counter read: plan_grouped_python polls this around every
 // lookup to detect evictions, so it must not marshal the whole stats
 // array per call.
-int64_t gt_table_evictions(void* tv) { return ((Table*)tv)->evictions; }
+int64_t gt_table_evictions(void* tv) {
+  GT_LOCK((Table*)tv);
+  return ((Table*)tv)->evictions;
+}
 
 // Mapping-change generation (see Table::map_generation): equal reads
 // across two points in time guarantee no key->front-slot mapping
 // changed between them.
-uint64_t gt_table_generation(void* tv) { return ((Table*)tv)->map_generation; }
+uint64_t gt_table_generation(void* tv) {
+  GT_LOCK((Table*)tv);
+  return ((Table*)tv)->map_generation;
+}
 
 int32_t gt_table_get_slot(void* tv, const char* key, int64_t len) {
   Table* t = (Table*)tv;
+  GT_LOCK(t);
   auto it = t->key_to_slot.find(std::string(key, (size_t)len));
   return it == t->key_to_slot.end() ? -1 : it->second;
 }
@@ -451,6 +482,7 @@ int32_t gt_table_get_slot(void* tv, const char* key, int64_t len) {
 void gt_table_lookup_or_assign(void* tv, const char* key, int64_t len,
                                int64_t now_ms, int32_t* out_slot,
                                uint8_t* out_exists) {
+  GT_LOCK((Table*)tv);
   auto [s, e] = ((Table*)tv)->lookup_or_assign(key, (size_t)len, now_ms);
   *out_slot = s;
   *out_exists = e ? 1 : 0;
@@ -458,6 +490,7 @@ void gt_table_lookup_or_assign(void* tv, const char* key, int64_t len,
 
 void gt_table_remove(void* tv, const char* key, int64_t len) {
   Table* t = (Table*)tv;
+  GT_LOCK(t);
   std::string k(key, (size_t)len);
   auto it = t->key_to_slot.find(k);
   if (it != t->key_to_slot.end()) t->unmap_slot(it->second);
@@ -473,6 +506,7 @@ void gt_table_remove(void* tv, const char* key, int64_t len) {
 // ---- two-tier back tier -----------------------------------------------
 
 void gt_table_enable_back(void* tv, int64_t back_capacity) {
+  GT_LOCK((Table*)tv);
   ((Table*)tv)->enable_back(back_capacity);
 }
 
@@ -480,6 +514,7 @@ void gt_table_enable_back(void* tv, int64_t back_capacity) {
 // back evictions (true state loss)
 void gt_table_tier_stats(void* tv, int64_t* out) {
   Table* t = (Table*)tv;
+  GT_LOCK(t);
   out[0] = (int64_t)t->key_to_slot.size() + t->back_size;
   out[1] = t->back_size;
   out[2] = t->demotions;
@@ -489,6 +524,7 @@ void gt_table_tier_stats(void* tv, int64_t* out) {
 
 void gt_table_move_counts(void* tv, int64_t* n_promo, int64_t* n_demo) {
   Table* t = (Table*)tv;
+  GT_LOCK(t);
   *n_promo = (int64_t)t->mv_promo_src.size();
   *n_demo = (int64_t)t->mv_demo_src.size();
 }
@@ -502,6 +538,7 @@ void gt_table_take_moves(void* tv, int32_t* promo_kind, int32_t* promo_src,
                          int32_t* promo_dst, int32_t* demo_src,
                          int32_t* demo_dst) {
   Table* t = (Table*)tv;
+  GT_LOCK(t);
   std::memcpy(promo_kind, t->mv_promo_kind.data(),
               t->mv_promo_kind.size() * sizeof(int32_t));
   std::memcpy(promo_src, t->mv_promo_src.data(),
@@ -526,6 +563,7 @@ void gt_table_take_moves(void* tv, int32_t* promo_kind, int32_t* promo_src,
 // fills (back_slots, expire, offsets[count+1], key bytes).
 void gt_table_back_size(void* tv, int64_t* count, int64_t* total_bytes) {
   Table* t = (Table*)tv;
+  GT_LOCK(t);
   *count = t->back_size;
   int64_t bytes = 0;
   for (auto& kv : t->key_to_back) bytes += (int64_t)kv.first.size();
@@ -535,6 +573,7 @@ void gt_table_back_size(void* tv, int64_t* count, int64_t* total_bytes) {
 void gt_table_back_keys(void* tv, int32_t* slots, int64_t* expire,
                         int64_t* offsets, char* bytes) {
   Table* t = (Table*)tv;
+  GT_LOCK(t);
   int64_t i = 0, off = 0;
   for (auto& kv : t->key_to_back) {
     slots[i] = kv.second;
@@ -548,6 +587,7 @@ void gt_table_back_keys(void* tv, int32_t* slots, int64_t* expire,
 }
 
 void gt_table_set_expire(void* tv, int32_t slot, int64_t expire) {
+  GT_LOCK((Table*)tv);
   ((Table*)tv)->expire_ms[slot] = expire;
 }
 
@@ -557,6 +597,7 @@ void gt_table_set_expire(void* tv, int32_t slot, int64_t expire) {
 void gt_table_get_expire(void* tv, const int32_t* slots, int64_t n,
                          int64_t* out) {
   Table* t = (Table*)tv;
+  GT_LOCK(t);
   for (int64_t i = 0; i < n; ++i)
     out[i] = (slots[i] >= 0 && slots[i] < t->capacity) ? t->expire_ms[slots[i]] : 0;
 }
@@ -565,6 +606,7 @@ void gt_table_get_expire(void* tv, const int32_t* slots, int64_t n,
 void gt_table_commit(void* tv, const int32_t* slots, const int64_t* expire,
                      const uint8_t* removed, int64_t n) {
   Table* t = (Table*)tv;
+  GT_LOCK(t);
   for (int64_t i = 0; i < n; ++i) {
     int32_t s = slots[i];
     if (s < 0) continue;
@@ -583,6 +625,7 @@ void gt_table_commit_keys(void* tv, const int32_t* slots,
                           const char* keys, const int64_t* offsets,
                           int64_t n) {
   Table* t = (Table*)tv;
+  GT_LOCK(t);
   for (int64_t i = 0; i < n; ++i) {
     int32_t s = slots[i];
     if (s < 0) continue;
@@ -602,6 +645,7 @@ void gt_table_commit_keys(void* tv, const int32_t* slots,
 // gt_table_keys to fill (slots, offsets[count+1], bytes).
 void gt_table_keys_size(void* tv, int64_t* count, int64_t* total_bytes) {
   Table* t = (Table*)tv;
+  GT_LOCK(t);
   *count = (int64_t)t->key_to_slot.size();
   int64_t bytes = 0;
   for (auto& kv : t->key_to_slot) bytes += (int64_t)kv.first.size();
@@ -610,6 +654,7 @@ void gt_table_keys_size(void* tv, int64_t* count, int64_t* total_bytes) {
 
 void gt_table_keys(void* tv, int32_t* slots, int64_t* offsets, char* bytes) {
   Table* t = (Table*)tv;
+  GT_LOCK(t);
   int64_t i = 0, off = 0;
   for (auto& kv : t->key_to_slot) {
     slots[i] = kv.second;
@@ -638,6 +683,7 @@ int64_t gt_batch_next_round(void* bv, int32_t* lane_idx, int32_t* slots,
                             uint8_t* exists) {
   Batch* b = (Batch*)bv;
   Table* t = b->table;
+  GT_LOCK(t);
   if (b->pending.empty()) return 0;
   std::unordered_map<std::string, int> seen_keys;
   std::unordered_map<int32_t, int> used_slots;
@@ -680,6 +726,7 @@ void gt_batch_commit_round(void* bv, const int64_t* new_expire,
                            const uint8_t* removed) {
   Batch* b = (Batch*)bv;
   Table* t = b->table;
+  GT_LOCK(t);
   for (size_t j = 0; j < b->round_lane.size(); ++j) {
     int32_t i = b->round_lane[j];
     int32_t s = b->slot[i];
@@ -779,6 +826,7 @@ static int64_t plan_rounds(Batch* b, int64_t round, int32_t* round_id,
 int64_t gt_batch_plan(void* bv, int32_t* round_id, int32_t* slots,
                       uint8_t* exists) {
   Batch* b = (Batch*)bv;
+  GT_LOCK(b->table);
   b->plan_order.clear();
   b->plan_order.reserve((size_t)b->n);
   std::unordered_map<int32_t, std::string_view> slot_owner;
@@ -798,6 +846,7 @@ void gt_batch_commit_plan(void* bv, const int64_t* new_expire,
                           const uint8_t* removed) {
   Batch* b = (Batch*)bv;
   Table* t = b->table;
+  GT_LOCK(t);
   b->committed = true;
   for (int32_t i : b->plan_order) {
     int32_t s = b->slot[i];
@@ -848,6 +897,7 @@ int64_t gt_batch_plan_grouped(void* bv, const int32_t* algo,
                               uint8_t* write) {
   Batch* b = (Batch*)bv;
   Table* t = b->table;
+  GT_LOCK(t);
   b->plan_order.clear();
   b->plan_order.reserve((size_t)b->n);
 
@@ -923,8 +973,11 @@ void gt_batch_free(void* bv) {
   Batch* b = (Batch*)bv;
   // A planned-but-never-committed batch (error path) must release its
   // pending-write claims or the slots stay device-authoritative forever.
+  // Locked: Python GC can run this from any thread while a younger
+  // batch's plan is mid-flight on the same table.
   if (!b->committed) {
     Table* t = b->table;
+    GT_LOCK(t);
     for (int32_t i : b->plan_order) {
       int32_t s = b->slot[i];
       if (s >= 0 && t->pending_write[s] > 0) --t->pending_write[s];
@@ -1050,6 +1103,12 @@ int64_t gt_mesh_plan_grouped(void* mpv, const int32_t* algo,
   for (int64_t s = 0; s < mp->S; ++s) {
     int64_t m = (int64_t)mp->lanes[s].size();
     if (m == 0) continue;
+    // One shard's whole plan (batch begin + grouped plan + pre_exp
+    // snapshot) runs under that shard's table lock: atomic against a
+    // concurrent older batch's finish on the same shard (the
+    // overlapped-pipeline contract; the gt_batch_* calls below
+    // re-enter the same recursive mutex).
+    GT_LOCK(mp->tables[s]);
     // Gather this shard's column values into contiguous temporaries.
     a32.resize(m); b32.resize(m);
     h64.resize(m); l64.resize(m); d64.resize(m);
@@ -1108,6 +1167,7 @@ void gt_mesh_finish_narrow(void* mpv, const int32_t* packed, int64_t now_ms,
     int64_t m = (int64_t)mp->lanes[s].size();
     if (m == 0) continue;
     Table* t = mp->tables[s];
+    GT_LOCK(t);
     Batch* b = (Batch*)mp->batches[s];
     const int32_t* row0 = packed + ((s * 4) + 0) * P;
     const int32_t* row1 = packed + ((s * 4) + 1) * P;
@@ -1155,6 +1215,7 @@ void gt_mesh_finish_wide(void* mpv, const int64_t* packed, int32_t* status,
   for (int64_t s = 0; s < mp->S; ++s) {
     int64_t m = (int64_t)mp->lanes[s].size();
     if (m == 0) continue;
+    GT_LOCK(mp->tables[s]);
     Batch* b = (Batch*)mp->batches[s];
     const int64_t* row0 = packed + ((s * 4) + 0) * P;
     const int64_t* row1 = packed + ((s * 4) + 1) * P;

@@ -1156,6 +1156,71 @@ apply_rounds_packed_wide_jit = jax.jit(
     apply_rounds_packed_wide, donate_argnums=0, static_argnames=("cold_cond",)
 )
 
+# Donating twins for the overlapped dispatch pipeline (models/shard.py):
+# the wire buffer is a fresh per-batch device upload that nothing reads
+# after the kernel, so donating it lets XLA recycle its bytes into the
+# outputs instead of allocating per batch.  Separate wrappers — the
+# plain _jit forms accept host numpy wires (tests, fallback callers),
+# which donation would spam warnings about.
+apply_rounds_packed_donated = jax.jit(
+    apply_rounds_packed, donate_argnums=(0, 1), static_argnames=("cold_cond",)
+)
+apply_rounds_packed_wide_donated = jax.jit(
+    apply_rounds_packed_wide, donate_argnums=(0, 1), static_argnames=("cold_cond",)
+)
+
+
+def apply_rounds_packed_fused(state, wires, n_rounds_vec, now_vec,
+                              wide: bool = False, cold_cond: bool = True):
+    """Apply K same-shape packed-wire batches SEQUENTIALLY inside one
+    program (the launch-fusion kernel of the overlapped dispatch
+    pipeline, models/shard.py ColumnarPipeline._launch_group).
+
+    Semantically identical to K solo apply_rounds_packed[_wide] calls in
+    order — batch i+1 sees the state batch i left — but the host pays
+    ONE dispatch (and the caller one readback) for the group, so the
+    fixed per-dispatch cost (per-call enqueue; on a tunnel device a
+    full RPC) amortizes over K batches.  `wires` is a tuple of K
+    equal-shape wire buffers; n_rounds_vec/now_vec are [K] arrays
+    (traced, so one compilation per (K, wire-shape) serves every round
+    count and timestamp).  Returns (state, stacked [K, 4, P] results).
+    """
+    fn = apply_rounds_packed_wide if wide else apply_rounds_packed
+    outs = []
+    for i, w in enumerate(wires):
+        state, packed = fn(state, w, n_rounds_vec[i], now_vec[i],
+                           cold_cond=cold_cond)
+        outs.append(packed)
+    return state, jnp.stack(outs)
+
+
+_FUSED_PACKED_JIT: dict = {}
+
+
+def fused_packed_jit(k: int, wide: bool, cold_cond: bool = True,
+                     donate_wires: bool = True):
+    """Jitted apply_rounds_packed_fused for a fixed group size `k`
+    (call as fn(state, w_0, ..., w_{k-1}, n_rounds_vec, now_vec)).
+    State is always donated; wires too unless `donate_wires` is False
+    (CPU zero-copies uploads from host numpy, so their buffers are not
+    donatable there — the caller passes the platform's verdict).
+    Cached module-wide so all stores in a process share one compilation
+    per (k, wide, cold_cond, shape)."""
+    key = (k, wide, cold_cond, donate_wires)
+    fn = _FUSED_PACKED_JIT.get(key)
+    if fn is None:
+
+        def run(state, *args):
+            return apply_rounds_packed_fused(
+                state, args[:k], args[k], args[k + 1],
+                wide=wide, cold_cond=cold_cond,
+            )
+
+        donate = tuple(range(k + 1)) if donate_wires else (0,)
+        fn = jax.jit(run, donate_argnums=donate)
+        _FUSED_PACKED_JIT[key] = fn
+    return fn
+
 
 def build_config_dict(cols, now_ms: int):
     """Host half of the dict wire: map each lane's 7 value columns to a

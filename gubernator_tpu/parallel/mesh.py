@@ -37,9 +37,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.shard import (
     ColumnarPipeline,
-    ColumnsHandle,
     RoundPlanner,
     _rows_to_items,
+    _Staged,
+    _wire_donate_ok,
     build_round_arrays,
     item_to_rows,
     make_columns,
@@ -161,8 +162,7 @@ def _rounds64_mesh_jit(state, batch, round_id, n_rounds, now):
     return jax.vmap(one)(state, batch, round_id)
 
 
-@partial(jax.jit, donate_argnums=0)
-def _rounds_packed_mesh_jit(state, wire, n_rounds, now):
+def _rounds_packed_mesh(state, wire, n_rounds, now):
     """Dict-wire rounds behind the single-buffer wire ([S, 3P+1792]
     i32, see buckets.pack_dict_wire): one sharded transfer per batch."""
 
@@ -172,8 +172,7 @@ def _rounds_packed_mesh_jit(state, wire, n_rounds, now):
     return jax.vmap(one)(state, wire)
 
 
-@partial(jax.jit, donate_argnums=0)
-def _rounds_packed_wide_mesh_jit(state, wire, n_rounds, now):
+def _rounds_packed_wide_mesh(state, wire, n_rounds, now):
     """Wide-output packed dict wire (values beyond int32 — monthly/
     yearly Gregorian expiries; i64[S, 4, B] result)."""
 
@@ -183,6 +182,52 @@ def _rounds_packed_wide_mesh_jit(state, wire, n_rounds, now):
         )
 
     return jax.vmap(one)(state, wire)
+
+
+_rounds_packed_mesh_jit = jax.jit(_rounds_packed_mesh, donate_argnums=0)
+_rounds_packed_wide_mesh_jit = jax.jit(_rounds_packed_wide_mesh, donate_argnums=0)
+# Donating twins for the overlapped dispatch pipeline: the wire is a
+# fresh per-batch sharded upload nothing reads afterwards, so on real
+# accelerators (not CPU, which zero-copies uploads) XLA can recycle its
+# bytes into the outputs.
+_rounds_packed_mesh_donated = jax.jit(_rounds_packed_mesh, donate_argnums=(0, 1))
+_rounds_packed_wide_mesh_donated = jax.jit(
+    _rounds_packed_wide_mesh, donate_argnums=(0, 1)
+)
+
+# Launch-fusion programs (ColumnarPipeline._launch_group): K same-shape
+# dict-wire batches applied SEQUENTIALLY inside one sharded program —
+# batch i+1 sees batch i's state, exactly as K solo dispatches would,
+# but the host pays one dispatch and one stacked readback for the
+# group.  Cached per (k, wide, donate) module-wide.
+_MESH_FUSED_JIT: dict = {}
+
+
+def _mesh_fused_packed_jit(k: int, wide: bool, donate_wires: bool = True):
+    key = (k, wide, donate_wires)
+    fn = _MESH_FUSED_JIT.get(key)
+    if fn is None:
+        base = (
+            buckets.apply_rounds_packed_wide if wide
+            else buckets.apply_rounds_packed
+        )
+
+        def run(state, *args):
+            wires, nr, now = args[:k], args[k], args[k + 1]
+            outs = []
+            for i in range(k):
+
+                def one(state_s, w_s):
+                    return base(state_s, w_s, nr[i], now[i], cold_cond=False)
+
+                state, packed = jax.vmap(one)(state, wires[i])
+                outs.append(packed)
+            return state, jnp.stack(outs)  # [k, S, 4, P]
+
+        donate = tuple(range(k + 1)) if donate_wires else (0,)
+        fn = jax.jit(run, donate_argnums=donate)
+        _MESH_FUSED_JIT[key] = fn
+    return fn
 
 
 @partial(jax.jit, donate_argnums=0)
@@ -292,18 +337,37 @@ def _locked(fn):
 def _drained_locked(fn):
     """_locked plus a pipeline drain first: mutators that read or commit
     the slot tables / state wholesale must observe every in-flight
-    columnar batch's commits (ColumnarPipeline._drain_then_lock)."""
+    columnar batch's commits, and must hold the PLAN lock too so no new
+    batch can plan against the state they are mutating
+    (ColumnarPipeline._drain_then_lock)."""
 
     def wrapper(self, *args, **kwargs):
         self._drain_then_lock()
         try:
             return fn(self, *args, **kwargs)
         finally:
-            self._lock.release()
+            self._unlock_drained()
 
     wrapper.__name__ = fn.__name__
     wrapper.__doc__ = fn.__doc__
     return wrapper
+
+
+@dataclass
+class _MeshPrep:
+    """Output of MeshBucketStore's prepare stage: the mesh plan plus
+    the commit closure, handed to the unlocked stage step."""
+
+    cols: object
+    now_ms: int
+    force_wire: Optional[str]
+    n: int
+    padded: int
+    n_rounds: int
+    narrow: bool
+    mp: object  # NativeMeshPlanner
+    pos: np.ndarray
+    commit: object
 
 
 @dataclass
@@ -405,6 +469,9 @@ class MeshBucketStore(ColumnarPipeline):
         self.dirty = np.zeros((self.n_shards, g_capacity), dtype=bool)
 
         self._sharding = NamedSharding(self.mesh, P(self.axis))
+        # Wire donation (launch stage): accelerators copy uploads, so
+        # the wire buffer is recyclable; CPU zero-copies host numpy.
+        self._wire_donate = _wire_donate_ok(self.mesh.devices.flat[0])
         self.state = self._stack_and_shard(buckets.init_state(capacity_per_shard))
         self.back = (
             self._stack_and_shard(buckets.init_back(back_capacity_per_shard))
@@ -582,32 +649,21 @@ class MeshBucketStore(ColumnarPipeline):
         )
         if (cols.behavior & int(Behavior.GLOBAL)).any():
             raise ValueError("GLOBAL lanes must take the dataclass path (apply)")
-        with self._lock:
-            handle = ColumnsHandle(
-                self,
-                *self._dispatch_columns(keys, cols, now_ms, force_wire),
-                cols.limit,
-            )
-            self._inflight.append(handle)
-        return handle
+        return self._submit_pipelined(keys, cols, now_ms, force_wire)
 
-    def _dispatch_columns(self, keys, cols, now_ms: int,
-                          force_wire: Optional[str] = None):
-        """Shard-bucket + plan + enqueue one columnar batch without
-        blocking; returns the resolve() closure (caller holds the store
-        lock for this dispatch phase, ColumnarPipeline discipline).
-
-        The whole host side runs in TWO C++ calls (gt_mesh_begin +
-        gt_mesh_plan_grouped: hash/bucket every key, per-shard grouped
-        round planning, padded [S, P] fill) plus vectorized numpy for
-        the value/cfg columns via the lane->padded-position map; the
-        commit side is ONE C++ call (gt_mesh_finish_*: decode,
-        slot-table commit, original-order scatter).  Round 3 ran this
-        as a serial Python loop over shards — the reference serves its
-        whole edge in compiled code (gubernator.go:116-227)."""
+    def _prepare_columns(self, keys, cols, now_ms: int,
+                         force_wire: Optional[str] = None) -> "_MeshPrep":
+        """Stage 1 of the overlapped dispatch (under `_plan_lock`): the
+        slot-table work only — gt_mesh_begin + gt_mesh_plan_grouped
+        (hash/bucket every key, per-shard grouped round planning,
+        padded [S, P] fill).  Tier moves queued by this plan stay
+        queued; the LAUNCH stage drains them, ordered against the
+        device program.  The commit side stays ONE C++ call
+        (gt_mesh_finish_*: decode, slot-table commit, original-order
+        scatter), safe against the NEXT batch's concurrent planning via
+        the per-table native mutex."""
         from .. import native as _native
 
-        S = self.n_shards
         n = len(keys)
         mp = _native.NativeMeshPlanner(self.tables, keys, now_ms)
         padded = pad_size(max(int(mp.counts.max()) if n else 1, 1))
@@ -615,13 +671,36 @@ class MeshBucketStore(ColumnarPipeline):
             cols, int(Behavior.RESET_REMAINING), padded
         )
         pos = mp.pos[:n]
-        # Tier moves queued by this plan (and any stale window) must
-        # land before the batch program reads front rows.
-        self._drain_moves()
-
         narrow = narrow_ok(cols, now_ms) and force_wire != "wide"
+
+        def commit(packed_np):
+            with self._lock:
+                if narrow:
+                    status, rem, reset = mp.finish_narrow(packed_np, now_ms)
+                else:
+                    status, rem, reset = mp.finish_wide(packed_np)
+                if n:
+                    # Host algo mirror (Store-SPI bookkeeping parity):
+                    # one vectorized 2-D scatter, no per-shard masks.
+                    self.algo_mirror[
+                        pos // padded, mp.slot.reshape(-1)[pos]
+                    ] = cols.algo
+            return status, rem, reset
+
+        return _MeshPrep(
+            cols=cols, now_ms=now_ms, force_wire=force_wire, n=n,
+            padded=padded, n_rounds=n_rounds, narrow=narrow,
+            mp=mp, pos=pos, commit=commit,
+        )
+
+    def _stage_columns(self, prep: "_MeshPrep") -> "_Staged":
+        """Stage 2 (no locks): encode the wire and start the sharded
+        H2D upload while older batches compute/transfer."""
+        cols, now_ms, padded = prep.cols, prep.now_ms, prep.padded
+        mp, pos, n_rounds, narrow = prep.mp, prep.pos, prep.n_rounds, prep.narrow
+        S = self.n_shards
         dict_enc = None
-        if force_wire is None and n_rounds <= 255:
+        if prep.force_wire is None and n_rounds <= 255:
             # Values live in the dict wire's 256-row i64 table, so wide
             # batches (monthly/yearly Gregorian) stay on it too — only
             # the output width switches (apply_rounds_packed_wide).
@@ -644,59 +723,62 @@ class MeshBucketStore(ColumnarPipeline):
             # price at these shapes is not per-submitted-row.  See
             # benchmarks/RESULTS.md round-4 notes; the kernel remains
             # available and equivalence-tested.)
-            fn_packed = (
-                _rounds_packed_mesh_jit if narrow else _rounds_packed_wide_mesh_jit
-            )
-            self.state, packed = fn_packed(
-                self.state, wire_dev, n_rounds, now_ms
-            )
-        else:
-            vdt = np.int32 if narrow else np.int64
-
-            def scatter(col, dtype):
-                a = np.zeros((S, padded), dtype=dtype)
-                a.reshape(-1)[pos] = col
-                return a
-
-            if narrow:
-                ge = np.where(
-                    cols.greg_duration != 0, cols.greg_expire - now_ms, 0
+            if self._wire_donate:
+                fn_packed = (
+                    _rounds_packed_mesh_donated if narrow
+                    else _rounds_packed_wide_mesh_donated
                 )
             else:
-                ge = cols.greg_expire
-            mk = buckets.make_batch32 if narrow else buckets.make_batch
-            batch = mk(
-                mp.slot, mp.exists.astype(bool), scatter(cols.algo, np.int32),
-                scatter(cols.behavior, np.int32), scatter(cols.hits, vdt),
-                scatter(cols.limit, vdt), scatter(cols.duration, vdt),
-                scatter(ge, vdt), scatter(cols.greg_duration, vdt),
-                occ=mp.occ, write=mp.write.astype(bool),
+                fn_packed = (
+                    _rounds_packed_mesh_jit if narrow
+                    else _rounds_packed_wide_mesh_jit
+                )
+            with self._stats_lock:
+                self._seen_wire_shapes.add((wire.shape[1], narrow))
+            return _Staged(
+                solo=lambda state: fn_packed(state, wire_dev, n_rounds, now_ms),
+                fuse_key=("dict", narrow, wire.shape[1]),
+                wire_dev=wire_dev, n_rounds=n_rounds, now_ms=now_ms,
+                wide=not narrow,
             )
-            batch = jax.tree.map(lambda a: jax.device_put(a, self._sharding), batch)
-            rid_dev = jax.device_put(jnp.asarray(mp.rid), self._sharding)
-            fn = _rounds32_mesh_jit if narrow else _rounds64_mesh_jit
-            self.state, packed = fn(self.state, batch, rid_dev, n_rounds, now_ms)
+        vdt = np.int32 if narrow else np.int64
 
-        def fetch():
-            # Blocking readback with no ordering locks held: concurrent
-            # waiters overlap transfers (ColumnarPipeline).
-            return np.asarray(packed)  # [S, 4, padded]
+        def scatter(col, dtype):
+            a = np.zeros((S, padded), dtype=dtype)
+            a.reshape(-1)[pos] = col
+            return a
 
-        def commit(packed_np):
-            with self._lock:
-                if narrow:
-                    status, rem, reset = mp.finish_narrow(packed_np, now_ms)
-                else:
-                    status, rem, reset = mp.finish_wide(packed_np)
-                if n:
-                    # Host algo mirror (Store-SPI bookkeeping parity):
-                    # one vectorized 2-D scatter, no per-shard masks.
-                    self.algo_mirror[
-                        pos // padded, mp.slot.reshape(-1)[pos]
-                    ] = cols.algo
-            return status, rem, reset
+        if narrow:
+            ge = np.where(
+                cols.greg_duration != 0, cols.greg_expire - now_ms, 0
+            )
+        else:
+            ge = cols.greg_expire
+        mk = buckets.make_batch32 if narrow else buckets.make_batch
+        batch = mk(
+            mp.slot, mp.exists.astype(bool), scatter(cols.algo, np.int32),
+            scatter(cols.behavior, np.int32), scatter(cols.hits, vdt),
+            scatter(cols.limit, vdt), scatter(cols.duration, vdt),
+            scatter(ge, vdt), scatter(cols.greg_duration, vdt),
+            occ=mp.occ, write=mp.write.astype(bool),
+        )
+        batch = jax.tree.map(lambda a: jax.device_put(a, self._sharding), batch)
+        rid_dev = jax.device_put(jnp.asarray(mp.rid), self._sharding)
+        fn = _rounds32_mesh_jit if narrow else _rounds64_mesh_jit
+        return _Staged(
+            solo=lambda state: fn(state, batch, rid_dev, n_rounds, now_ms)
+        )
 
-        return fetch, commit
+    def _pre_launch(self) -> None:
+        # Tier moves queued by the group's plans (and any stale window)
+        # must land before the batch programs read front rows.  One
+        # drain covers the group: moves queued by a LATER plan are safe
+        # to apply early — the pending-write guard keeps every
+        # in-flight batch's slots out of the mover's reach.
+        self._drain_moves()
+
+    def _fused_launch_fn(self, k: int, wide: bool):
+        return _mesh_fused_packed_jit(k, wide, donate_wires=self._wire_donate)
 
     # ------------------------------------------------------------------
     def _apply_fused(self, by_shard, now_ms: int, responses) -> None:
@@ -1175,7 +1257,7 @@ class MeshBucketStore(ColumnarPipeline):
                 np.asarray(packed[:1, :1, :1])
                 return (_time.perf_counter() - t0) / iters
         finally:
-            self._lock.release()
+            self._unlock_drained()
 
     # ------------------------------------------------------------------
     def warmup(self, now_ms: int, warm_shapes: Optional[Sequence[int]] = None) -> None:
@@ -1237,6 +1319,35 @@ class MeshBucketStore(ColumnarPipeline):
                             np.zeros(lanes, np.int32), np.zeros(lanes, np.int32),
                             np.zeros(lanes, np.int64), np.ones(lanes, np.int64),
                             np.ones(lanes, np.int64), now_ms, force_wire=wire,
+                        )
+            # Compile the launch-FUSION programs for every dict-wire
+            # shape the warm shapes exercised: a backlogged coalescer
+            # fuses consecutive same-shape batches into one program
+            # (ColumnarPipeline._launch_group), and that program's
+            # first dispatch must not pay its executable load inside a
+            # client deadline.  All-noop wires (slot=-1 lanes) thread
+            # the state through unchanged.
+            S = self.n_shards
+            with self._stats_lock:
+                shapes = sorted(self._seen_wire_shapes)
+            for W, narrow in shapes:
+                if not narrow:
+                    continue  # wide dict batches are rare: compile lazily
+                P_lanes = (W - buckets.DICT_WIRE_TABLE_WORDS) // 3
+                noop = np.zeros((S, W), dtype=np.int32)
+                noop[:, :P_lanes] = -1  # slot=-1: every lane inert
+                for k in (2, 4):
+                    fn = _mesh_fused_packed_jit(
+                        k, False, donate_wires=self._wire_donate
+                    )
+                    wires = [
+                        jax.device_put(noop, self._sharding) for _ in range(k)
+                    ]
+                    with self._lock:
+                        self.state, _ = fn(
+                            self.state, *wires,
+                            np.ones(k, np.int32),
+                            np.full(k, now_ms, np.int64),
                         )
 
     def size(self) -> int:

@@ -63,6 +63,58 @@ class ApiError(Exception):
         self.http_status = http_status
 
 
+class IngressShedError(ApiError):
+    """The bounded ingress queue is full and this submission was SHED
+    (429 semantics).  Deliberately an ERROR, not an OVER_LIMIT status:
+    OVER_LIMIT is an answer about the client's rate limit; this is the
+    daemon declining to queue more work than it can serve inside any
+    useful deadline (BENCH_r05 measured an unbounded queue stretching
+    ingress p99 to 4.5s).  Callers retry with backoff, exactly like a
+    429."""
+
+    def __init__(self, queued_lanes: int, cap: int):
+        super().__init__(
+            "ResourceExhausted",
+            f"ingress queue saturated ({queued_lanes} lanes queued, "
+            f"cap {cap}); retry with backoff",
+            http_status=429,
+        )
+
+
+class _IngressGate:
+    """Shared lane accounting for the bounded ingress queue
+    (GUBER_INGRESS_QUEUE_LANES): admit at submit, release at flush.
+    cap <= 0 disables the bound."""
+
+    def __init__(self, cap: int, metrics: Optional[Metrics]):
+        self.cap = cap
+        self.metrics = metrics
+        self._queued = 0
+        self._mu = threading.Lock()
+
+    def admit(self, lanes: int) -> None:
+        """Reserve `lanes` or raise IngressShedError (counted)."""
+        if self.cap <= 0:
+            return
+        with self._mu:
+            if self._queued + lanes > self.cap:
+                queued = self._queued
+                shed = True
+            else:
+                self._queued += lanes
+                shed = False
+        if shed:
+            if self.metrics is not None:
+                self.metrics.ingress_shed.inc(lanes)
+            raise IngressShedError(queued, self.cap)
+
+    def release(self, lanes: int) -> None:
+        if self.cap <= 0:
+            return
+        with self._mu:
+            self._queued = max(self._queued - lanes, 0)
+
+
 @dataclass
 class ServiceConfig:
     """Library-user config (reference Config, config.go:66-104)."""
@@ -119,9 +171,16 @@ class LocalBatcher:
     flagged NO_BATCHING bypass the window (proto/gubernator.proto:74-78
     semantics)."""
 
-    def __init__(self, store, behaviors: BehaviorConfig, clock: Clock):
+    def __init__(self, store, behaviors: BehaviorConfig, clock: Clock,
+                 metrics: Optional[Metrics] = None):
         self.store = store
         self.clock = clock
+        # Bounded ingress (GUBER_INGRESS_QUEUE_LANES): a queue deeper
+        # than the cap sheds new submissions with a 429-style error
+        # instead of stretching every queued caller's latency.
+        self._gate = _IngressGate(
+            getattr(behaviors, "ingress_queue_lanes", 0), metrics
+        )
         self._window = BatchWindow(
             self._flush, behaviors.batch_wait_s, behaviors.batch_limit
         )
@@ -131,12 +190,18 @@ class LocalBatcher:
         if self._window.stopped:
             fut.set_exception(PeerError(ERR_BATCHER_CLOSED))
             return fut
+        try:
+            self._gate.admit(1)
+        except IngressShedError as e:
+            fut.set_exception(e)
+            return fut
         # A submit racing past the stopped check is still safe: stop()
         # drains and flushes the queue after joining the worker.
         self._window.submit((req, fut))
         return fut
 
     def _flush(self, batch) -> None:
+        self._gate.release(len(batch))
         try:
             resps = self.store.apply(
                 [r for r, _ in batch], self.clock.now_ms()
@@ -431,6 +496,17 @@ class _HandleDrainer:
     def register(self, handle, cb) -> None:
         """cb(value, exc) fires exactly once from a drainer thread (or
         inline with a shutdown error when the drainer has stopped)."""
+        # Backlog hint: ask for the handle's device->host copy NOW so a
+        # deep pipeline's transfers overlap even while every worker is
+        # parked on an older readback (the launch stage already
+        # requested one; this covers handles that were registered after
+        # their launch's request went stale).
+        pf = getattr(handle, "prefetch", None)
+        if pf is not None:
+            try:
+                pf()
+            except Exception:  # noqa: BLE001 — a hint must never fail the path
+                pass
         with self._cv:
             if not self._stopped:
                 self._q.append((handle, cb))
@@ -615,9 +691,14 @@ class ColumnarBatcher:
     # pathological pileup (arrival rate >> device rate for seconds).
     MAX_INFLIGHT = 8
 
-    def __init__(self, store, behaviors: BehaviorConfig, clock: Clock):
+    def __init__(self, store, behaviors: BehaviorConfig, clock: Clock,
+                 metrics: Optional[Metrics] = None):
         self.store = store
         self.clock = clock
+        # Bounded ingress, lane-weighted (GUBER_INGRESS_QUEUE_LANES).
+        self._gate = _IngressGate(
+            getattr(behaviors, "ingress_queue_lanes", 0), metrics
+        )
         self._own_inflight: "deque" = deque()
         # _flush can run concurrently in edge cases (worker stuck past
         # stop()'s join timeout while the stop/post-stop-submit drain
@@ -635,6 +716,11 @@ class ColumnarBatcher:
             fut.set_exception(PeerError(ERR_BATCHER_CLOSED))
             return fut
         n = len(keys)
+        try:
+            self._gate.admit(n)
+        except IngressShedError as e:
+            fut.set_exception(e)
+            return fut
         ge = np.zeros(n, np.int64) if greg_expire is None else greg_expire
         gd = np.zeros(n, np.int64) if greg_duration is None else greg_duration
         self._window.submit(
@@ -643,6 +729,7 @@ class ColumnarBatcher:
         return fut
 
     def _flush(self, batch) -> None:
+        self._gate.release(sum(len(item[0][0]) for item in batch))
         # The window admits the submission that CROSSES the lane limit
         # (it cannot un-take from the queue), so one flush can overshoot
         # MAX_LANES by up to a submission; re-chunk so no single device
@@ -780,8 +867,12 @@ class V1Service:
             for item in conf.loader.load():
                 self.store.load_item(item)
 
-        self.local_batcher = LocalBatcher(self.store, conf.behaviors, self.clock)
-        self.columnar_batcher = ColumnarBatcher(self.store, conf.behaviors, self.clock)
+        self.local_batcher = LocalBatcher(
+            self.store, conf.behaviors, self.clock, metrics=self.metrics
+        )
+        self.columnar_batcher = ColumnarBatcher(
+            self.store, conf.behaviors, self.clock, metrics=self.metrics
+        )
         self.global_mgr = GlobalManager(self)
         self.multi_region_mgr = MultiRegionManager(self)
 
