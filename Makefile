@@ -6,8 +6,11 @@
 test:
 	python -m pytest tests/ -q -p no:cacheprovider
 
-# The ROADMAP verify command: fast deterministic tests only.
+# The ROADMAP verify command: fast deterministic tests only.  The
+# metrics-name lint runs first (scripts/check_metrics_parity.py):
+# reference-parity names are frozen, new names need review there.
 tier1:
+	env JAX_PLATFORMS=cpu python scripts/check_metrics_parity.py
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
 

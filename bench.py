@@ -511,6 +511,31 @@ def measure_service_ingress(n_threads: int = 32, svc_iters: int = 10,
     return service_cps, svc_p50, svc_p99
 
 
+def measure_tracing_overhead(n_threads: int = 8, iters: int = 4):
+    """Same-run tracing overhead: headline ingress checks/s with
+    GUBER_TRACE_SAMPLE=0 (the shipped default — every hook is one
+    comparison returning the no-op singleton) over the same path with
+    tracing force-disabled ('compiled out': tracing.force_disable, the
+    as-if-the-module-did-not-exist baseline).  Both halves run
+    back-to-back in THIS process so device/host weather cancels; the
+    gate floors the ratio at 0.95 — the guards must cost <5% even on a
+    noisy host, and ~0% in truth.  Returns (ratio, off_cps, s0_cps)."""
+    from gubernator_tpu import tracing
+
+    prev_rate = tracing.sample_rate()
+    tracing.force_disable(True)
+    try:
+        off_cps, _, _ = measure_service_ingress(n_threads, iters)
+    finally:
+        tracing.force_disable(False)
+    tracing.set_sample_rate(0.0)
+    try:
+        s0_cps, _, _ = measure_service_ingress(n_threads, iters)
+    finally:
+        tracing.set_sample_rate(prev_rate)
+    return s0_cps / max(off_cps, 1.0), off_cps, s0_cps
+
+
 def measure_peer_forward(mode: str = "columns", n_threads: int = 8,
                          iters: int = 4, batch: int = 1000) -> float:
     """Loopback two-daemon forward throughput: the owner daemon runs in
@@ -765,6 +790,17 @@ def gate() -> int:
             f"device_us_b{sb}": dev["small_batch_us"][sb][3]
             for sb in (256, 1024)
         }
+    # Tracing overhead is a SAME-RUN ratio by definition (both halves
+    # back-to-back in this process), so it never reuses saved rows.
+    try:
+        ratio, off_cps, s0_cps = measure_tracing_overhead()
+        rows["tracing_overhead_ratio"] = ratio
+        print(
+            f"gate tracing rows: compiled-out {off_cps:.0f} checks/s, "
+            f"sample-0 {s0_cps:.0f} checks/s"
+        )
+    except Exception as e:  # noqa: BLE001 — service spawn can fail
+        print(f"gate tracing_overhead_ratio: SKIP (measure failed: {e})")
     failed = []
     for name, spec in thresholds.items():
         if name.startswith("_"):

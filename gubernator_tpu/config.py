@@ -96,6 +96,14 @@ class BehaviorConfig:
     # is the breaker's job across ticks, not this budget's.
     global_send_retries: int = 1  # GUBER_GLOBAL_SEND_RETRIES
 
+    # -- request tracing (tracing.py) ----------------------------------
+    # Ingress sampling rate, 0..1.  0 (the default) disables tracing
+    # entirely: every hook is a single comparison and the peer wire is
+    # byte-identical to a pre-trace build (the interop parity
+    # contract).  The daemon applies this process-wide at startup.
+    # Env: GUBER_TRACE_SAMPLE.
+    trace_sample: float = 0.0
+
 
 @dataclass
 class DaemonConfig:
@@ -403,6 +411,19 @@ def setup_daemon_config(
     b.global_send_retries = _env_int(
         merged, "GUBER_GLOBAL_SEND_RETRIES", b.global_send_retries
     )
+    v = merged.get("GUBER_TRACE_SAMPLE", "")
+    if v:
+        try:
+            rate = float(v)
+        except ValueError:
+            rate = -1.0
+        if not 0.0 <= rate <= 1.0:
+            # Loud, not clamped: GUBER_TRACE_SAMPLE=5 meaning "5%"
+            # silently tracing EVERY request is a 20x surprise.
+            raise ValueError(
+                f"GUBER_TRACE_SAMPLE must be a float in [0, 1], got '{v}'"
+            )
+        b.trace_sample = rate
     conf.gossip_seed = _env_int(merged, "GUBER_GOSSIP_SEED", conf.gossip_seed)
 
     # Static peers: GUBER_STATIC_PEERS=grpcAddr[|httpAddr],... (our

@@ -46,6 +46,14 @@ class Daemon:
             raise
 
     def _start(self) -> "Daemon":
+        # Tracing is process-wide (per-thread contexts, one flight
+        # recorder); the daemon's parsed GUBER_TRACE_SAMPLE wins over
+        # the module's import-time env default — unconditionally, so a
+        # config that says 0 also DISABLES tracing a stale environment
+        # variable turned on.
+        from . import tracing
+
+        tracing.set_sample_rate(self.conf.behaviors.trace_sample)
         tls_conf = setup_tls(self.conf.tls)
         server_tls = tls_conf.server_ctx if tls_conf else None
         # Peer data plane credentials: gRPC channel creds unless the

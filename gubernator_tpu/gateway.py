@@ -19,10 +19,12 @@ import time
 from functools import partial
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
 from . import native as _native
+from . import tracing
 from . import wire
 from .config import MAX_BATCH_SIZE, PEER_COLUMNS_MAX_LANES
 from .service import ApiError, ColumnarResult, IngressColumns, V1Service
@@ -203,11 +205,15 @@ def render_columns(result: ColumnarResult) -> dict:
     return {"responses": out}
 
 
-def handle_request(service: V1Service, method: str, path: str, raw: bytes):
+def handle_request(service: V1Service, method: str, path: str, raw: bytes,
+                   headers=None):
     """Transport-independent request handler: the single routing +
     metrics + error surface behind BOTH edges (the stdlib ThreadingHTTP
     server below and the native epoll edge, NativeGatewayServer).
-    Returns (http_status, content_type, body_bytes)."""
+    Returns (http_status, content_type, body_bytes).  `headers` (any
+    mapping with .get, or None) feeds traceparent extraction and
+    /metrics content negotiation; the native edge passes None — its
+    requests root fresh traces."""
     try:
         if method == "GET":
             # /healthz is an alias so stock k8s liveness/readiness
@@ -222,15 +228,25 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes):
                 # Collect-on-scrape: refresh the cache gauges from the
                 # store (the reference's prometheus Collector pattern,
                 # cache.go:205-218) and the per-peer circuit-breaker
-                # state gauges from the live PeerClients.
-                service.metrics.observe_cache(service.store)
-                service.metrics.observe_dispatch(service.store)
-                service.metrics.observe_peers(
-                    service.get_peer_list()
-                    + list(service.get_region_picker().peers())
-                )
-                return (200, "text/plain; version=0.0.4",
-                        service.metrics.render())
+                # state gauges from the live PeerClients.  The WHOLE
+                # refresh+render runs under the scrape lock: two racing
+                # scrapers must not interleave a take_pipeline_stats
+                # drain with the other's clear()/set() — an unlucky
+                # interleaving would render a per-scrape sample as if
+                # it never happened.
+                with service.metrics.scrape_lock:
+                    service.metrics.observe_cache(service.store)
+                    service.metrics.observe_dispatch(service.store)
+                    service.metrics.observe_peers(
+                        service.get_peer_list()
+                        + list(service.get_region_picker().peers())
+                    )
+                    ctype, payload = service.metrics.render_negotiated(
+                        headers.get("Accept", "") if headers else ""
+                    )
+                return 200, ctype, payload
+            if urlsplit(path).path in ("/debug/traces", "/debug/events"):
+                return _debug_dump(path)
             return 404, "application/json", _json_bytes(
                 {"code": 5, "message": f"no handler for {path}"}
             )
@@ -238,20 +254,24 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes):
             return 404, "application/json", _json_bytes(
                 {"code": 5, "message": f"no handler for {method} {path}"}
             )
+        tp = headers.get("traceparent") if headers else None
         if path == "/v1/GetRateLimits":
-            with service.metrics.observe_rpc("/pb.gubernator.V1/GetRateLimits"):
-                cols = parse_body_native(raw) if raw else None
-                if cols is not None:
-                    result = service.get_rate_limits_columns(cols)
-                    rendered = render_result_native(result)
-                else:
-                    body = json.loads(raw) if raw else {}
-                    result = service.get_rate_limits_columns(
-                        parse_columns(body.get("requests", []))
-                    )
-                    rendered = None
-                if rendered is None:
-                    rendered = _json_bytes(render_columns(result))
+            # Span OUTSIDE the metrics timer: observe_rpc's exit hook
+            # attaches a trace exemplar from the still-active context.
+            with tracing.ingress_span("http", path, tp):
+                with service.metrics.observe_rpc("/pb.gubernator.V1/GetRateLimits"):
+                    cols = parse_body_native(raw) if raw else None
+                    if cols is not None:
+                        result = service.get_rate_limits_columns(cols)
+                        rendered = render_result_native(result)
+                    else:
+                        body = json.loads(raw) if raw else {}
+                        result = service.get_rate_limits_columns(
+                            parse_columns(body.get("requests", []))
+                        )
+                        rendered = None
+                    if rendered is None:
+                        rendered = _json_bytes(render_columns(result))
             return 200, "application/json", rendered
         if path == "/v1/peer.GetPeerRateLimits":
             # Body parsing happens INSIDE the metrics span on BOTH
@@ -259,24 +279,27 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes):
             # status="1" request in request_counts here exactly like on
             # the async edge (architecture.md "Columnar pipeline: the
             # peer hop" documents the parity rule).
-            with service.metrics.observe_rpc(
-                "/pb.gubernator.PeersV1/GetPeerRateLimits"
-            ):
-                if service.serves_peer_columns and wire.is_columns_frame(raw):
-                    # Columnar peer hop: binary frame in, frame out.
-                    result = service.get_peer_rate_limits_columns(
-                        _decode_frame_or_400(raw),
-                        max_lanes=PEER_COLUMNS_MAX_LANES,
-                    )
-                    return (200, wire.COLUMNS_CONTENT_TYPE,
-                            wire.encode_result_frame(result))
-                body = json.loads(raw) if raw else {}
-                cols = parse_columns(body.get("requests", []))
-                result = service.get_peer_rate_limits_columns(cols)
+            with tracing.ingress_span("http", path, tp):
+                with service.metrics.observe_rpc(
+                    "/pb.gubernator.PeersV1/GetPeerRateLimits"
+                ):
+                    if service.serves_peer_columns and wire.is_columns_frame(raw):
+                        # Columnar peer hop: binary frame in, frame out.
+                        result = service.get_peer_rate_limits_columns(
+                            _decode_frame_or_400(raw),
+                            max_lanes=PEER_COLUMNS_MAX_LANES,
+                        )
+                        return (200, wire.COLUMNS_CONTENT_TYPE,
+                                wire.encode_result_frame(result))
+                    body = json.loads(raw) if raw else {}
+                    cols = parse_columns(body.get("requests", []))
+                    result = service.get_peer_rate_limits_columns(cols)
             # PeersV1 response field is rate_limits (peers.proto:42-45).
             return 200, "application/json", _json_bytes(
                 {"rateLimits": render_columns(result)["responses"]}
             )
+        if path == "/debug/profile":
+            return _debug_profile(raw)
         if path == "/v1/peer.UpdatePeerGlobals":
             with service.metrics.observe_rpc(
                 "/pb.gubernator.PeersV1/UpdatePeerGlobals"
@@ -297,6 +320,93 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes):
 
 def _json_bytes(payload) -> bytes:
     return json.dumps(payload).encode("utf-8")
+
+
+def _debug_dump(path: str):
+    """GET /debug/traces[?trace_id=<32-hex>] and GET /debug/events:
+    dump the flight recorder (tracing.py).  The trace filter matches a
+    span's own trace id OR its links — the batch span-link rule, so a
+    lane's trace finds the coalesced window/stage spans it rode."""
+    parts = urlsplit(path)
+    if parts.path == "/debug/events":
+        return 200, "application/json", _json_bytes(
+            {"events": tracing.events_snapshot()}
+        )
+    q = parse_qs(parts.query)
+    trace_id = (q.get("trace_id") or [""])[0]
+    return 200, "application/json", _json_bytes(
+        {
+            "sampleRate": tracing.sample_rate(),
+            "spans": tracing.spans_snapshot(trace_id),
+        }
+    )
+
+
+_profile_state = {"thread": None, "dirs": []}
+_profile_lock = threading.Lock()
+# Retention cap on profile dumps this daemon created: a client looping
+# POST /debug/profile must not fill the temp filesystem of a long-lived
+# daemon (each dump is a multi-MB TensorBoard trace).
+PROFILE_KEEP = 5
+
+
+def _debug_profile(raw: bytes):
+    """POST /debug/profile {"durationMs": N}: run an on-demand
+    jax.profiler device trace for N ms (default 1000, cap 60s) in the
+    background, writing a TensorBoard-loadable dump to a fresh
+    mkdtemp-created directory (mode 0700, unpredictable name — the
+    caller must NOT choose the path, and a predictable fixed path in
+    /tmp could be pre-planted by another local user).  Gated on tracing
+    being enabled (GUBER_TRACE_SAMPLE > 0) — a daemon with
+    observability off must not let callers start device-wide profiles.
+    One at a time; answers 202 immediately (a profile must not park a
+    gateway worker for its whole duration; the first call also pays
+    jax.profiler's lazy tensorflow import, several seconds)."""
+    if not tracing.enabled():
+        raise ApiError(
+            "InvalidArgument",
+            "profiling requires tracing enabled (GUBER_TRACE_SAMPLE > 0)",
+            http_status=403,
+        )
+    body = json.loads(raw) if raw else {}
+    if not isinstance(body, dict):
+        raise ApiError("InvalidArgument", "body must be a JSON object")
+    try:
+        duration_s = min(max(float(body.get("durationMs", 1000)) / 1000.0, 0.01), 60.0)
+    except (TypeError, ValueError):
+        raise ApiError("InvalidArgument", "durationMs must be a number") from None
+    with _profile_lock:
+        t = _profile_state["thread"]
+        if t is not None and t.is_alive():
+            return 409, "application/json", _json_bytes(
+                {"code": 10, "message": "a device profile is already running"}
+            )
+        import shutil
+        import tempfile
+
+        log_dir = tempfile.mkdtemp(prefix="gubernator-profile-")
+        _profile_state["dirs"].append(log_dir)
+        while len(_profile_state["dirs"]) > PROFILE_KEEP:
+            shutil.rmtree(_profile_state["dirs"].pop(0), ignore_errors=True)
+
+        def run():
+            import jax
+
+            try:
+                jax.profiler.start_trace(log_dir)
+                time.sleep(duration_s)
+            finally:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
+
+        t = threading.Thread(target=run, daemon=True, name="debug-profile")
+        _profile_state["thread"] = t
+        t.start()
+    return 202, "application/json", _json_bytes(
+        {"logDir": log_dir, "durationMs": duration_s * 1000.0}
+    )
 
 
 def _decode_frame_or_400(raw: bytes):
@@ -331,7 +441,7 @@ def _error_triplet(e: BaseException):
 
 
 def handle_request_async(service: V1Service, method: str, path: str,
-                         raw: bytes, respond) -> None:
+                         raw: bytes, respond, headers=None) -> None:
     """Async twin of handle_request for the device-bound POST paths:
     parse + submit on the calling thread, deliver via
     respond(status, content_type, body) exactly once from a completion
@@ -342,7 +452,7 @@ def handle_request_async(service: V1Service, method: str, path: str,
     if method != "POST" or path not in (
         "/v1/GetRateLimits", "/v1/peer.GetPeerRateLimits"
     ):
-        respond(*handle_request(service, method, path, raw))
+        respond(*handle_request(service, method, path, raw, headers))
         return
     rpc = (
         "/pb.gubernator.V1/GetRateLimits"
@@ -351,6 +461,14 @@ def handle_request_async(service: V1Service, method: str, path: str,
     )
     metrics = service.metrics
     start = time.perf_counter()
+    # Ingress span, async form: active on THIS thread only while the
+    # request is parsed/submitted (that is where routing captures the
+    # context into batch links and peer forwards); ended exactly once
+    # by finish(), from whichever completion thread delivers.
+    span = tracing.ingress_span(
+        "http", path, headers.get("traceparent") if headers else None
+    )
+    span.activate()
     # Exactly-once guard: an inline callback that raised must not
     # re-enter through the outer except and answer the same token
     # twice (round-5 review finding).  The check-then-set is LOCKED: a
@@ -368,10 +486,11 @@ def handle_request_async(service: V1Service, method: str, path: str,
             finished[0] = True
         # Manual observe_rpc: the span covers parse -> response-ready,
         # like the sync context manager covers parse -> render.
+        dt = time.perf_counter() - start
         metrics.request_counts.labels(status=status_label, method=rpc).inc()
-        metrics.request_duration.labels(method=rpc).observe(
-            time.perf_counter() - start
-        )
+        metrics.request_duration.labels(method=rpc).observe(dt)
+        metrics.observe_latency(rpc, dt, ctx=span.ctx if span else None)
+        span.end(status=status_label)
         respond(*triplet)
 
     try:
@@ -429,6 +548,10 @@ def handle_request_async(service: V1Service, method: str, path: str,
             )
     except Exception as e:  # noqa: BLE001 — parse/submit errors, before
         finish("1", _error_triplet(e))  # any callback was registered
+    finally:
+        # Submit done: drop the context from this worker thread (the
+        # span itself stays open until finish()).
+        span.deactivate()
 
 
 _HTTP_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
@@ -589,10 +712,15 @@ def _make_handler(service: V1Service):
         def log_message(self, fmt, *args):  # noqa: N802 — silence stdlib logging
             pass
 
-        def _send_bytes(self, status: int, content_type: str, body: bytes) -> None:
+        def _send_bytes(self, status: int, content_type: str, body: bytes,
+                        traceparent: "Optional[str]" = None) -> None:
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            if traceparent:
+                # W3C trace-context emission: the client learns the
+                # trace id its request was sampled under.
+                self.send_header("traceparent", traceparent)
             self.end_headers()
             self.wfile.write(body)
 
@@ -616,15 +744,20 @@ def _make_handler(service: V1Service):
         def do_GET(self):  # noqa: N802
             if self._refuse_if_closed():
                 return
-            status, ctype, body = handle_request(service, "GET", self.path, b"")
+            status, ctype, body = handle_request(
+                service, "GET", self.path, b"", self.headers
+            )
             self._send_bytes(status, ctype, body)
 
         def do_POST(self):  # noqa: N802
             if self._refuse_if_closed():
                 return
             status, ctype, body = handle_request(
-                service, "POST", self.path, self._read_raw()
+                service, "POST", self.path, self._read_raw(), self.headers
             )
-            self._send_bytes(status, ctype, body)
+            self._send_bytes(
+                status, ctype, body,
+                traceparent=tracing.take_emitted_traceparent(),
+            )
 
     return Handler

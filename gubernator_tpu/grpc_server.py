@@ -18,6 +18,7 @@ from typing import Optional
 
 import grpc
 
+from . import tracing
 from . import wire
 from .config import PEER_COLUMNS_MAX_LANES
 from .proto import PEERS_V1_SERVICE, V1_SERVICE
@@ -48,10 +49,27 @@ class MetricsInterceptor(grpc.ServerInterceptor):
             return handler  # only unary-unary methods exist here
         inner = handler.unary_unary
         method = handler_call_details.method
+        # W3C trace-context ingress (tracing.py): extract `traceparent`
+        # from the invocation metadata, run the handler under the span,
+        # and emit the context back as trailing metadata so callers can
+        # join logs/traces on one id.  Zero-cost when tracing is off —
+        # ingress_span returns the shared no-op.
+        traceparent = None
+        for k, v in handler_call_details.invocation_metadata or ():
+            if k == "traceparent":
+                traceparent = v
+                break
 
         def wrapped(request, context):
-            with self.metrics.observe_rpc(method):
-                return inner(request, context)
+            # Span OUTSIDE the metrics timer: observe_rpc's exit hook
+            # attaches a trace exemplar from the still-active context.
+            with tracing.ingress_span("grpc", method, traceparent) as sp:
+                with self.metrics.observe_rpc(method):
+                    resp = inner(request, context)
+                    tp = sp.traceparent()
+                    if tp is not None:
+                        context.set_trailing_metadata((("traceparent", tp),))
+                    return resp
 
         return grpc.unary_unary_rpc_method_handler(
             wrapped,

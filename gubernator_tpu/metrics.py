@@ -10,15 +10,42 @@ gubernator_async_durations + gubernator_broadcast_durations
 
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
 
-from prometheus_client import CollectorRegistry, Counter, Gauge, Summary, generate_latest
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    Summary,
+    generate_latest,
+)
+
+from . import tracing
+
+try:  # OpenMetrics exposition carries trace exemplars; text 0.0.4 cannot
+    from prometheus_client.openmetrics.exposition import (
+        CONTENT_TYPE_LATEST as OPENMETRICS_CONTENT_TYPE,
+    )
+    from prometheus_client.openmetrics.exposition import (
+        generate_latest as openmetrics_latest,
+    )
+except ImportError:  # pragma: no cover — ancient prometheus_client
+    OPENMETRICS_CONTENT_TYPE = ""
+    openmetrics_latest = None
 
 
 class Metrics:
     def __init__(self):
         self.registry = CollectorRegistry()
+        # Serializes collect-on-scrape refresh + render: two racing
+        # scrapers must never interleave a take_pipeline_stats drain
+        # with another's clear()+set() (a drained-but-not-yet-rendered
+        # sample would silently vanish).  Held by the gateway /metrics
+        # handler around the whole observe_*+render sequence.
+        self.scrape_lock = threading.Lock()
         self.cache_size = Gauge(
             "gubernator_cache_size",
             "The number of items in LRU Cache which holds the rate limits.",
@@ -40,6 +67,27 @@ class Metrics:
             "gubernator_grpc_request_duration",
             "The timings of gRPC requests in seconds.",
             ["method"],
+            registry=self.registry,
+        )
+        # Histogram twin of request_duration, bucketed for latency SLOs
+        # and carrying TRACE EXEMPLARS (tracing.py): each bucket
+        # remembers one recent trace id, rendered on the OpenMetrics
+        # exposition so a dashboard latency spike links straight to a
+        # recorded trace.  The Summary above keeps reference name
+        # parity; this is the observability extension.
+        self.request_duration_hist = Histogram(
+            "gubernator_request_duration_seconds",
+            "RPC latency histogram with trace exemplars.",
+            ["method"],
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5, 5.0),
+            registry=self.registry,
+        )
+        self.build_info = Gauge(
+            "gubernator_build_info",
+            "Constant 1, labeled with the daemon build version, the "
+            "jax backend platform, and the device-mesh shape.",
+            ["version", "backend", "mesh"],
             registry=self.registry,
         )
         self.async_durations = Summary(
@@ -132,13 +180,57 @@ class Metrics:
             status = "1"
             raise
         finally:
+            dt = time.perf_counter() - start
             self.request_counts.labels(status=status, method=method).inc()
-            self.request_duration.labels(method=method).observe(
-                time.perf_counter() - start
-            )
+            self.request_duration.labels(method=method).observe(dt)
+            self.observe_latency(method, dt)
+
+    def observe_latency(self, method: str, dt: float, ctx=None) -> None:
+        """Histogram observation with a trace exemplar — shared by the
+        sync observe_rpc (ambient per-thread context) and the async
+        gateway finish path (which passes its span's context explicitly:
+        completion threads have no ambient one)."""
+        hist = self.request_duration_hist.labels(method=method)
+        if ctx is None and tracing.enabled():
+            ctx = tracing.current()
+        if ctx is not None:
+            try:
+                hist.observe(dt, exemplar={"trace_id": ctx.trace_hex})
+                return
+            except (TypeError, ValueError):  # pragma: no cover
+                pass  # prometheus_client without exemplar support
+        hist.observe(dt)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
+
+    def render_negotiated(self, accept: str) -> "tuple[str, bytes]":
+        """(content_type, payload) honoring the scraper's Accept
+        header: `application/openmetrics-text` gets the OpenMetrics
+        exposition — the only format that carries the trace exemplars —
+        everyone else the classic text format."""
+        if "application/openmetrics-text" in (accept or "") and (
+            openmetrics_latest is not None
+        ):
+            return OPENMETRICS_CONTENT_TYPE, openmetrics_latest(self.registry)
+        return "text/plain; version=0.0.4", self.render()
+
+    def set_build_info(self, store) -> None:
+        """Pin the build-info series: version from the package, backend
+        and mesh shape from the store's device topology (stores without
+        a mesh report their shard layout)."""
+        from . import __version__
+
+        describe = getattr(store, "describe_topology", None)
+        backend, mesh = ("unknown", "none")
+        if describe is not None:
+            try:
+                backend, mesh = describe()
+            except Exception:  # noqa: BLE001 — labels must never fail startup
+                pass
+        self.build_info.labels(
+            version=__version__, backend=backend, mesh=mesh
+        ).set(1)
 
     def observe_cache(self, store) -> None:
         """Refresh cache gauges from a ShardStore/MeshBucketStore."""

@@ -24,6 +24,7 @@ import jax
 import numpy as np
 
 from .. import native
+from .. import tracing
 from ..ops import buckets
 from ..types import (
     Algorithm,
@@ -516,6 +517,10 @@ class ColumnsHandle:
         self._value = None
         self.ticket = -1  # plan-order reservation (set by the pipeline)
         self.done = False
+        # tracing.BatchTrace of the submitting batcher (None when the
+        # batch carried no sampled lanes): stage spans for this batch
+        # parent under its window span and link its member lanes.
+        self._trace = None
 
     # -- launch side (dispatcher threads) ------------------------------
     def _launch_ok(self, fetch_fn) -> None:
@@ -549,14 +554,18 @@ class ColumnsHandle:
         except Exception as e:  # noqa: BLE001 — launch failure
             self._finish_exc(e)
             return
-        self._store._observe_stage("fetch", time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._store._observe_stage("fetch", dt)
+        tracing.stage_span("fetch", dt, self._trace)
         t1 = time.perf_counter()
         try:
             status, remaining, reset = self._commit_fn(packed_np)
         except Exception as e:  # noqa: BLE001 — surfaced at result()
             self._finish_exc(e)
             return
-        self._store._observe_stage("commit", time.perf_counter() - t1)
+        dt = time.perf_counter() - t1
+        self._store._observe_stage("commit", dt)
+        tracing.stage_span("commit", dt, self._trace)
         self._value = {
             "status": status,
             "limit": self._limit,
@@ -693,20 +702,27 @@ class ColumnarPipeline:
         `_stage_columns` (pack + upload, returns a _Staged), and
         `_launch_group` (the locked jit call for 1..MAX_FUSE staged
         batches)."""
+        bt = tracing.take_batch_trace()  # staged by the batcher (if sampled)
         t0 = time.perf_counter()
         with self._plan_lock:
             prep = self._prepare_columns(keys, cols, now_ms, force_wire)
             handle = ColumnsHandle(self, prep.commit, cols.limit)
+            handle._trace = bt
             handle.ticket = self._next_ticket
             self._next_ticket += 1
             self._inflight.append(handle)
             with self._stats_lock:
                 self._depth_hwm = max(self._depth_hwm, len(self._inflight))
-        self._observe_stage("prepare", time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._observe_stage("prepare", dt)
+        tracing.stage_span("prepare", dt, bt, ticket=handle.ticket,
+                           lanes=prep.n)
         try:
             t1 = time.perf_counter()
             staged = self._stage_columns(prep)
-            self._observe_stage("stage", time.perf_counter() - t1)
+            dt = time.perf_counter() - t1
+            self._observe_stage("stage", dt)
+            tracing.stage_span("stage", dt, bt)
         except BaseException as e:
             self._abort_launch_turn(handle, e)
             raise
@@ -785,7 +801,12 @@ class ColumnarPipeline:
                 self._launch_group(group)
         except BaseException as e:  # noqa: BLE001
             exc = e
-        self._observe_stage("launch", time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._observe_stage("launch", dt)
+        for _, h in group:
+            # One launch span per batch (a fused group launches several
+            # batches in one program; each batch's trace sees it).
+            tracing.stage_span("launch", dt, h._trace, fused=len(group))
         if exc is not None:
             for _, h in group:
                 h._launch_fail(exc)
@@ -926,6 +947,15 @@ class ShardStore(ColumnarPipeline):
         # host mirror of per-slot algorithm, for store-SPI removal detection
         self.algo_mirror = np.zeros(capacity, dtype=np.int32)
         self._init_pipeline()  # FIFO of unresolved pipelined batches
+
+    def describe_topology(self) -> "Tuple[str, str]":
+        """(backend platform, mesh shape) for gubernator_build_info —
+        a single-shard store reports a 1-wide mesh."""
+        try:
+            d = self.device if self.device is not None else jax.devices()[0]
+            return d.platform, "1"
+        except Exception:  # noqa: BLE001
+            return "unknown", "1"
 
     # ------------------------------------------------------------------
     def apply(

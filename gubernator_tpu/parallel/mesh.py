@@ -615,6 +615,16 @@ class MeshBucketStore(ColumnarPipeline):
         runtime present, no synchronous Store SPI callbacks)."""
         return self._native and self.store is None
 
+    def describe_topology(self) -> "Tuple[str, str]":
+        """(backend platform, mesh shape string) for the
+        gubernator_build_info gauge: e.g. ("tpu", "8") for a flat
+        8-device mesh."""
+        try:
+            platform = self.mesh.devices.flat[0].platform
+        except Exception:  # noqa: BLE001
+            platform = "unknown"
+        return platform, "x".join(str(d) for d in self.mesh.devices.shape)
+
     def apply_columns(
         self, keys, algorithm, behavior, hits, limit, duration, now_ms: int,
         greg_expire=None, greg_duration=None, force_wire=None,

@@ -38,6 +38,7 @@ import grpc
 import numpy as np
 
 from . import faults as faults_mod
+from . import tracing
 from . import wire
 from .config import MAX_BATCH_SIZE, PEER_COLUMNS_MAX_LANES, BehaviorConfig
 from .faults import CircuitBreaker, FaultPlan
@@ -146,6 +147,12 @@ class PeerClient:
         self._columnar: Optional[bool] = (
             None if self.behaviors.peer_columns else False
         )
+        # Whether the peer accepts the frame trace-context trailer
+        # (HTTP transport only; gRPC needs no probe — proto3 unknown
+        # fields are skipped).  None = untried: the first SAMPLED frame
+        # probes; a peer that answers "length mismatch" predates the
+        # trailer and is resent the same frame without it.
+        self._trace_frames: Optional[bool] = None
         # Per-RPC lane caps.  The operator's GUBER_BATCH_LIMIT keeps
         # meaning on both encodings: it is the classic per-RPC cap
         # verbatim, and the columnar cap scales with it (16.384x at the
@@ -203,27 +210,39 @@ class PeerClient:
         rc, lo, _hi = fut.result(timeout=timeout + 1.0)
         return rc.response_at(lo)
 
-    def forward_columns(self, cols: "wire.PeerColumns") -> Future:
+    def forward_columns(self, cols: "wire.PeerColumns",
+                        trace_ctx=None) -> Future:
         """Submit a column sub-batch to the per-owner coalescing window
         (peer_client.go:272-312 sendQueue, columnar).  The future
         resolves to (result: service.ColumnarResult, lo, hi) — this
         sub-batch's slice of the shared flushed batch — or raises the
-        transport/breaker failure."""
+        transport/breaker failure.  `trace_ctx` (a tracing.SpanContext)
+        rides the sub-batch so the flushed RPC can carry the wire
+        trace-context column and link its peer.rpc span."""
         if self._shutdown.is_set():
             raise PeerError(ERR_CLOSING, not_ready=True)
         fut: Future = Future()
+        if trace_ctx is None and tracing.enabled():
+            trace_ctx = tracing.current()
+        if trace_ctx is not None:
+            fut._trace_ctx = trace_ctx  # read back at flush (same Future)
         self._window.submit((cols, fut))
         return fut
 
     def send_columns_direct(self, cols: "wire.PeerColumns",
-                            timeout_s: Optional[float] = None):
+                            timeout_s: Optional[float] = None,
+                            trace_ctx=None):
         """One columnar GetPeerRateLimits RPC, no window (the
         NO_BATCHING group forward).  Returns service.ColumnarResult."""
         if self._shutdown.is_set():
             raise PeerError(ERR_CLOSING, not_ready=True)
+        trace = None
+        if trace_ctx is not None and tracing.enabled():
+            trace = tracing.links_to_entries([trace_ctx], 0, len(cols[0]))
         return self._send_columns(
             cols,
             timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s,
+            trace=trace,
         )
 
     def get_peer_rate_limits(
@@ -338,6 +357,22 @@ class PeerClient:
             )
         return wire.concat_results(parts)
 
+    def _trace_entries(self, chunk: List[tuple]):
+        """Wire trace-context entries for a chunk: one lane-range entry
+        per SAMPLED sub-batch (all lanes of one ingress submission share
+        its context).  Returns (entries | None, link contexts)."""
+        if not tracing.enabled():
+            return None, ()
+        entries, links, lo = [], [], 0
+        for c, fut in chunk:
+            hi = lo + len(c[0])
+            ctx = getattr(fut, "_trace_ctx", None)
+            if ctx is not None:
+                entries.append((lo, hi, ctx.trace_id, ctx.span_id))
+                links.append(ctx)
+            lo = hi
+        return (entries or None), links
+
     def _send_chunk(self, chunk: List[tuple]) -> None:
         try:
             if len(chunk) == 1:
@@ -351,9 +386,37 @@ class PeerClient:
                         for i in range(2, 7)
                     ),
                 )
-            rc = self._send_columns(
-                cols, self.behaviors.batch_timeout_s, _draining=True
-            )
+            trace, links = self._trace_entries(chunk)
+            t0 = time.monotonic_ns()
+            rpc_err = None
+            try:
+                rc = self._send_columns(
+                    cols, self.behaviors.batch_timeout_s, _draining=True,
+                    trace=trace,
+                )
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                rpc_err = e
+                raise
+            finally:
+                bt = tracing.new_batch(links)
+                if bt is not None:
+                    # The client half of the cross-daemon hop: one span
+                    # for the RPC, linked to every sampled sub-batch it
+                    # coalesced (one RPC carries many traces — link,
+                    # not nest).  A failed RPC stamps the error — the
+                    # span must not read as a completed round trip.
+                    attrs = dict(
+                        peer=self.info.grpc_address,
+                        lanes=len(cols[0]),
+                        encoding="columns" if self._columnar else "classic",
+                    )
+                    if rpc_err is not None:
+                        attrs["error"] = str(rpc_err)
+                    tracing.record_span(
+                        "peer.rpc", bt.ctx,
+                        start_ns=t0, end_ns=time.monotonic_ns(),
+                        links=links, **attrs,
+                    )
         except Exception as e:  # noqa: BLE001
             for _, fut in chunk:
                 if not fut.done():
@@ -367,10 +430,14 @@ class PeerClient:
             lo = hi
 
     def _send_columns(self, cols: "wire.PeerColumns",
-                      timeout_s: Optional[float], _draining: bool = False):
+                      timeout_s: Optional[float], _draining: bool = False,
+                      trace=None):
         """One columnar GetPeerRateLimits over the configured transport
         (negotiating the encoding, see _columnar).  Returns a decoded
-        service.ColumnarResult of exactly len(cols) lanes."""
+        service.ColumnarResult of exactly len(cols) lanes.  `trace`
+        (wire.TraceEntry list) rides the columnar encodings only — the
+        classic fallback drops it, pre-columns peers never see trace
+        bytes."""
         n = len(cols[0])
 
         def _count_check(rc) -> None:
@@ -389,7 +456,7 @@ class PeerClient:
                 raise PeerError(ERR_CLOSING, not_ready=True)
             rc = self._guarded_call(
                 "GetPeerRateLimits",
-                lambda: self._post_columns_inner(cols, timeout_s),
+                lambda: self._post_columns_inner(cols, timeout_s, trace),
                 _count_check,
             )
         else:
@@ -397,7 +464,7 @@ class PeerClient:
                 raise PeerError(ERR_CLOSING, not_ready=True)
             rc = self._guarded_call(
                 "GetPeerRateLimits",
-                lambda: self._grpc_columns_inner(cols, timeout_s),
+                lambda: self._grpc_columns_inner(cols, timeout_s, trace),
                 _count_check,
             )
         if self._metrics is not None:
@@ -455,6 +522,13 @@ class PeerClient:
             self._metrics.circuit_transitions.labels(
                 peer=self.info.grpc_address, to=state
             ).inc()
+        if state == "open":
+            # Flight-recorder event + automatic dump (tracing.py): the
+            # recorder's last-N spans are exactly the context a breaker
+            # trip needs preserved before traffic moves on.
+            tracing.record_event(
+                "breaker-open", peer=self.info.grpc_address
+            )
 
     def _breaker_gate(self, op: str) -> None:
         """Raise the circuit-open fast-fail, or reserve the call slot
@@ -484,6 +558,9 @@ class PeerClient:
             return
         msg = f"{op} to peer {self.info.grpc_address} failed: {act.message}"
         self._set_last_err(msg)
+        tracing.record_event(
+            "fault", op=op, peer=self.info.grpc_address, kind_detail=act.kind
+        )
         raise PeerError(msg, not_ready=act.not_ready)
 
     def _guarded_call(self, op: str, fn, check=None):
@@ -529,12 +606,14 @@ class PeerClient:
             raise self._wrap_value_error(method, e) from e
 
     def _grpc_columns_inner(self, cols: "wire.PeerColumns",
-                            timeout_s: Optional[float]):
+                            timeout_s: Optional[float], trace=None):
         """Columnar GetPeerRateLimits over gRPC: proto columns against
         the peer's GetPeerRateLimitsColumns method; an UNIMPLEMENTED
         answer from an untried peer downgrades to the classic
         per-request encoding (same guarded call — the negotiation miss
-        is not a breaker failure)."""
+        is not a breaker failure).  The trace column rides as a proto3
+        field old receivers skip as unknown — no trace negotiation on
+        this transport."""
         timeout = (
             timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
         )
@@ -543,7 +622,8 @@ class PeerClient:
             if self._columnar is not False:
                 try:
                     m = get_cols(
-                        wire.peer_columns_req_to_pb(cols), timeout=timeout
+                        wire.peer_columns_req_to_pb(cols, trace=trace),
+                        timeout=timeout,
                     )
                     self._columnar = True
                     return wire.result_from_peer_columns_pb(m)
@@ -619,20 +699,43 @@ class PeerClient:
         return json.loads(body) if body else {}
 
     def _post_columns_inner(self, cols: "wire.PeerColumns",
-                            timeout_s: Optional[float]):
+                            timeout_s: Optional[float], trace=None):
         """Columnar GetPeerRateLimits over HTTP: the binary frame
         against the same /v1/peer.GetPeerRateLimits path (the receiver
         sniffs the magic).  An old peer answers 400 (its JSON parse
         fails) — remember and resend as classic per-request JSON inside
-        the same guarded call."""
+        the same guarded call.
+
+        Trace trailer negotiation: the first SAMPLED frame to an
+        untried peer probes with the trailer attached.  A columns-
+        capable peer that predates it rejects the frame as a length
+        mismatch (400, provably not applied) — remember trailer-free
+        and resend the SAME frame without it, still inside this guarded
+        call, so the probe is breaker- and health-neutral like the
+        columns probe itself.  Unsampled traffic never probes: with
+        GUBER_TRACE_SAMPLE=0 the wire is byte-identical to pre-trace."""
         if self._columnar is not False:
-            frame = wire.encode_columns_frame(cols)
+            with_trace = bool(trace) and self._trace_frames is not False
+            frame = wire.encode_columns_frame(
+                cols, trace=trace if with_trace else None
+            )
             try:
                 body = self._http_roundtrip(
                     "/v1/peer.GetPeerRateLimits", frame, timeout_s,
                     wire.COLUMNS_CONTENT_TYPE,
                 )
             except PeerError as e:
+                if (
+                    with_trace
+                    and e.http_status == 400
+                    and "length mismatch" in str(e)
+                ):
+                    # Columns peer that predates the trace trailer: the
+                    # decode rejected the frame before applying it, so
+                    # the trailer-free resend cannot double-count.
+                    self._trace_frames = False
+                    self._clear_last_err(str(e))
+                    return self._post_columns_inner(cols, timeout_s)
                 # Downgrade when the frame was provably REJECTED, not
                 # applied (safe to resend classic): a 4xx, or the old
                 # gateway's 500 — pre-columns builds map the
@@ -650,6 +753,8 @@ class PeerClient:
                 else:
                     raise
             else:
+                if with_trace:
+                    self._trace_frames = True
                 if wire.is_columns_frame(body):
                     self._columnar = True
                     try:

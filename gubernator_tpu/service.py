@@ -23,6 +23,7 @@ from .utils.logging import category_logger
 
 import numpy as np
 
+from . import tracing
 from .config import MAX_BATCH_SIZE, BehaviorConfig
 from .faults import Backoff
 from .metrics import Metrics
@@ -106,6 +107,11 @@ class _IngressGate:
         if shed:
             if self.metrics is not None:
                 self.metrics.ingress_shed.inc(lanes)
+            # Flight-recorder event + automatic dump (tracing.py):
+            # shedding is the overload signal the recorder exists for.
+            tracing.record_event(
+                "shed", lanes=lanes, queued=queued, cap=self.cap
+            )
             raise IngressShedError(queued, self.cap)
 
     def release(self, lanes: int) -> None:
@@ -232,6 +238,10 @@ class IngressColumns:
     hits: np.ndarray  # i64[n]
     limit: np.ndarray  # i64[n]
     duration: np.ndarray  # i64[n]
+    # Wire trace-context column of a forwarded peer batch (tracing.py):
+    # (lane_lo, lane_hi, trace_id, span_id) ranges, or None.  Local
+    # ingress leaves it None — the thread's ambient context covers it.
+    trace_ctx: Optional[list] = None
 
     def __len__(self) -> int:
         return len(self.names)
@@ -710,7 +720,7 @@ class ColumnarBatcher:
         )
 
     def submit(self, keys, algo, behavior, hits, limit, duration,
-               greg_expire, greg_duration) -> "Future":
+               greg_expire, greg_duration, trace_links=None) -> "Future":
         fut: Future = Future()
         if self._window.stopped:
             fut.set_exception(PeerError(ERR_BATCHER_CLOSED))
@@ -721,6 +731,12 @@ class ColumnarBatcher:
         except IngressShedError as e:
             fut.set_exception(e)
             return fut
+        if trace_links:
+            # Per-lane span handles (tracing.py): the flush joins every
+            # submission's links into the batch.window span and the
+            # dispatch pipeline's stage spans.
+            fut._trace_links = trace_links
+            fut._trace_t = time.monotonic_ns()
         ge = np.zeros(n, np.int64) if greg_expire is None else greg_expire
         gd = np.zeros(n, np.int64) if greg_duration is None else greg_duration
         self._window.submit(
@@ -781,10 +797,18 @@ class ColumnarBatcher:
                     for i in range(1, 8)
                 )
             algo, beh, hits, limit, duration, ge, gd = arrays
-            handle = self.store.apply_columns_async(
-                keys, algo, beh, hits, limit, duration,
-                self.clock.now_ms(), ge, gd,
-            )
+            bt = self._batch_trace(batch)
+            if bt is not None:
+                tracing.stage_batch_trace(bt)
+            try:
+                handle = self.store.apply_columns_async(
+                    keys, algo, beh, hits, limit, duration,
+                    self.clock.now_ms(), ge, gd,
+                )
+            finally:
+                # A store that raised before consuming the staged trace
+                # must not leak it into this thread's next dispatch.
+                tracing.take_batch_trace()
             with self._inflight_lock:
                 self._own_inflight.append(handle)
                 # Reap resolved heads now, not just at the next flush:
@@ -802,6 +826,35 @@ class ColumnarBatcher:
             for _, fut in batch:
                 if not fut.done():
                     fut.set_exception(e)
+
+    def _batch_trace(self, batch):
+        """Join the chunk's sampled submissions into one BatchTrace and
+        record its batch.window span (start = the earliest member's
+        submit time: the span COVERS the coalescing wait, which is one
+        of the four places a slow request loses time).  None when no
+        member was sampled — the common fast path."""
+        if not tracing.enabled():
+            return None
+        links, seen, t0 = [], set(), None
+        for _, fut in batch:
+            for ctx in getattr(fut, "_trace_links", ()):
+                if (ctx.trace_id, ctx.span_id) not in seen:
+                    seen.add((ctx.trace_id, ctx.span_id))
+                    links.append(ctx)
+            ts = getattr(fut, "_trace_t", None)
+            if ts is not None and (t0 is None or ts < t0):
+                t0 = ts
+        bt = tracing.new_batch(links)
+        if bt is not None:
+            now = time.monotonic_ns()
+            tracing.record_span(
+                "batch.window", bt.ctx,
+                start_ns=t0 if t0 is not None else now, end_ns=now,
+                links=bt.links,
+                lanes=sum(len(item[0][0]) for item in batch),
+                submissions=len(batch),
+            )
+        return bt
 
     def stop(self) -> None:
         self._window.stop()
@@ -832,6 +885,9 @@ class V1Service:
             if conf.back_cache_size > 0
             else 0,
         )
+        # gubernator_build_info: version/backend/mesh labels, set once —
+        # the store's topology is fixed for the service's lifetime.
+        self.metrics.set_build_info(self.store)
         self.local_picker = conf.local_picker or ReplicatedConsistentHash()
         self.region_picker = conf.region_picker or RegionPicker()
         self._peer_mutex = threading.RLock()
@@ -1103,7 +1159,11 @@ class V1Service:
             )
             direct = bool((beh[idx] & int(Behavior.NO_BATCHING)).any())
             group_futs[addr] = self._forward_pool.submit(
-                self._forward_group_columns, remote_peers[addr], sub, direct
+                self._forward_group_columns, remote_peers[addr], sub, direct,
+                # Captured HERE: the forward runs on a pool thread with
+                # no ambient context; the peer hop carries this as the
+                # wire trace-context column (tracing.py).
+                tracing.current(),
             )
 
         # Remaining slow lanes (GLOBAL remote/local specials) ride the
@@ -1192,6 +1252,10 @@ class V1Service:
         if not fast_idx.size:
             return []
         n = len(cols)
+        # Span handles for the dispatch (tracing.py): the ambient
+        # ingress context plus any wire trace-context column a peer
+        # batch carried; [] on unsampled traffic (one branch).
+        links = tracing.request_links(cols)
 
         def dispatch(idx, direct):
             full = idx.size == n
@@ -1209,11 +1273,20 @@ class V1Service:
                 None if greg_duration is None else greg_duration[sl],
             )
             if direct:
-                handle = self.store.apply_columns_async(
-                    *args[:6], self.clock.now_ms(), *args[6:]
-                )
+                bt = tracing.new_batch(links)
+                if bt is not None:
+                    tracing.stage_batch_trace(bt)
+                try:
+                    handle = self.store.apply_columns_async(
+                        *args[:6], self.clock.now_ms(), *args[6:]
+                    )
+                finally:
+                    tracing.take_batch_trace()
                 return (handle, 0, idx.size), idx
-            return self.columnar_batcher.submit(*args), idx
+            return (
+                self.columnar_batcher.submit(*args, trace_links=links),
+                idx,
+            )
 
         nb = (beh[fast_idx] & int(Behavior.NO_BATCHING)) != 0
         if not nb.any():
@@ -1386,14 +1459,24 @@ class V1Service:
             np.array([int(r.limit)], np.int64),
             np.array([int(r.duration)], np.int64),
         )
+        cur = tracing.current()
+        links = [cur] if cur is not None else None
         if direct:
-            handle = self.store.apply_columns_async(
-                *cols, self.clock.now_ms(), ge_arr, gd_arr
-            )
+            bt = tracing.new_batch(links or [])
+            if bt is not None:
+                tracing.stage_batch_trace(bt)
+            try:
+                handle = self.store.apply_columns_async(
+                    *cols, self.clock.now_ms(), ge_arr, gd_arr
+                )
+            finally:
+                tracing.take_batch_trace()
             fut: Future = Future()
             fut.set_result((handle, 0, 1))
         else:
-            fut = self.columnar_batcher.submit(*cols, ge_arr, gd_arr)
+            fut = self.columnar_batcher.submit(
+                *cols, ge_arr, gd_arr, trace_links=links
+            )
         return _SingleLaneWait(fut)
 
     def _pick_ready_peer(self, key: str):
@@ -1405,7 +1488,8 @@ class V1Service:
         except PeerError as e:
             return None, e
 
-    def _forward_group_columns(self, peer: PeerClient, sub, direct: bool):
+    def _forward_group_columns(self, peer: PeerClient, sub, direct: bool,
+                               trace_ctx=None):
         """Forward a whole owner-group as ONE columnar sub-batch
         (riding the peer's coalescing window; `direct` bypasses it for
         NO_BATCHING groups).  Fast outcome: ("cols", result, lo, hi) —
@@ -1419,10 +1503,11 @@ class V1Service:
         try:
             if direct:
                 rc = peer.send_columns_direct(
-                    sub, timeout_s=self.conf.behaviors.batch_timeout_s
+                    sub, timeout_s=self.conf.behaviors.batch_timeout_s,
+                    trace_ctx=trace_ctx,
                 )
                 return ("cols", rc, 0, len(sub[0]))
-            fut = peer.forward_columns(sub)
+            fut = peer.forward_columns(sub, trace_ctx=trace_ctx)
             rc, lo, hi = fut.result(
                 timeout=self.conf.behaviors.batch_timeout_s + 1.0
             )
@@ -1850,11 +1935,14 @@ class V1Service:
             if errs:
                 self._health.status = UNHEALTHY
                 self._health.message = "|".join(errs)
+            from . import __version__
+
             return HealthCheckResponse(
                 status=self._health.status,
                 message=self._health.message,
                 peer_count=self._health.peer_count,
                 breaker_open_count=self._health.breaker_open_count,
+                version=__version__,
             )
 
     # ------------------------------------------------------------------

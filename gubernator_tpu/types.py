@@ -176,6 +176,10 @@ class HealthCheckResponse:
     # evaluation (faults.py).  JSON-only extension: the reference proto
     # has no such field, so the gRPC wire omits it.
     breaker_open_count: int = 0
+    # Daemon build version (gubernator_tpu.__version__).  JSON-only
+    # extension like breaker_open_count: the reference HealthCheckResp
+    # proto has no version field, so the gRPC wire omits it.
+    version: str = ""
 
     def to_json(self) -> dict:
         out = {
@@ -183,6 +187,8 @@ class HealthCheckResponse:
             "peerCount": self.peer_count,
             "breakerOpenCount": self.breaker_open_count,
         }
+        if self.version:
+            out["version"] = self.version
         if self.message:
             out["message"] = self.message
         return out
@@ -196,6 +202,7 @@ class HealthCheckResponse:
             breaker_open_count=_to_int(
                 _pick(d, "breaker_open_count", "breakerOpenCount", default=0)
             ),
+            version=d.get("version", ""),
         )
 
 

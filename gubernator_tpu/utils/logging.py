@@ -79,19 +79,38 @@ def category_logger(name: str = "") -> logging.Logger:
     return logging.getLogger(f"{CATEGORY}.{name}" if name else CATEGORY)
 
 
+class TraceContextFilter(logging.Filter):
+    """Stamps trace_id/span_id onto every record from the calling
+    thread's active trace context (tracing.py), so logs and traces join
+    on one id; "-" when no sampled trace is active.  A Filter, not a
+    LogRecordFactory: the stamp must apply only to the gubernator tree,
+    not hijack the process-global record factory."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        # Local import: tracing imports category_logger from here.
+        from .. import tracing
+
+        ctx = tracing.current() if tracing.enabled() else None
+        record.trace_id = ctx.trace_hex if ctx is not None else "-"
+        record.span_id = ctx.span_hex if ctx is not None else "-"
+        return True
+
+
 def setup_logging(debug: bool = False, stream=None) -> logging.Logger:
     """Configure the gubernator logger tree: level from the debug flag
     (GUBER_DEBUG / -debug, config.go:231-235), one structured line per
-    record."""
+    record, trace/span ids stamped when a trace context is active."""
     logger = logging.getLogger(CATEGORY)
     logger.setLevel(logging.DEBUG if debug else logging.INFO)
     if not logger.handlers:
         handler = logging.StreamHandler(stream or sys.stderr)
+        handler.addFilter(TraceContextFilter())
         handler.setFormatter(
             logging.Formatter(
                 fmt=(
                     "time=%(asctime)s level=%(levelname)s category=" + CATEGORY +
-                    " logger=%(name)s msg=%(message)s"
+                    " logger=%(name)s trace_id=%(trace_id)s"
+                    " span_id=%(span_id)s msg=%(message)s"
                 ),
                 datefmt="%Y-%m-%dT%H:%M:%S%z",
             )

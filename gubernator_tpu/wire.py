@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import json
 import struct
-from typing import Iterable, List, Sequence, Tuple
+from typing import Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -206,6 +206,68 @@ COLUMNS_CONTENT_TYPE = "application/x-gubernator-columns"
 
 _FRAME_HEADER_LEN = 10  # magic(4) + version(1) + kind(1) + n(4)
 
+# Optional trace-context trailer on a request frame (tracing.py): after
+# the seven columns, `TRACE_MAGIC | u32 n_entries | n_entries * 32B`
+# where each entry is `<II` lane_lo, lane_hi (exclusive) + 16B trace id
+# + 8B span id (big-endian, the traceparent byte order).  Entries are
+# lane RANGES because a coalesced RPC's lanes arrive as contiguous
+# per-ingress-batch runs that share one context.  A frame without the
+# trailer is byte-identical to the pre-trace layout (the
+# GUBER_TRACE_SAMPLE=0 wire-parity contract); receivers that predate
+# the trailer reject it as a length mismatch, which the sender treats
+# as a version answer and renegotiates (peer_client._post_columns_inner).
+TRACE_MAGIC = b"GTRC"
+_TRACE_ENTRY_LEN = 32
+
+# (lane_lo, lane_hi, trace_id 128-bit int, span_id 64-bit int)
+TraceEntry = Tuple[int, int, int, int]
+
+
+def _pack_trace_entry(entry: TraceEntry) -> bytes:
+    """THE 32-byte entry layout, shared by the frame trailer and the
+    proto column (one codec: a format change lands everywhere)."""
+    lo, hi, tid, sid = entry
+    return (
+        struct.pack("<II", lo, hi)
+        + int(tid).to_bytes(16, "big")
+        + int(sid).to_bytes(8, "big")
+    )
+
+
+def _unpack_trace_entry(raw: bytes, pos: int = 0) -> TraceEntry:
+    lo, hi = struct.unpack_from("<II", raw, pos)
+    return (
+        lo, hi,
+        int.from_bytes(raw[pos + 8:pos + 24], "big"),
+        int.from_bytes(raw[pos + 24:pos + 32], "big"),
+    )
+
+
+def pack_trace_entries(entries: Sequence[TraceEntry]) -> bytes:
+    parts = [TRACE_MAGIC, struct.pack("<I", len(entries))]
+    parts.extend(_pack_trace_entry(e) for e in entries)
+    return b"".join(parts)
+
+
+def unpack_trace_entries(raw: bytes, pos: int) -> Tuple[list, int]:
+    """Parse a trace trailer at `pos`; raises ValueError when
+    malformed/truncated (the decode edge maps it to a 400)."""
+    if raw[pos:pos + 4] != TRACE_MAGIC:
+        raise ValueError("columns frame length mismatch")
+    pos += 4
+    try:
+        (count,) = struct.unpack_from("<I", raw, pos)
+    except struct.error:
+        raise ValueError("trace trailer truncated") from None
+    pos += 4
+    if pos + count * _TRACE_ENTRY_LEN > len(raw):
+        raise ValueError("trace trailer truncated")
+    entries = []
+    for _ in range(count):
+        entries.append(_unpack_trace_entry(raw, pos))
+        pos += _TRACE_ENTRY_LEN
+    return entries, pos
+
 
 def is_columns_frame(raw: bytes) -> bool:
     return len(raw) >= _FRAME_HEADER_LEN and raw[:4] == FRAME_MAGIC
@@ -229,9 +291,13 @@ def _read_array(raw: bytes, pos: int, dtype, n: int):
     return arr, pos + arr.nbytes
 
 
-def encode_columns_frame(cols: PeerColumns) -> bytes:
+def encode_columns_frame(
+    cols: PeerColumns, trace: "Optional[Sequence[TraceEntry]]" = None
+) -> bytes:
     """PeerColumns -> binary request frame (see architecture.md for the
-    byte-level spec)."""
+    byte-level spec).  `trace` (sampled lanes' contexts) appends the
+    optional trace trailer; None/empty keeps the frame byte-identical
+    to the pre-trace layout."""
     names, uks, algo, beh, hits, limit, duration = cols
     n = len(names)
     parts = [
@@ -245,6 +311,8 @@ def encode_columns_frame(cols: PeerColumns) -> bytes:
         np.ascontiguousarray(limit, dtype=np.int64).tobytes(),
         np.ascontiguousarray(duration, dtype=np.int64).tobytes(),
     ]
+    if trace:
+        parts.append(pack_trace_entries(trace))
     return b"".join(parts)
 
 
@@ -311,9 +379,11 @@ class FrameIngressColumns:
     dataclasses (GLOBAL / MULTI_REGION / slow legs)."""
 
     __slots__ = ("algorithm", "behavior", "hits", "limit", "duration",
-                 "_n", "_nb", "_no", "_ub", "_uo", "_names", "_uks")
+                 "_n", "_nb", "_no", "_ub", "_uo", "_names", "_uks",
+                 "trace_ctx")
 
-    def __init__(self, n, nb, no, ub, uo, algo, beh, hits, limit, duration):
+    def __init__(self, n, nb, no, ub, uo, algo, beh, hits, limit, duration,
+                 trace_ctx=None):
         self._n = n
         self._nb, self._no = nb, no
         self._ub, self._uo = ub, uo
@@ -324,6 +394,9 @@ class FrameIngressColumns:
         self.duration = duration
         self._names = None
         self._uks = None
+        # Wire trace-context column (lane ranges -> trace/span ids);
+        # consumed by tracing.request_links on the owner's dispatch.
+        self.trace_ctx = trace_ctx
 
     def __len__(self) -> int:
         return self._n
@@ -390,17 +463,24 @@ def decode_columns_frame(raw: bytes):
     hits, pos = _read_array(raw, pos, np.int64, n)
     limit, pos = _read_array(raw, pos, np.int64, n)
     duration, pos = _read_array(raw, pos, np.int64, n)
+    trace_ctx = None
     if pos != len(raw):
-        raise ValueError("columns frame length mismatch")
+        # The only legal continuation is the trace-context trailer
+        # (tracing.py); anything else is still a length mismatch.
+        trace_ctx, pos = unpack_trace_entries(raw, pos)
+        if pos != len(raw):
+            raise ValueError("columns frame length mismatch")
     if native.available():
         return FrameIngressColumns(
-            n, nb, no, ub, uo, algo, beh, hits, limit, duration
+            n, nb, no, ub, uo, algo, beh, hits, limit, duration,
+            trace_ctx=trace_ctx,
         )
     return IngressColumns(
         names=[nb[no[i]:no[i + 1]].decode("utf-8") for i in range(n)],
         unique_keys=[ub[uo[i]:uo[i + 1]].decode("utf-8") for i in range(n)],
         algorithm=algo, behavior=beh,
         hits=hits, limit=limit, duration=duration,
+        trace_ctx=trace_ctx,
     )
 
 
@@ -465,7 +545,9 @@ def decode_result_frame(raw: bytes):
 
 
 # -- proto columns (gRPC transport) ------------------------------------
-def peer_columns_req_to_pb(cols: PeerColumns) -> pc_pb.PeerColumnsReq:
+def peer_columns_req_to_pb(
+    cols: PeerColumns, trace: "Optional[Sequence[TraceEntry]]" = None
+) -> pc_pb.PeerColumnsReq:
     names, uks, algo, beh, hits, limit, duration = cols
     m = pc_pb.PeerColumnsReq()
     m.names.extend(names)
@@ -475,7 +557,21 @@ def peer_columns_req_to_pb(cols: PeerColumns) -> pc_pb.PeerColumnsReq:
     m.hits.extend(np.asarray(hits, dtype=np.int64).tolist())
     m.limit.extend(np.asarray(limit, dtype=np.int64).tolist())
     m.duration.extend(np.asarray(duration, dtype=np.int64).tolist())
+    if trace:
+        # One 32-byte packed entry per field element; proto3 receivers
+        # that predate the field skip it as an unknown field (that IS
+        # the negotiation: no probe needed on this transport).
+        m.trace.extend(_pack_trace_entry(e) for e in trace)
     return m
+
+
+def _trace_entries_from_pb(m) -> "Optional[list]":
+    entries = [
+        _unpack_trace_entry(raw)
+        for raw in getattr(m, "trace", ())
+        if len(raw) == _TRACE_ENTRY_LEN  # skip foreign/corrupt entries
+    ]
+    return entries or None
 
 
 def ingress_from_peer_columns_pb(m: pc_pb.PeerColumnsReq):
@@ -490,6 +586,7 @@ def ingress_from_peer_columns_pb(m: pc_pb.PeerColumnsReq):
         hits=np.fromiter(m.hits, np.int64, count=n),
         limit=np.fromiter(m.limit, np.int64, count=n),
         duration=np.fromiter(m.duration, np.int64, count=n),
+        trace_ctx=_trace_entries_from_pb(m),
     )
 
 

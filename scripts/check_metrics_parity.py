@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Lint exported Prometheus metric names against the golden list.
+
+Two classes of names, two rules:
+
+* REFERENCE_PARITY — names ported verbatim from the reference
+  Gubernator so its dashboards/alerts work unchanged (metrics.py
+  module docstring).  FROZEN: renaming or dropping one silently breaks
+  every deployed dashboard, so a diff here fails the build until the
+  golden list is updated in the same reviewed change.
+
+* EXTENSIONS — names this project added (fault tolerance, columnar
+  hop, dispatch pipeline, tracing).  New names are allowed only by
+  editing this list — i.e. every new exported series passes review
+  here instead of appearing silently.
+
+Exit 0 on exact match, 1 with a readable diff otherwise.  Wired into
+`make tier1` and covered by tests/test_metrics_parity.py so the
+ROADMAP verify command exercises it too.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# Runnable as `python scripts/check_metrics_parity.py` from the repo
+# root without an installed package.
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# Names as prometheus_client reports them at collect() time (counters
+# WITHOUT the _total suffix).
+REFERENCE_PARITY = frozenset(
+    {
+        "gubernator_cache_size",            # cache.go:88-92
+        "gubernator_cache_access_count",    # cache.go:205-218
+        "gubernator_grpc_request_counts",   # grpc_stats.go:45-51
+        "gubernator_grpc_request_duration", # grpc_stats.go:52-59
+        "gubernator_async_durations",       # global.go:40-48
+        "gubernator_broadcast_durations",   # global.go:49-56
+    }
+)
+
+EXTENSIONS = frozenset(
+    {
+        # PR 1: peer fault tolerance
+        "gubernator_circuit_breaker_state",
+        "gubernator_circuit_breaker_transitions",
+        "gubernator_peer_retry_count",
+        "gubernator_degraded_local_evals",
+        # PR 2: columnar peer hop
+        "gubernator_peer_columns_batches",
+        # PR 3: bounded ingress + dispatch pipeline
+        "gubernator_ingress_shed",
+        "gubernator_dispatch_inflight",
+        "gubernator_dispatch_inflight_hwm",
+        "gubernator_dispatch_stage_seconds",
+        # PR 4: observability
+        "gubernator_build_info",
+        "gubernator_request_duration_seconds",
+    }
+)
+
+GOLDEN = REFERENCE_PARITY | EXTENSIONS
+
+
+def main() -> int:
+    from gubernator_tpu.metrics import Metrics
+
+    exported = {fam.name for fam in Metrics().registry.collect()}
+    missing = sorted(GOLDEN - exported)
+    unexpected = sorted(exported - GOLDEN)
+    if not missing and not unexpected:
+        print(f"metrics parity OK ({len(exported)} families)")
+        return 0
+    if missing:
+        frozen = sorted(set(missing) & REFERENCE_PARITY)
+        print("MISSING metric families (golden names not exported):")
+        for name in missing:
+            tag = "REFERENCE-PARITY, FROZEN" if name in frozen else "extension"
+            print(f"  - {name}  [{tag}]")
+    if unexpected:
+        print("UNEXPECTED metric families (new names need review here):")
+        for name in unexpected:
+            print(f"  + {name}")
+        print(
+            "add intentionally-new names to EXTENSIONS in "
+            "scripts/check_metrics_parity.py"
+        )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

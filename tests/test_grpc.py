@@ -244,7 +244,12 @@ def _peer_rpc_count(daemon) -> float:
     for metric in daemon.service.metrics.registry.collect():
         if metric.name == "gubernator_grpc_request_counts":
             for s in metric.samples:
-                if s.labels.get("method") in (
+                # _total only: the family also emits a _created sample
+                # (a unix timestamp) per labelset, which must not be
+                # summed as if it were a request count — it made this
+                # helper order-dependent (correct only when an earlier
+                # test had already created the owner's labelset).
+                if s.name.endswith("_total") and s.labels.get("method") in (
                     "/pb.gubernator.PeersV1/GetPeerRateLimits",
                     "/pb.gubernator.PeersV1/GetPeerRateLimitsColumns",
                 ):

@@ -1,0 +1,38 @@
+"""scripts/check_metrics_parity.py — the metric-name lint `make tier1`
+runs — must pass against the live registry, and must actually FAIL on
+a drifted registry (a lint that cannot fail guards nothing)."""
+
+import os
+import subprocess
+import sys
+
+SCRIPT = os.path.join(
+    os.path.dirname(__file__), "..", "scripts", "check_metrics_parity.py"
+)
+
+
+def test_parity_script_passes():
+    out = subprocess.run(
+        [sys.executable, SCRIPT],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "metrics parity OK" in out.stdout
+
+
+def test_parity_module_detects_unexpected_name():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    try:
+        import check_metrics_parity as parity
+    finally:
+        sys.path.pop(0)
+    # A registry with an unreviewed extra family must fail the lint.
+    from prometheus_client import Counter
+
+    from gubernator_tpu.metrics import Metrics
+
+    m = Metrics()
+    Counter("gubernator_surprise_total", "drift", registry=m.registry)
+    exported = {fam.name for fam in m.registry.collect()}
+    assert exported - parity.GOLDEN == {"gubernator_surprise"}
