@@ -675,6 +675,178 @@ def measure_peer_forward(mode: str = "columns", n_threads: int = 8,
         entry.close()
 
 
+def measure_global_plane(mode: str = "columns", n_threads: int = 2,
+                         iters: int = 3, batch: int = 512):
+    """Loopback GLOBAL replication-plane throughput: the receiver
+    daemon runs in its OWN process (own GIL, as in production — the
+    measure_peer_forward technique) and this process plays the owner's
+    GlobalManager, driving both host-tier legs against it:
+
+      * broadcast — UpdatePeerGlobals of `batch` keys per send.
+        "columns": a fresh wire.BroadcastBatch per send (the per-tick
+        encode; the encode-ONCE win is across peers) negotiated onto
+        the columnar wire, committed by the receiver as ONE replica
+        scatter.  "classic": the legacy per-item encoding against a
+        GUBER_GLOBAL_COLUMNS=0 receiver — per-item wire AND one replica
+        dispatch per item, the whole pre-columns plane.
+      * forwarded hits — `batch` GLOBAL lanes per GetPeerRateLimits
+        send, columnar vs classic per-request encoding.
+
+    Both daemons CPU-pinned (wire/dispatch cost, not device weather).
+    Returns a dict with broadcast_items_per_sec, forwarded_hits_per_sec
+    and the combined plane_items_per_sec (total items over the two
+    legs' best-epoch wall time) that the same-run
+    global_plane_vs_classic gate ratio uses."""
+    import os
+    import signal
+    import socket
+    import subprocess
+    import threading
+
+    from gubernator_tpu import wire
+    from gubernator_tpu.config import BehaviorConfig
+    from gubernator_tpu.parallel.global_mgr import GlobalsColumns
+    from gubernator_tpu.peer_client import PeerClient
+    from gubernator_tpu.types import (
+        Behavior,
+        GetRateLimitsRequest,
+        PeerInfo,
+        RateLimitRequest,
+    )
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    columns = mode == "columns"
+    owner_http, owner_grpc = free_port(), free_port()
+    env = dict(os.environ)
+    env.update(
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        JAX_PLATFORMS="cpu",
+        JAX_COMPILATION_CACHE_DIR=os.path.join(os.getcwd(), ".jax_cache"),
+        GUBER_HTTP_ADDRESS=f"127.0.0.1:{owner_http}",
+        GUBER_GRPC_ADDRESS=f"127.0.0.1:{owner_grpc}",
+        GUBER_STATIC_PEERS=f"127.0.0.1:{owner_grpc}|127.0.0.1:{owner_http}",
+        GUBER_GLOBAL_COLUMNS="1" if columns else "0",
+        GUBER_PEER_COLUMNS="1" if columns else "0",
+        GUBER_GLOBAL_SYNC_WAIT="3600s",
+        GUBER_MULTI_REGION_SYNC_WAIT="3600s",
+        GUBER_BATCH_TIMEOUT="30s",
+        GUBER_CACHE_SIZE="8192",
+        GUBER_GLOBAL_CACHE_SIZE="4096",
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gubernator_tpu.cmd.server"],
+        stdout=subprocess.PIPE, text=True, env=env, cwd=os.getcwd(),
+    )
+    client = None
+    try:
+        line = proc.stdout.readline()
+        if "listening" not in line:
+            raise RuntimeError(f"receiver daemon failed to start: {line!r}")
+        behaviors = BehaviorConfig(
+            batch_timeout_s=30.0,
+            peer_columns=columns,
+            global_columns=columns,
+        )
+        client = PeerClient(
+            PeerInfo(
+                grpc_address=f"127.0.0.1:{owner_grpc}",
+                http_address=f"127.0.0.1:{owner_http}",
+            ),
+            behaviors,
+        )
+        now = int(time.time() * 1000)
+        bcols = GlobalsColumns(
+            keys=[f"gp_bench:{i}" for i in range(batch)],
+            algorithm=np.zeros(batch, np.int32),
+            status=np.zeros(batch, np.int32),
+            limit=np.full(batch, 1_000_000, np.int64),
+            remaining=np.full(batch, 999_999, np.int64),
+            reset_time=np.full(batch, now + 3_600_000, np.int64),
+        )
+        # Classic leg sends the EXACT pre-columns payloads: the
+        # dataclass list through the legacy per-item API (the sync pass
+        # built these once per tick pre-PR too).
+        updates = bcols.to_updates()
+        hit_pc = (
+            ["gp"] * batch,
+            [f"bench:{i}" for i in range(batch)],
+            np.zeros(batch, np.int32),
+            np.full(batch, int(Behavior.GLOBAL), np.int32),
+            np.ones(batch, np.int64),
+            np.full(batch, 1_000_000, np.int64),
+            np.full(batch, 3_600_000, np.int64),
+        )
+        hit_reqs = GetRateLimitsRequest(
+            requests=[
+                RateLimitRequest(
+                    name="gp", unique_key=f"bench:{i}", hits=1,
+                    limit=1_000_000, duration=3_600_000,
+                    behavior=Behavior.GLOBAL,
+                )
+                for i in range(batch)
+            ]
+        )
+
+        def send_broadcast():
+            if columns:
+                client.update_peer_globals_batch(
+                    wire.BroadcastBatch(bcols), timeout_s=30.0
+                )
+            else:
+                client.update_peer_globals(updates, timeout_s=30.0)
+
+        def send_hits():
+            if columns:
+                client.send_columns_direct(hit_pc, timeout_s=30.0)
+            else:
+                client.get_peer_rate_limits(hit_reqs, timeout_s=30.0)
+
+        def run_leg(send, epochs: int = 3):
+            def worker():
+                for _ in range(iters):
+                    send()
+
+            send()  # warm: negotiation + receiver pad-bucket compiles
+            best_rate, best_dt = 0.0, float("inf")
+            for _ in range(epochs):
+                ts = [
+                    threading.Thread(target=worker) for _ in range(n_threads)
+                ]
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                dt = time.perf_counter() - t0
+                rate = batch * iters * n_threads / dt
+                if rate > best_rate:
+                    best_rate, best_dt = rate, dt
+            return best_rate, best_dt
+
+        bc_rate, bc_dt = run_leg(send_broadcast)
+        hit_rate, hit_dt = run_leg(send_hits)
+        total = 2 * batch * iters * n_threads
+        return {
+            "broadcast_items_per_sec": bc_rate,
+            "forwarded_hits_per_sec": hit_rate,
+            "plane_items_per_sec": total / (bc_dt + hit_dt),
+        }
+    finally:
+        if client is not None:
+            client.shutdown(timeout_s=2.0)
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 GATE_THRESHOLDS = "benchmarks/gate_thresholds.json"
 LAST_DEVICE_ROWS = "benchmarks/last_device_rows.json"
 
@@ -790,6 +962,22 @@ def gate() -> int:
             f"device_us_b{sb}": dev["small_batch_us"][sb][3]
             for sb in (256, 1024)
         }
+    if "global_plane_vs_classic" not in rows:
+        try:
+            gp_cols = measure_global_plane("columns")
+            gp_classic = measure_global_plane("classic")
+            rows["global_plane_vs_classic"] = gp_cols[
+                "plane_items_per_sec"
+            ] / max(gp_classic["plane_items_per_sec"], 1.0)
+            print(
+                "gate global plane rows: columnar "
+                f"bc {gp_cols['broadcast_items_per_sec']:.0f}/s "
+                f"hits {gp_cols['forwarded_hits_per_sec']:.0f}/s; classic "
+                f"bc {gp_classic['broadcast_items_per_sec']:.0f}/s "
+                f"hits {gp_classic['forwarded_hits_per_sec']:.0f}/s"
+            )
+        except Exception as e:  # noqa: BLE001 — two-daemon spawn can fail
+            print(f"gate global_plane_vs_classic: SKIP (measure failed: {e})")
     # Tracing overhead is a SAME-RUN ratio by definition (both halves
     # back-to-back in this process), so it never reuses saved rows.
     try:
@@ -944,6 +1132,13 @@ def main():
     peer_forward_cps = measure_peer_forward("columns")
     peer_forward_classic_cps = measure_peer_forward("classic")
 
+    # ---- GLOBAL replication plane: loopback broadcast + hit forward --
+    global_plane = measure_global_plane("columns")
+    global_plane_classic = measure_global_plane("classic")
+    global_plane_ratio = global_plane["plane_items_per_sec"] / max(
+        global_plane_classic["plane_items_per_sec"], 1.0
+    )
+
     # Re-save with the ingress + peer-forward rows so --gate covers
     # end-to-end service-path regressions, not just the device kernel
     # (round-4 verdict: the headline regressed ungated across rounds).
@@ -953,6 +1148,7 @@ def main():
         "peer_forward_vs_classic": (
             peer_forward_cps / max(peer_forward_classic_cps, 1.0)
         ),
+        "global_plane_vs_classic": global_plane_ratio,
         "dispatch_overlap_ratio": dispatch_overlap_ratio,
     })
 
@@ -1000,6 +1196,19 @@ def main():
                 "peer_forward_vs_classic": round(
                     peer_forward_cps / max(peer_forward_classic_cps, 1.0), 2
                 ),
+                "global_broadcast_items_per_sec": round(
+                    global_plane["broadcast_items_per_sec"], 1
+                ),
+                "global_forwarded_hits_per_sec": round(
+                    global_plane["forwarded_hits_per_sec"], 1
+                ),
+                "global_broadcast_classic_items_per_sec": round(
+                    global_plane_classic["broadcast_items_per_sec"], 1
+                ),
+                "global_forwarded_hits_classic_per_sec": round(
+                    global_plane_classic["forwarded_hits_per_sec"], 1
+                ),
+                "global_plane_vs_classic": round(global_plane_ratio, 2),
                 "batch_size": batch_size,
                 "batch_latency_ms_median": round(batch_latency_ms, 2),
                 "device_batch_us": round(device_batch_us, 1),

@@ -72,6 +72,22 @@ class BehaviorConfig:
     # (cluster.py uses 50ms, mirroring cluster/cluster.go:104-110).
     global_sync_wait_s: Optional[float] = None
     global_batch_limit: int = 1000
+    # Columnar GLOBAL replication plane (architecture.md "GLOBAL
+    # plane"): broadcasts travel as one GlobalsColumns batch (proto
+    # columns on gRPC, the GUBC globals frame on HTTP), encoded once
+    # per tick and committed by the receiver in one device program;
+    # forwarded GLOBAL hits ride the columnar GetPeerRateLimits path.
+    # False disables BOTH directions — the daemon sends per-item
+    # classic encodings, serves no columnar globals surface, and
+    # commits received broadcasts per item, behaving exactly like a
+    # pre-columns peer (wire- and dispatch-identical; the interop mode).
+    # Env: GUBER_GLOBAL_COLUMNS.
+    global_columns: bool = True
+    # Broadcast fan-out concurrency: the GlobalManager sends one
+    # sync pass's broadcasts to all peers through a pool of this many
+    # workers, so tick wall-time stops scaling as peers x RTT (the
+    # pre-columns sender fanned out serially).  Env: GUBER_GLOBAL_FANOUT.
+    global_fanout: int = 8
 
     multi_region_timeout_s: float = 0.5
     multi_region_sync_wait_s: float = 0.1
@@ -382,6 +398,10 @@ def setup_daemon_config(
     )
     if b.global_batch_limit > MAX_BATCH_SIZE:
         raise ValueError(f"GUBER_GLOBAL_BATCH_LIMIT cannot exceed '{MAX_BATCH_SIZE}'")
+    b.global_columns = _env_bool(merged, "GUBER_GLOBAL_COLUMNS", b.global_columns)
+    b.global_fanout = _env_int(merged, "GUBER_GLOBAL_FANOUT", b.global_fanout)
+    if b.global_fanout < 1:
+        raise ValueError("GUBER_GLOBAL_FANOUT must be >= 1")
     b.multi_region_timeout_s = _env_float_ms(
         merged, "GUBER_MULTI_REGION_TIMEOUT", b.multi_region_timeout_s
     )

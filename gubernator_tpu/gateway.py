@@ -304,6 +304,20 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
             with service.metrics.observe_rpc(
                 "/pb.gubernator.PeersV1/UpdatePeerGlobals"
             ):
+                if service.serves_global_columns and wire.is_globals_frame(raw):
+                    # Columnar GLOBAL broadcast: GUBC globals frame in,
+                    # ONE batched replica commit.  A daemon with the
+                    # plane off never reaches here — the json.loads
+                    # below rejects the frame exactly like a
+                    # pre-columns build (the sender's version answer).
+                    try:
+                        cols = wire.decode_globals_frame(raw)
+                    except ValueError as e:
+                        raise ApiError(
+                            "InvalidArgument", f"invalid globals frame: {e}"
+                        ) from e
+                    service.update_peer_globals_columns(cols)
+                    return 200, "application/json", b"{}"
                 body = json.loads(raw) if raw else {}
                 updates = [
                     UpdatePeerGlobal.from_json(u)

@@ -247,6 +247,20 @@ def _peers_v1_handler(service: V1Service) -> grpc.GenericRpcHandler:
         service.update_peer_globals(wire.update_globals_req_from_pb(request))
         return peers_pb.UpdatePeerGlobalsResp()
 
+    def update_peer_globals_columns(
+        request: pc_pb.GlobalsColumnsReq, context
+    ) -> peers_pb.UpdatePeerGlobalsResp:
+        """Columnar GLOBAL broadcast receive (peers_columns.proto
+        GlobalsColumnsReq): the whole batch decodes into arrays and
+        commits as ONE replica scatter (store.set_replica_batch)."""
+        try:
+            service.update_peer_globals_columns(
+                wire.globals_cols_from_pb(request)
+            )
+            return peers_pb.UpdatePeerGlobalsResp()
+        except ApiError as e:
+            _abort_api_error(context, e)
+
     methods = {
         "GetPeerRateLimits": grpc.unary_unary_rpc_method_handler(
             get_peer_rate_limits,
@@ -269,5 +283,16 @@ def _peers_v1_handler(service: V1Service) -> grpc.GenericRpcHandler:
             get_peer_rate_limits_columns,
             request_deserializer=pc_pb.PeerColumnsReq.FromString,
             response_serializer=pc_pb.PeerColumnsResp.SerializeToString,
+        )
+    if service.serves_global_columns:
+        # Same advertisement rule as the forward hop, on its own knob
+        # (V1Service.serves_global_columns): GUBER_GLOBAL_COLUMNS=0
+        # withholds the method so senders see UNIMPLEMENTED — exactly
+        # what a pre-columns daemon answers — and fall back to the
+        # classic per-item UpdatePeerGlobals.
+        methods["UpdatePeerGlobalsColumns"] = grpc.unary_unary_rpc_method_handler(
+            update_peer_globals_columns,
+            request_deserializer=pc_pb.GlobalsColumnsReq.FromString,
+            response_serializer=peers_pb.UpdatePeerGlobalsResp.SerializeToString,
         )
     return grpc.method_handlers_generic_handler(PEERS_V1_SERVICE, methods)

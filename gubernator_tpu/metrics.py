@@ -134,6 +134,36 @@ class Metrics:
             ["encoding"],
             registry=self.registry,
         )
+        # -- columnar GLOBAL replication plane (service.GlobalManager) -
+        self.global_broadcast_batches = Counter(
+            "gubernator_global_broadcast_batches",
+            "GLOBAL broadcast sends by negotiated wire encoding "
+            "(columns = encode-once GlobalsColumns fast path, classic "
+            "= per-item JSON/protobuf fallback to a pre-columns peer).",
+            ["encoding"],
+            registry=self.registry,
+        )
+        self.global_fanout_concurrency = Gauge(
+            "gubernator_global_fanout_concurrency",
+            "Concurrent peer sends of the last GLOBAL broadcast "
+            "fan-out (bounded by GUBER_GLOBAL_FANOUT).",
+            registry=self.registry,
+        )
+        self.global_requeued_hits = Counter(
+            "gubernator_global_requeued_hits",
+            "Aggregated GLOBAL hit lanes (one per key) requeued into "
+            "the next sync tick after an unroutable owner or a "
+            "provably-unapplied send failure (the pre-columns sender "
+            "silently dropped these).",
+            registry=self.registry,
+        )
+        self.global_dropped_hits = Counter(
+            "gubernator_global_dropped_hits",
+            "Aggregated GLOBAL hit lanes dropped: timeout-shaped send "
+            "failures that may have applied server-side (requeueing "
+            "would double-count) or requeue-carry overflow.",
+            registry=self.registry,
+        )
         # -- bounded ingress queue (service._IngressGate) --------------
         self.ingress_shed = Counter(
             "gubernator_ingress_shed_total",

@@ -25,7 +25,7 @@ the host tier in both designs.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass
 import threading
 from functools import partial
 from typing import List, Optional, Sequence
@@ -60,7 +60,7 @@ from ..types import (
     has_behavior,
 )
 from ..utils import hashing
-from .global_mgr import GlobalKeyTable
+from .global_mgr import GlobalKeyTable, GlobalsColumns, HitColumns
 
 try:
     from jax import shard_map  # jax >= 0.6
@@ -71,6 +71,17 @@ except ImportError:  # pragma: no cover
 def shard_of_key(key: str, n_shards: int) -> int:
     """Static shardmap: fnv1a-64 of the hash key, modulo shard count."""
     return hashing.hash_string_64(key) % n_shards
+
+
+def _pad_pow2(n: int, floor: int = 8) -> int:
+    """Own pow2 size buckets (>= floor) for variable-length index
+    arrays handed to jitted programs: every distinct shape is its own
+    XLA compile, so unpadded tick-to-tick sizes would recompile inside
+    the store lock."""
+    m = floor
+    while m < n:
+        m <<= 1
+    return m
 
 
 @partial(jax.jit, donate_argnums=(0, 1))
@@ -372,18 +383,37 @@ class _MeshPrep:
 
 @dataclass
 class SyncResult:
-    """Host-tier work produced by one GLOBAL sync collective."""
+    """Host-tier work produced by one GLOBAL sync collective.
 
-    broadcasts: List[UpdatePeerGlobal] = field(default_factory=list)
-    remote_hits: List[RateLimitRequest] = field(default_factory=list)
+    Both legs come back in COLUMN form, emitted straight from the sync
+    decode arrays (no per-key dataclasses): `broadcast_cols` feeds the
+    encode-once UpdatePeerGlobals fan-out, `remote_hit_cols` rides the
+    columnar GetPeerRateLimits forward.  The dataclass views
+    (`broadcasts` / `remote_hits`) materialize lazily for tests and the
+    classic legs."""
+
+    broadcast_cols: Optional[GlobalsColumns] = None
+    remote_hit_cols: Optional[HitColumns] = None
     # False only for the empty early return (no active gslots, nothing
     # dirty): such passes never ran the collective, so observers tuning
     # windows from sync cost must ignore them.
     did_work: bool = True
 
     @property
+    def broadcasts(self) -> List[UpdatePeerGlobal]:
+        if self.broadcast_cols is None:
+            return []
+        return self.broadcast_cols.to_updates()
+
+    @property
+    def remote_hits(self) -> List[RateLimitRequest]:
+        if self.remote_hit_cols is None:
+            return []
+        return self.remote_hit_cols.to_requests()
+
+    @property
     def broadcast_count(self) -> int:
-        return len(self.broadcasts)
+        return 0 if self.broadcast_cols is None else len(self.broadcast_cols)
 
 
 class MeshBucketStore(ColumnarPipeline):
@@ -467,6 +497,10 @@ class MeshBucketStore(ColumnarPipeline):
         )
         self.gtable = GlobalKeyTable(g_capacity)
         self.dirty = np.zeros((self.n_shards, g_capacity), dtype=bool)
+        # Device programs dispatched by replica-batch commits — the
+        # O(1)-dispatch-per-broadcast contract is pinned by counting,
+        # not timing (tests/test_global_plane.py).
+        self.replica_commit_dispatches = 0
 
         self._sharding = NamedSharding(self.mesh, P(self.axis))
         # Wire donation (launch stage): accelerators copy uploads, so
@@ -512,14 +546,7 @@ class MeshBucketStore(ColumnarPipeline):
         if max_p == 0 and max_d == 0:
             return
         S = self.n_shards
-
-        def _pad(n):  # own pow2 buckets (>=8) to bound recompiles
-            m = 8
-            while m < n:
-                m <<= 1
-            return m
-
-        pp, dp = _pad(max_p), _pad(max_d)
+        pp, dp = _pad_pow2(max_p), _pad_pow2(max_d)
         pk = np.zeros((S, pp), dtype=np.int32)
         ps = np.full((S, pp), -1, dtype=np.int32)
         pd = np.zeros((S, pp), dtype=np.int32)
@@ -986,25 +1013,87 @@ class MeshBucketStore(ColumnarPipeline):
         return items
 
     # ------------------------------------------------------------------
-    @_locked
     def set_replica(self, update, now_ms: int) -> None:
         """Receive side of UpdatePeerGlobals (gubernator.go:259-272):
         store the owner daemon's authoritative status in the replica
-        columns, expiring at ResetTime."""
-        g, evicted = self.gtable.lookup_or_assign(update.key, -1)
-        if evicted is not None:
-            self.gcols = self._clear_fn(self.gcols, np.array([evicted], np.int32))
-        st = update.status
-        self.gcols = self._set_replica_fn(
-            self.gcols,
-            np.array([g], np.int32),
-            np.array([int(st.status)], np.int32),
-            np.array([st.limit], np.int64),
-            np.array([st.remaining], np.int64),
-            np.array([st.reset_time], np.int64),
+        columns, expiring at ResetTime.  One code path with the batch
+        receive: a single update is a 1-lane batch."""
+        self.set_replica_batch(GlobalsColumns.from_updates([update]), now_ms)
+
+    @_locked
+    def set_replica_batch(self, cols: "GlobalsColumns", now_ms: int) -> None:
+        """Batched receive side of UpdatePeerGlobals: decode the WHOLE
+        broadcast into arrays and commit it with ONE gather/scatter
+        device program (plus one clear program when assignments evicted
+        gslots) and one vectorized host-mirror update — an N-item
+        broadcast costs O(1) device dispatches, not N (the pre-columns
+        receiver paid a full dispatch/readback RTT per item,
+        `replica_commit_dispatches` counts the programs for the tests
+        that pin this)."""
+        n = len(cols)
+        if n == 0:
+            return
+        gslots = np.empty(n, dtype=np.int64)
+        evicted: List[int] = []
+        for i, k in enumerate(cols.keys):
+            g, ev = self.gtable.lookup_or_assign(k, -1)
+            if ev is not None:
+                evicted.append(ev)
+            gslots[i] = g
+        # Keep only lanes whose key STILL maps to its gslot: a lane can
+        # go stale when a later assignment in this same batch recycled
+        # its gslot under capacity pressure; and duplicate keys keep the
+        # LAST lane (dict semantics of the per-item loop this replaces).
+        keep = np.fromiter(
+            (
+                self.gtable._key_to_gslot.get(k) == int(g)  # noqa: SLF001
+                for k, g in zip(cols.keys, gslots)
+            ),
+            dtype=bool, count=n,
         )
-        self.gtable.rep_expire[g] = st.reset_time
-        self.gtable.algorithm[g] = int(update.algorithm)
+        idx = np.nonzero(keep)[0]
+        if idx.size > 1:
+            g_kept = gslots[idx]
+            _, last_rev = np.unique(g_kept[::-1], return_index=True)
+            idx = idx[(idx.size - 1) - last_rev]
+        if evicted:
+            # Zero recycled rows BEFORE the scatter: a slot evicted and
+            # reassigned within this batch gets its new values next.
+            # Padded to pow2 buckets with out-of-range indices (clear's
+            # mode="drop" ignores them) so varying eviction counts stay
+            # within a handful of compiled shapes.
+            ev = sorted(set(evicted))
+            ev_a = np.full(_pad_pow2(len(ev)), self.g_capacity, np.int32)
+            ev_a[: len(ev)] = ev
+            self.gcols = self._clear_fn(self.gcols, ev_a)
+            self.replica_commit_dispatches += 1
+        if not idx.size:
+            return
+        m = idx.size
+        pad = _pad_pow2(m)
+        # Pad the scatter to pow2 shape buckets: gslot -1 lanes are
+        # dropped inside set_replica, so broadcasts of any size share
+        # ~log2(g_capacity) compiled programs instead of one per size.
+        gsel = np.full(pad, -1, np.int32)
+        gsel[:m] = gslots[idx]
+        status = np.zeros(pad, np.int32)
+        status[:m] = np.asarray(cols.status, dtype=np.int32)[idx]
+        limit = np.zeros(pad, np.int64)
+        limit[:m] = np.asarray(cols.limit, dtype=np.int64)[idx]
+        remaining = np.zeros(pad, np.int64)
+        remaining[:m] = np.asarray(cols.remaining, dtype=np.int64)[idx]
+        reset = np.zeros(pad, np.int64)
+        reset[:m] = np.asarray(cols.reset_time, dtype=np.int64)[idx]
+        self.gcols = self._set_replica_fn(
+            self.gcols, gsel, status, limit, remaining, reset
+        )
+        self.replica_commit_dispatches += 1
+        # Vectorized host mirror (rep_expire gates the replica-cache
+        # hint; algorithm keeps the broadcast's authoritative value).
+        self.gtable.rep_expire[gsel[:m]] = reset[:m]
+        self.gtable.algorithm[gsel[:m]] = np.asarray(
+            cols.algorithm, dtype=np.int32
+        )[idx]
 
     # ------------------------------------------------------------------
     @_drained_locked
@@ -1126,12 +1215,13 @@ class MeshBucketStore(ColumnarPipeline):
         act = np.fromiter(active, dtype=np.int64, count=len(active))
         owner_np = self.gtable.owner_shard[act]
         # Remote daemons' keys with aggregated hits: sendHits payloads
-        # (global.go:120-160).
-        for g in act[(owner_np < 0) & (totals_np[act] > 0)]:
-            g = int(g)
-            if self.gtable.req_proto.get(g) is not None:
-                req = replace(self.gtable.req_proto[g], hits=int(totals_np[g]))
-                result.remote_hits.append(req)
+        # (global.go:120-160), emitted as wire-ready COLUMNS straight
+        # from the template arrays — no per-key dataclasses.
+        rsel = act[(owner_np < 0) & (totals_np[act] > 0)]
+        if rsel.size:
+            rsel = rsel[self.gtable.templated(rsel)]
+        if rsel.size:
+            result.remote_hit_cols = self.gtable.hit_columns(rsel, totals_np)
         local = act[owner_np >= 0]
         sel = local[applied_np[local] & (self.gtable.owner_slot[local] >= 0)]
         sel_shard = self.gtable.owner_shard[sel]
@@ -1149,7 +1239,7 @@ class MeshBucketStore(ColumnarPipeline):
                     self.tables[o].commit(
                         [slot], [out_exp[o, g]], [out_rm[o, g]], keys=[k]
                     )
-                    req = self.gtable.req_proto.get(g)
+                    req = self.gtable.request_template(g, int(totals_np[g]))
                     if out_rm[o, g]:
                         self.store.remove(k)
                     elif req is not None:
@@ -1167,21 +1257,17 @@ class MeshBucketStore(ColumnarPipeline):
             # shard skip re-resolving them next pass.
             for g in idx[out_rm[o, idx]]:
                 self.gtable.owner_slot[int(g)] = -1
-        # Authoritative statuses for the host broadcast leg
-        # (UpdatePeerGlobal payload, peers.proto:52-56).
-        for g in sel:
-            g = int(g)
-            result.broadcasts.append(
-                UpdatePeerGlobal(
-                    key=self.gtable.key_of(g),
-                    algorithm=int(self.gtable.algorithm[g]),
-                    status=RateLimitResponse(
-                        status=int(rep_status[g]),
-                        limit=int(rep_limit[g]),
-                        remaining=int(rep_remaining[g]),
-                        reset_time=int(rep_reset[g]),
-                    ),
-                )
+        # Authoritative statuses for the host broadcast leg, in column
+        # form straight from the packed sync readback (the sender
+        # encodes these ONCE and fans the same payload to every peer).
+        if sel.size:
+            result.broadcast_cols = GlobalsColumns(
+                keys=[self.gtable.key_of(int(g)) for g in sel],
+                algorithm=self.gtable.algorithm[sel].astype(np.int32),
+                status=rep_status[sel].astype(np.int32),
+                limit=np.asarray(rep_limit[sel], dtype=np.int64),
+                remaining=np.asarray(rep_remaining[sel], dtype=np.int64),
+                reset_time=np.asarray(rep_reset[sel], dtype=np.int64),
             )
         # Snapshot AFTER our own commits (which may bump generations):
         # shards untouched until the next sync verify nothing then.
@@ -1287,6 +1373,22 @@ class MeshBucketStore(ColumnarPipeline):
         )
         self.apply([req], now_ms)
         self.sync_globals(now_ms)
+        # Compile the batched replica-commit scatter at its smallest
+        # pad bucket: the first received GLOBAL broadcast must not pay
+        # the compile inside the sender's RPC deadline.  Reuses the
+        # warmup key's gslot; reset_time in the past so the replica
+        # row can never serve a cached answer.
+        self.set_replica_batch(
+            GlobalsColumns(
+                keys=[req.hash_key()],
+                algorithm=np.zeros(1, np.int32),
+                status=np.zeros(1, np.int32),
+                limit=np.ones(1, np.int64),
+                remaining=np.zeros(1, np.int64),
+                reset_time=np.full(1, now_ms - 1, np.int64),
+            ),
+            now_ms,
+        )
         if self.back is not None:
             # Compile the tier-move program at its smallest pad bucket
             # (all-noop records): the first real demotion otherwise pays

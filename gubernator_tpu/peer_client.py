@@ -135,6 +135,7 @@ class PeerClient:
         self._rpc_get_peer_rate_limits = None
         self._rpc_get_peer_rate_limits_columns = None
         self._rpc_update_peer_globals = None
+        self._rpc_update_peer_globals_columns = None
         self._shutdown = threading.Event()
         self._err_lock = threading.Lock()
         self._last_err: Dict[str, float] = {}  # message -> expiry timestamp
@@ -153,6 +154,14 @@ class PeerClient:
         # probes; a peer that answers "length mismatch" predates the
         # trailer and is resent the same frame without it.
         self._trace_frames: Optional[bool] = None
+        # GLOBAL broadcast encoding negotiation, independent of the
+        # forward-hop flag above (its own GUBER_GLOBAL_COLUMNS knob):
+        # None = untried (probe columns first), True = peer takes the
+        # columnar broadcast, False = classic per-item only.  Sticky for
+        # the client's lifetime, like _columnar.
+        self._globals_columnar: Optional[bool] = (
+            None if getattr(self.behaviors, "global_columns", True) else False
+        )
         # Per-RPC lane caps.  The operator's GUBER_BATCH_LIMIT keeps
         # meaning on both encodings: it is the classic per-RPC cap
         # verbatim, and the columnar cap scales with it (16.384x at the
@@ -290,7 +299,10 @@ class PeerClient:
     def update_peer_globals(
         self, updates: Sequence[UpdatePeerGlobal], timeout_s: Optional[float] = None
     ) -> None:
-        """PeersV1.UpdatePeerGlobals."""
+        """PeersV1.UpdatePeerGlobals, classic per-item encoding (the
+        legacy dataclass API; the GlobalManager's fan-out sends
+        update_peer_globals_batch, which negotiates the columnar
+        encoding and caches each encode across peers)."""
         if self.transport == "http":
             payload = {"globals": [u.to_json() for u in updates]}
             self._post("/v1/peer.UpdatePeerGlobals", payload, timeout_s)
@@ -298,6 +310,121 @@ class PeerClient:
             self._grpc_call(
                 "UpdatePeerGlobals", wire.update_globals_req_to_pb(updates), timeout_s
             )
+
+    def update_peer_globals_batch(
+        self, batch: "wire.BroadcastBatch", timeout_s: Optional[float] = None,
+        trace_ctx=None,
+    ) -> None:
+        """One GLOBAL broadcast send from a pre-encoded BroadcastBatch
+        (encode-once fan-out: every peer reuses the same cached wire
+        bytes).  Encoding negotiates per peer like the forward hop:
+        proto columns (gRPC UpdatePeerGlobalsColumns) / the GUBC
+        globals frame (HTTP, same /v1/peer.UpdatePeerGlobals path)
+        first; a peer that answers UNIMPLEMENTED / 4xx is remembered as
+        classic-only and resent the per-item encoding inside the same
+        guarded call — the probe is breaker- and health-neutral.
+        `trace_ctx` links the per-peer peer.rpc client span into the
+        tick's global.sync trace (tracing.py)."""
+        if self._shutdown.is_set():
+            raise PeerError(ERR_CLOSING, not_ready=True)
+        t0 = time.monotonic_ns()
+        rpc_err: Optional[Exception] = None
+        try:
+            if self.transport == "http":
+                self._guarded_call(
+                    "UpdatePeerGlobals",
+                    lambda: self._post_globals_inner(batch, timeout_s),
+                )
+            else:
+                self._guarded_call(
+                    "UpdatePeerGlobals",
+                    lambda: self._grpc_globals_inner(batch, timeout_s),
+                )
+        except Exception as e:  # noqa: BLE001 — re-raised below
+            rpc_err = e
+            raise
+        finally:
+            if trace_ctx is not None:
+                bt = tracing.new_batch([trace_ctx])
+                if bt is not None:
+                    attrs = dict(
+                        peer=self.info.grpc_address,
+                        op="UpdatePeerGlobals",
+                        items=len(batch),
+                        encoding=(
+                            "columns" if self._globals_columnar else "classic"
+                        ),
+                    )
+                    if rpc_err is not None:
+                        attrs["error"] = str(rpc_err)
+                    tracing.record_span(
+                        "peer.rpc", bt.ctx,
+                        start_ns=t0, end_ns=time.monotonic_ns(),
+                        links=bt.links, **attrs,
+                    )
+        if self._metrics is not None:
+            self._metrics.global_broadcast_batches.labels(
+                encoding="columns" if self._globals_columnar else "classic"
+            ).inc()
+
+    def _grpc_globals_inner(self, batch: "wire.BroadcastBatch",
+                            timeout_s: Optional[float]) -> None:
+        """Columnar UpdatePeerGlobals over gRPC, falling back to the
+        classic per-item message on UNIMPLEMENTED (the method never
+        executed, so the classic resend cannot double-apply)."""
+        timeout = (
+            timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
+        )
+        try:
+            _get_rl, upd, _get_cols, upd_cols = self._ensure_channel()
+            if self._globals_columnar is not False:
+                try:
+                    upd_cols(batch.columns_pb(), timeout=timeout)
+                    self._globals_columnar = True
+                    return
+                except grpc.RpcError as e:
+                    code = e.code() if hasattr(e, "code") else None
+                    if code == grpc.StatusCode.UNIMPLEMENTED:
+                        self._globals_columnar = False
+                    else:
+                        raise
+            upd(batch.classic_pb(), timeout=timeout)
+        except grpc.RpcError as e:
+            raise self._wrap_grpc_error("UpdatePeerGlobals", e) from e
+        except ValueError as e:
+            raise self._wrap_value_error("UpdatePeerGlobals", e) from e
+
+    def _post_globals_inner(self, batch: "wire.BroadcastBatch",
+                            timeout_s: Optional[float]) -> None:
+        """Columnar UpdatePeerGlobals over HTTP: the GUBC globals frame
+        against the same /v1/peer.UpdatePeerGlobals path (the receiver
+        sniffs the magic).  An old peer rejects the frame — 4xx from
+        its JSON parse, or the pre-columns gateway's 500 naming the
+        codec failure — which proves it was not applied, so the classic
+        per-item JSON resend inside this same guarded call is safe and
+        the probe stays breaker/health-neutral."""
+        if self._globals_columnar is not False:
+            try:
+                self._http_roundtrip(
+                    "/v1/peer.UpdatePeerGlobals", batch.frame(), timeout_s,
+                    wire.COLUMNS_CONTENT_TYPE,
+                )
+                self._globals_columnar = True
+                return
+            except PeerError as e:
+                rejected = e.http_status in (400, 404, 415) or (
+                    e.http_status == 500 and "codec can't decode" in str(e)
+                )
+                if not rejected:
+                    raise
+                self._globals_columnar = False
+                # A benign version probe, not a peer failure: it must
+                # not leave HealthCheck unhealthy for 5 minutes.
+                self._clear_last_err(str(e))
+        self._http_roundtrip(
+            "/v1/peer.UpdatePeerGlobals", batch.classic_json_bytes(),
+            timeout_s, "application/json",
+        )
 
     # ------------------------------------------------------------------
     def _send_batch(self, batch: List[tuple]) -> None:
@@ -478,10 +605,11 @@ class PeerClient:
     # ------------------------------------------------------------------
     def _ensure_channel(self):
         """Returns (get_peer_rate_limits, update_peer_globals,
-        get_peer_rate_limits_columns) stubs, building the channel
-        lazily.  The stubs are captured and returned under the lock:
-        _reset_channel may null the attributes concurrently (a racing
-        thread observing a torn state must not see None)."""
+        get_peer_rate_limits_columns, update_peer_globals_columns)
+        stubs, building the channel lazily.  The stubs are captured and
+        returned under the lock: _reset_channel may null the attributes
+        concurrently (a racing thread observing a torn state must not
+        see None)."""
         with self._conn_lock:
             if self._channel is None:
                 target = self.info.grpc_address
@@ -507,10 +635,16 @@ class PeerClient:
                     request_serializer=peers_pb.UpdatePeerGlobalsReq.SerializeToString,
                     response_deserializer=peers_pb.UpdatePeerGlobalsResp.FromString,
                 )
+                self._rpc_update_peer_globals_columns = self._channel.unary_unary(
+                    f"/{PEERS_V1_SERVICE}/UpdatePeerGlobalsColumns",
+                    request_serializer=pc_pb.GlobalsColumnsReq.SerializeToString,
+                    response_deserializer=peers_pb.UpdatePeerGlobalsResp.FromString,
+                )
             return (
                 self._rpc_get_peer_rate_limits,
                 self._rpc_update_peer_globals,
                 self._rpc_get_peer_rate_limits_columns,
+                self._rpc_update_peer_globals_columns,
             )
 
     # ------------------------------------------------------------------
@@ -594,7 +728,7 @@ class PeerClient:
 
     def _grpc_inner(self, method: str, request, timeout_s: Optional[float]):
         try:
-            get_rl, update_g, _ = self._ensure_channel()
+            get_rl, update_g, _, _ = self._ensure_channel()
             rpc = get_rl if method == "GetPeerRateLimits" else update_g
             timeout = (
                 timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
@@ -618,7 +752,7 @@ class PeerClient:
             timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
         )
         try:
-            get_rl, _upd, get_cols = self._ensure_channel()
+            get_rl, _upd, get_cols, _ = self._ensure_channel()
             if self._columnar is not False:
                 try:
                     m = get_cols(
@@ -680,6 +814,8 @@ class PeerClient:
                 self._channel = None
                 self._rpc_get_peer_rate_limits = None
                 self._rpc_update_peer_globals = None
+                self._rpc_get_peer_rate_limits_columns = None
+                self._rpc_update_peer_globals_columns = None
 
     # ------------------------------------------------------------------
     # HTTP/JSON fallback transport (the peer's gateway surface)
@@ -801,6 +937,18 @@ class PeerClient:
         timeout = timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
         host = self.info.http_address or self.info.grpc_address
         with self._conn_lock:
+            # not_ready marks a failure as provably-unapplied (safe to
+            # retry/requeue).  That holds only until the request body
+            # has been DELIVERED: a timeout while waiting for the
+            # response may have executed server-side — the same reason
+            # DEADLINE_EXCEEDED is excluded from _NOT_READY_CODES on
+            # the gRPC transport — so post-send failures must not
+            # present as retry-safe.  One exception: RemoteDisconnected
+            # on a REUSED connection is the keep-alive expiry race (the
+            # peer closed the idle socket before the request arrived —
+            # the urllib3 retry rule), which stays retry-safe.
+            fresh_conn = self._conn is None
+            sent = False
             try:
                 if self._conn is None:
                     hostname, _, port = host.partition(":")
@@ -817,6 +965,7 @@ class PeerClient:
                     "POST", path, body=data,
                     headers={"Content-Type": content_type},
                 )
+                sent = True
                 r = self._conn.getresponse()
                 body = r.read()
                 if r.status != 200:
@@ -833,7 +982,11 @@ class PeerClient:
                 msg = f"connect to peer {host} failed: {e}"
                 self._set_last_err(msg)
                 self._reset_conn()
-                raise PeerError(msg, not_ready=True) from e
+                retry_safe = not sent or (
+                    not fresh_conn
+                    and isinstance(e, http.client.RemoteDisconnected)
+                )
+                raise PeerError(msg, not_ready=retry_safe) from e
 
     def _reset_conn(self) -> None:
         if self._conn is not None:

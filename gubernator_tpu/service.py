@@ -24,9 +24,11 @@ from .utils.logging import category_logger
 import numpy as np
 
 from . import tracing
-from .config import MAX_BATCH_SIZE, BehaviorConfig
+from . import wire
+from .config import MAX_BATCH_SIZE, PEER_COLUMNS_MAX_LANES, BehaviorConfig
 from .faults import Backoff
 from .metrics import Metrics
+from .parallel.global_mgr import GlobalsColumns, HitColumns
 from .parallel.hash_ring import ReplicatedConsistentHash
 from .parallel.mesh import MeshBucketStore
 from .parallel.region import RegionPicker
@@ -952,6 +954,19 @@ class V1Service:
             self.store, "supports_columns", False
         )
 
+    @property
+    def serves_global_columns(self) -> bool:
+        """Whether this daemon SPEAKS the columnar GLOBAL replication
+        plane — the single rule both transport edges consult (gRPC
+        method registration, gateway frame sniff) AND the receive-side
+        batching switch.  False under the GUBER_GLOBAL_COLUMNS opt-out
+        (the pre-columns interop mode: classic wire bytes, one replica
+        commit dispatch per item) and for stores without the batched
+        replica commit."""
+        return getattr(self.conf.behaviors, "global_columns", True) and hasattr(
+            self.store, "set_replica_batch"
+        )
+
     def get_peer(self, key: str) -> PeerClient:
         """Owner peer for a key (gubernator.go:440-449)."""
         with self._peer_mutex:
@@ -1629,15 +1644,24 @@ class V1Service:
         fast-fails are skipped immediately (the breaker's open interval
         IS the backoff across ticks); budgets come from
         behaviors.global_send_retries.  Returns success."""
+        ok, _ = self._peer_send_ex(op, fn)
+        return ok
+
+    def _peer_send_ex(self, op: str, fn: Callable[[], object]):
+        """_peer_send returning (success, last_error): the GLOBAL
+        requeue accounting reads the failure SHAPE — a breaker
+        fast-fail / connection-level not-ready provably never applied
+        (safe to requeue the hits), a timeout-shaped failure may have
+        applied server-side (requeueing would double-count)."""
         budget = self.conf.behaviors.global_send_retries
         attempt = 0
         while True:
             try:
                 fn()
-                return True
+                return True, None
             except Exception as e:  # noqa: BLE001 (logged-and-continue in ref)
                 if is_circuit_open(e) or not is_not_ready(e) or attempt >= budget:
-                    return False
+                    return False, e
                 self.metrics.peer_retries.labels(op=op).inc()
                 self._retry_backoff.sleep(attempt)
                 attempt += 1
@@ -1906,9 +1930,39 @@ class V1Service:
         _ColumnsJoin(self, plan, result, callback).start()
 
     def update_peer_globals(self, updates: Sequence[UpdatePeerGlobal]) -> None:
-        """gubernator.go:259-272."""
+        """gubernator.go:259-272.  With the columnar GLOBAL plane on,
+        even a classic (per-item encoded) broadcast commits as ONE
+        batched replica scatter; the GUBER_GLOBAL_COLUMNS=0 interop
+        mode keeps the pre-columns per-item dispatches."""
         now = self.clock.now_ms()
+        if updates and self.serves_global_columns:
+            self.store.set_replica_batch(
+                GlobalsColumns.from_updates(list(updates)), now
+            )
+            return
         for u in updates:
+            self.store.set_replica(u, now)
+
+    def update_peer_globals_columns(self, cols: GlobalsColumns) -> None:
+        """Columnar receive side of the GLOBAL broadcast (the
+        GlobalsColumns wire decodes straight into one batched replica
+        commit — O(1) device dispatches for an N-item broadcast).
+        Capped like the forwarded-hits columns edge: the sender chunks
+        at the same bound, so an oversized batch is a bug or abuse —
+        and an uncapped one could churn the whole gslot table under
+        the store lock in a single RPC."""
+        if len(cols) > PEER_COLUMNS_MAX_LANES:
+            raise ApiError(
+                "OutOfRange",
+                f"'UpdatePeerGlobals' columns list too large; "
+                f"max size is '{PEER_COLUMNS_MAX_LANES}'",
+            )
+        now = self.clock.now_ms()
+        batch = getattr(self.store, "set_replica_batch", None)
+        if batch is not None:
+            batch(cols, now)
+            return
+        for u in cols.to_updates():
             self.store.set_replica(u, now)
 
     # ------------------------------------------------------------------
@@ -2029,7 +2083,22 @@ class GlobalManager:
     device-tier collective sync: every GlobalSyncWait, run the on-mesh
     sync; fan out the resulting owner broadcasts (UpdatePeerGlobals) to
     every peer daemon and forward aggregated hits for remotely-owned
-    keys (GetPeerRateLimits) to their owner daemons."""
+    keys (GetPeerRateLimits) to their owner daemons.
+
+    Both legs are COLUMNAR and CONCURRENT (architecture.md "GLOBAL
+    plane"): the sync emits column batches, the broadcast is encoded
+    once (wire.BroadcastBatch) and fanned to all peers through a
+    bounded pool — tick wall-time stops scaling as peers x RTT — and
+    aggregated hits ride the columnar GetPeerRateLimits path as
+    per-owner sub-batches.  Hits whose send provably never applied
+    (unroutable owner, breaker fast-fail, connection-level not-ready)
+    requeue into the next tick instead of being dropped."""
+
+    # Requeue-carry bound (distinct keys): hits for a peer that stays
+    # down accumulate here between ticks; past the cap new keys drop
+    # (counted in gubernator_global_dropped_hits) — matching the
+    # reference's bounded-loss posture under prolonged partition.
+    HIT_CARRY_MAX = 16_384
 
     # Auto-sizing policy: one sync pass (device collective + host
     # fan-out) should cost <=10% of its window, clamped to [5ms, 1s].
@@ -2077,6 +2146,14 @@ class GlobalManager:
             maxlen=self.SYNC_COST_SAMPLES
         )
         self._last_sync_cost_s: Optional[float] = None
+        # Requeued hit lanes awaiting the next tick: hash_key ->
+        # [name, unique_key, algorithm, behavior, hits, limit,
+        # duration], hits summed on merge.  Tick-thread-only state (the
+        # Interval serializes run_once), so no lock.
+        self._hit_carry: Dict[str, list] = {}
+        # Bounded fan-out pool, created on first use (idle daemons and
+        # non-GLOBAL deployments spawn no threads).
+        self._fanout_pool: "Optional[ThreadPoolExecutor]" = None
         self._interval = Interval(self.sync_wait_s, self._tick)
         self._interval.next()
 
@@ -2105,6 +2182,7 @@ class GlobalManager:
         must not inflate the window for every healthy peer."""
         svc = self.service
         t0 = time.perf_counter()
+        t0_ns = time.monotonic_ns()
         res = svc.store.sync_globals(svc.clock.now_ms())
         # The store reports the in-lock cost of the pass (collective +
         # decode/commit).  The wall time around the call additionally
@@ -2117,51 +2195,274 @@ class GlobalManager:
         self._last_sync_cost_s = (
             cost if cost is not None else (time.perf_counter() - t0)
         )
-        if res.remote_hits:
-            start = time.perf_counter()
-            by_owner: Dict[str, List[RateLimitRequest]] = {}
-            clients: Dict[str, PeerClient] = {}
-            for r in res.remote_hits:
-                try:
-                    peer = svc.get_peer(r.hash_key())
-                except PeerError:
-                    continue
-                addr = peer.info.grpc_address
-                by_owner.setdefault(addr, []).append(r)
-                clients[addr] = peer
-            for addr, reqs in by_owner.items():
-                # Jittered-backoff retry budget + circuit-breaker
-                # fast-fail (service._peer_send): a dead owner costs at
-                # most the breaker's open-interval probe per tick, not a
-                # full network timeout per send.
-                svc._peer_send(
-                    "global_hits",
-                    partial(
-                        clients[addr].get_peer_rate_limits,
-                        GetRateLimitsRequest(requests=reqs),
-                        timeout_s=svc.conf.behaviors.global_timeout_s,
-                    ),
-                )
-            svc.metrics.async_durations.observe(time.perf_counter() - start)
-        if res.broadcasts:
-            start = time.perf_counter()
-            for peer in svc.get_peer_list():
-                if peer.info.is_owner:
-                    continue  # exclude ourselves (global.go:223-226)
-                svc._peer_send(
+        did_work = bool(res.broadcast_cols or res.remote_hit_cols)
+        # global.sync batch trace per WORK tick (PR 4 taxonomy): child
+        # spans for the collective and the two fan-out legs, with the
+        # per-peer peer.rpc client spans span-linked to the tick's ctx.
+        tick = (
+            tracing.BatchTrace(())
+            if (did_work or self._hit_carry) and tracing.sampled()
+            else None
+        )
+        tracing.batch_span(
+            "global.collective", tick, t0_ns, time.monotonic_ns(),
+            broadcasts=res.broadcast_count,
+            hit_lanes=(
+                0 if res.remote_hit_cols is None else len(res.remote_hit_cols)
+            ),
+        )
+        hit_cols = self._take_carry_merged(res.remote_hit_cols)
+        if hit_cols is not None and len(hit_cols):
+            self._forward_hits(hit_cols, tick)
+        if res.broadcast_cols is not None and len(res.broadcast_cols):
+            self._broadcast(res.broadcast_cols, tick)
+        if tick is not None:
+            tracing.record_span(
+                "global.sync", tick.ctx,
+                start_ns=t0_ns, end_ns=time.monotonic_ns(),
+                broadcasts=res.broadcast_count,
+            )
+        return did_work
+
+    # ------------------------------------------------------------------
+    def _get_fanout_pool(self) -> "ThreadPoolExecutor":
+        # Tick-thread-only (like _hit_carry): no lock needed.
+        if self._fanout_pool is None:
+            self._fanout_pool = ThreadPoolExecutor(
+                max_workers=max(
+                    1, getattr(self.service.conf.behaviors, "global_fanout", 8)
+                ),
+                thread_name_prefix="global-fanout",
+            )
+        return self._fanout_pool
+
+    def _broadcast(self, bcols, tick) -> None:
+        """Encode the sync pass's broadcasts ONCE (wire.BroadcastBatch
+        caches every encoding) and fan them out to all peers
+        CONCURRENTLY through the bounded pool.  Per-peer breaker /
+        backoff semantics ride unchanged inside each send
+        (service._peer_send -> PeerClient._guarded_call); a peer that
+        exhausts its budget triggers the flight-recorder dump path."""
+        svc = self.service
+        peers = [
+            p for p in svc.get_peer_list()
+            if not p.info.is_owner  # exclude ourselves (global.go:223-226)
+        ]
+        if not peers:
+            return
+        t0 = time.perf_counter()
+        t0_ns = time.monotonic_ns()
+        # Chunk at the receive-side lane cap (a full 65536-gslot table
+        # going dirty in one tick outsizes one RPC); each chunk is
+        # still ONE encoded batch shared by every peer.
+        batches = [
+            wire.BroadcastBatch(bcols.slice(lo, lo + PEER_COLUMNS_MAX_LANES))
+            for lo in range(0, len(bcols), PEER_COLUMNS_MAX_LANES)
+        ]
+        pool = self._get_fanout_pool()
+        svc.metrics.global_fanout_concurrency.set(
+            min(len(peers), getattr(svc.conf.behaviors, "global_fanout", 8))
+        )
+        ctx = tick.ctx if tick is not None else None
+        timeout = svc.conf.behaviors.global_timeout_s
+
+        def send_all(peer) -> bool:
+            ok = True
+            for batch in batches:
+                ok = svc._peer_send(
                     "global_broadcast",
                     partial(
-                        peer.update_peer_globals,
-                        res.broadcasts,
-                        timeout_s=svc.conf.behaviors.global_timeout_s,
+                        peer.update_peer_globals_batch, batch,
+                        timeout_s=timeout, trace_ctx=ctx,
                     ),
+                ) and ok
+            return ok
+
+        futs = [(peer, pool.submit(send_all, peer)) for peer in peers]
+        for peer, fut in futs:
+            if not fut.result():
+                # Flight-recorder dump (tracing._DUMP_KINDS): a peer
+                # that missed a broadcast serves stale replicas until
+                # the next successful tick — preserve the context.
+                tracing.record_event(
+                    "global-send-failed", op="global_broadcast",
+                    peer=peer.info.grpc_address, items=len(bcols),
                 )
-            svc.metrics.broadcast_durations.observe(time.perf_counter() - start)
-        return bool(res.broadcasts or res.remote_hits)
+        svc.metrics.broadcast_durations.observe(time.perf_counter() - t0)
+        tracing.batch_span(
+            "global.broadcast", tick, t0_ns, time.monotonic_ns(),
+            items=len(bcols), peers=len(peers),
+        )
+
+    def _forward_hits(self, cols: "HitColumns", tick) -> None:
+        """Forward aggregated hits to their remote owners as columnar
+        sub-batches over the existing GetPeerRateLimits columnar path
+        (sendHits, global.go:120-160), one concurrent send per owner.
+        BUGFIX vs the pre-columns sender: an unroutable owner (pool
+        churn mid-tick) or a provably-unapplied send failure requeues
+        the lanes into the next tick instead of silently dropping
+        them."""
+        svc = self.service
+        t0 = time.perf_counter()
+        t0_ns = time.monotonic_ns()
+        by_owner: Dict[str, list] = {}
+        clients: Dict[str, PeerClient] = {}
+        requeue: list = []
+        for i in range(len(cols)):
+            try:
+                peer = svc.get_peer(cols.hash_key_at(i))
+            except PeerError:
+                requeue.append(i)
+                continue
+            addr = peer.info.grpc_address
+            by_owner.setdefault(addr, []).append(i)
+            clients[addr] = peer
+        pool = self._get_fanout_pool()
+        ctx = tick.ctx if tick is not None else None
+        futs = {
+            addr: pool.submit(
+                self._send_hits, clients[addr], cols.subset(lanes), ctx
+            )
+            for addr, lanes in by_owner.items()
+        }
+        dropped = 0
+        for addr, fut in futs.items():
+            rq_rel, dr = fut.result()
+            lanes = by_owner[addr]
+            requeue.extend(lanes[j] for j in rq_rel)
+            dropped += dr
+            if rq_rel or dr:
+                tracing.record_event(
+                    "global-send-failed", op="global_hits", peer=addr,
+                    requeued=len(rq_rel), dropped=dr,
+                )
+        if requeue:
+            self._requeue_hits(cols, requeue)
+        if dropped:
+            svc.metrics.global_dropped_hits.inc(dropped)
+        svc.metrics.async_durations.observe(time.perf_counter() - t0)
+        tracing.batch_span(
+            "global.hits", tick, t0_ns, time.monotonic_ns(),
+            lanes=len(cols), owners=len(by_owner),
+        )
+
+    def _send_hits(self, peer: PeerClient, sub: "HitColumns", ctx):
+        """Send one owner's hit columns, chunked at the columnar lane
+        cap (the client re-chunks classic-negotiated sends itself).
+        Returns (lanes to requeue, lanes dropped): a chunk whose
+        failure provably never applied — breaker fast-fail or a
+        connection-level not-ready error — requeues; a timeout-shaped
+        failure may have applied server-side, so requeueing would
+        double-count and the chunk drops (counted)."""
+        svc = self.service
+        n = len(sub)
+        pc = sub.peer_columns()
+        timeout = svc.conf.behaviors.global_timeout_s
+        requeue: list = []
+        dropped = 0
+        for lo in range(0, n, PEER_COLUMNS_MAX_LANES):
+            hi = min(lo + PEER_COLUMNS_MAX_LANES, n)
+            chunk = wire.peer_columns_slice(pc, lo, hi)
+            t0_ns = time.monotonic_ns()
+            ok, err = svc._peer_send_ex(
+                "global_hits",
+                partial(
+                    peer.send_columns_direct, chunk,
+                    timeout_s=timeout, trace_ctx=ctx,
+                ),
+            )
+            if ctx is not None:
+                bt = tracing.new_batch([ctx])
+                if bt is not None:
+                    attrs = dict(
+                        peer=peer.info.grpc_address,
+                        op="GetPeerRateLimits", leg="global_hits",
+                        lanes=hi - lo,
+                    )
+                    if not ok:
+                        attrs["error"] = str(err)
+                    tracing.record_span(
+                        "peer.rpc", bt.ctx,
+                        start_ns=t0_ns, end_ns=time.monotonic_ns(),
+                        links=bt.links, **attrs,
+                    )
+            if ok:
+                continue
+            if is_circuit_open(err) or is_not_ready(err):
+                requeue.extend(range(lo, hi))
+            else:
+                dropped += hi - lo
+        return requeue, dropped
+
+    def _requeue_hits(self, cols: "HitColumns", lanes) -> None:
+        """Fold failed lanes into the carry (hits summed per key),
+        bounded at HIT_CARRY_MAX distinct keys."""
+        carry = self._hit_carry
+        dropped = 0
+        for i in lanes:
+            hk = cols.hash_key_at(i)
+            cur = carry.get(hk)
+            if cur is not None:
+                cur[4] += int(cols.hits[i])
+                continue
+            if len(carry) >= self.HIT_CARRY_MAX:
+                dropped += 1
+                continue
+            carry[hk] = [
+                cols.names[i], cols.unique_keys[i],
+                int(cols.algorithm[i]), int(cols.behavior[i]),
+                int(cols.hits[i]), int(cols.limit[i]),
+                int(cols.duration[i]),
+            ]
+        requeued = len(lanes) - dropped
+        if requeued:
+            self.service.metrics.global_requeued_hits.inc(requeued)
+        if dropped:
+            self.service.metrics.global_dropped_hits.inc(dropped)
+
+    def _take_carry_merged(
+        self, new_cols: "Optional[HitColumns]"
+    ) -> "Optional[HitColumns]":
+        """Previous ticks' requeued hits merged with this tick's
+        accumulator output: hits sum per key, config fields take the
+        newest lane (last-writer-wins, like the gtable mirror)."""
+        if not self._hit_carry:
+            return new_cols
+        carry, self._hit_carry = self._hit_carry, {}
+        if new_cols is not None:
+            for i in range(len(new_cols)):
+                hk = new_cols.hash_key_at(i)
+                cur = carry.get(hk)
+                if cur is None:
+                    carry[hk] = [
+                        new_cols.names[i], new_cols.unique_keys[i],
+                        int(new_cols.algorithm[i]), int(new_cols.behavior[i]),
+                        int(new_cols.hits[i]), int(new_cols.limit[i]),
+                        int(new_cols.duration[i]),
+                    ]
+                else:
+                    cur[2] = int(new_cols.algorithm[i])
+                    cur[3] = int(new_cols.behavior[i])
+                    cur[4] += int(new_cols.hits[i])
+                    cur[5] = int(new_cols.limit[i])
+                    cur[6] = int(new_cols.duration[i])
+        vals = list(carry.values())
+        n = len(vals)
+        return HitColumns(
+            names=[v[0] for v in vals],
+            unique_keys=[v[1] for v in vals],
+            algorithm=np.fromiter((v[2] for v in vals), np.int32, count=n),
+            behavior=np.fromiter((v[3] for v in vals), np.int32, count=n),
+            hits=np.fromiter((v[4] for v in vals), np.int64, count=n),
+            limit=np.fromiter((v[5] for v in vals), np.int64, count=n),
+            duration=np.fromiter((v[6] for v in vals), np.int64, count=n),
+        )
 
     def stop(self) -> None:
         self._stopped = True
         self._interval.stop()
+        if self._fanout_pool is not None:
+            self._fanout_pool.shutdown(wait=False)
 
 
 class MultiRegionManager:

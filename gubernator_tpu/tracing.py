@@ -127,6 +127,13 @@ def enabled() -> bool:
     return _SAMPLE > 0.0 and not _FORCE_DISABLED
 
 
+def sampled() -> bool:
+    """Roll the sampling dice for work that is not an ingress request
+    (the GlobalManager's sync ticks): same rate, same single-compare
+    fast path when tracing is off."""
+    return enabled() and _rng().random() < _SAMPLE
+
+
 def _rng() -> random.Random:
     r = getattr(_tls, "rng", None)
     if r is None:
@@ -224,7 +231,9 @@ _events = _Ring(EVENT_RING_CAPACITY)
 
 # Event kinds that trigger an automatic flight-recorder dump to the
 # structured log (rate-limited so an open breaker can't storm it).
-_DUMP_KINDS = frozenset({"breaker-open", "shed", "fault"})
+# global-send-failed: a GLOBAL broadcast/hit-forward send exhausted its
+# retry budget — the same lost-progress signal a breaker trip is.
+_DUMP_KINDS = frozenset({"breaker-open", "shed", "fault", "global-send-failed"})
 _DUMP_MIN_INTERVAL_S = 5.0
 _last_dump = [0.0]
 _dump_lock = threading.Lock()
@@ -505,6 +514,24 @@ def stage_span(stage: str, dur_s: float, bt: Optional[BatchTrace],
         parent_id=bt.ctx.span_id,
         start_ns=end - int(dur_s * 1e9),
         end_ns=end,
+        links=bt.links,
+        **attrs,
+    )
+
+
+def batch_span(name: str, bt: Optional[BatchTrace], start_ns: int,
+               end_ns: int, **attrs) -> None:
+    """One completed child span of a batch trace (the GlobalManager's
+    global.collective / global.broadcast / global.hits legs), parented
+    under the batch root and carrying its links."""
+    if bt is None:
+        return
+    record_span(
+        name,
+        SpanContext(bt.ctx.trace_id, _rng().getrandbits(64) or 1),
+        parent_id=bt.ctx.span_id,
+        start_ns=start_ns,
+        end_ns=end_ns,
         links=bt.links,
         **attrs,
     )

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import struct
+import threading
 from typing import Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -727,6 +728,162 @@ def result_from_classic_peer_json(body: dict):
 
 
 # ---- GLOBAL broadcast ------------------------------------------------
+#
+# Columnar replication plane (architecture.md "GLOBAL plane"): the
+# owner's sync pass emits its broadcasts as one GlobalsColumns batch
+# and fans the SAME encoded payload to every peer.  Two encodings of
+# the batch, mirroring the peer-forward hop:
+#   * proto columns (GlobalsColumnsReq) for the gRPC transport — served
+#     as PeersV1/UpdatePeerGlobalsColumns; old peers answer
+#     UNIMPLEMENTED and the sender falls back to the classic per-item
+#     UpdatePeerGlobals encoding.
+#   * a GUBC frame (kind 3) for the HTTP transport, POSTed to the SAME
+#     /v1/peer.UpdatePeerGlobals path; the receiver sniffs the magic
+#     (JSON bodies can never start with it), old receivers answer
+#     4xx/"codec can't decode" and the sender falls back to per-item
+#     JSON.
+# BroadcastBatch caches every encoding, so an N-peer fan-out encodes
+# each at most once per tick.
+
+_FRAME_KIND_GLOBALS = 3
+
+
+def is_globals_frame(raw: bytes) -> bool:
+    return is_columns_frame(raw) and raw[5] == _FRAME_KIND_GLOBALS
+
+
+def encode_globals_frame(cols) -> bytes:
+    """GlobalsColumns -> binary broadcast frame: GUBC header (kind 3)
+    + key string column + algo/status i32 + limit/remaining/reset i64."""
+    n = len(cols.keys)
+    return b"".join(
+        (
+            FRAME_MAGIC,
+            struct.pack("<BBI", FRAME_VERSION, _FRAME_KIND_GLOBALS, n),
+            _pack_str_column(cols.keys),
+            np.ascontiguousarray(cols.algorithm, dtype=np.int32).tobytes(),
+            np.ascontiguousarray(cols.status, dtype=np.int32).tobytes(),
+            np.ascontiguousarray(cols.limit, dtype=np.int64).tobytes(),
+            np.ascontiguousarray(cols.remaining, dtype=np.int64).tobytes(),
+            np.ascontiguousarray(cols.reset_time, dtype=np.int64).tobytes(),
+        )
+    )
+
+
+def decode_globals_frame(raw: bytes):
+    """Binary broadcast frame -> GlobalsColumns.  Raises ValueError on
+    a malformed/foreign frame (the gateway maps it to a 400)."""
+    from .parallel.global_mgr import GlobalsColumns
+
+    if not is_columns_frame(raw):
+        raise ValueError("not a columns frame")
+    version, kind, n = struct.unpack_from("<BBI", raw, 4)
+    if version != FRAME_VERSION or kind != _FRAME_KIND_GLOBALS:
+        raise ValueError(
+            f"unsupported globals frame (version={version}, kind={kind})"
+        )
+    pos = _FRAME_HEADER_LEN
+    ko, kb, pos = _read_str_blob(raw, pos, n)
+    algo, pos = _read_array(raw, pos, np.int32, n)
+    status, pos = _read_array(raw, pos, np.int32, n)
+    limit, pos = _read_array(raw, pos, np.int64, n)
+    remaining, pos = _read_array(raw, pos, np.int64, n)
+    reset, pos = _read_array(raw, pos, np.int64, n)
+    if pos != len(raw):
+        raise ValueError("columns frame length mismatch")
+    return GlobalsColumns(
+        keys=[kb[ko[i]:ko[i + 1]].decode("utf-8") for i in range(n)],
+        algorithm=algo, status=status, limit=limit,
+        remaining=remaining, reset_time=reset,
+    )
+
+
+def globals_cols_to_pb(cols) -> pc_pb.GlobalsColumnsReq:
+    m = pc_pb.GlobalsColumnsReq()
+    m.keys.extend(cols.keys)
+    m.algorithm.extend(np.asarray(cols.algorithm, dtype=np.int32).tolist())
+    m.status.extend(np.asarray(cols.status, dtype=np.int32).tolist())
+    m.limit.extend(np.asarray(cols.limit, dtype=np.int64).tolist())
+    m.remaining.extend(np.asarray(cols.remaining, dtype=np.int64).tolist())
+    m.reset_time.extend(np.asarray(cols.reset_time, dtype=np.int64).tolist())
+    return m
+
+
+def globals_cols_from_pb(m: pc_pb.GlobalsColumnsReq):
+    from .parallel.global_mgr import GlobalsColumns
+
+    n = len(m.keys)
+    return GlobalsColumns(
+        keys=list(m.keys),
+        algorithm=np.fromiter(m.algorithm, np.int32, count=n),
+        status=np.fromiter(m.status, np.int32, count=n),
+        limit=np.fromiter(m.limit, np.int64, count=n),
+        remaining=np.fromiter(m.remaining, np.int64, count=n),
+        reset_time=np.fromiter(m.reset_time, np.int64, count=n),
+    )
+
+
+class BroadcastBatch:
+    """One sync pass's broadcasts with every wire encoding cached: the
+    N-peer fan-out encodes ONCE per encoding actually used (the
+    pre-columns sender re-encoded the whole batch per peer per tick).
+    The classic encodings are built through the exact dataclass path
+    the pre-columns sender used, so a GUBER_GLOBAL_COLUMNS=0 daemon —
+    or a classic-negotiated peer — sees byte-identical wire.
+
+    Lazy init is LOCKED: the fan-out pool hands one batch to many
+    concurrent sends, and an unguarded check-then-encode would let
+    every worker encode its own copy — per-peer encoding through the
+    back door."""
+
+    __slots__ = ("cols", "_lock", "_frame", "_pb", "_classic_pb",
+                 "_classic_json", "_updates")
+
+    def __init__(self, cols):
+        self.cols = cols
+        self._lock = threading.Lock()
+        self._frame = None
+        self._pb = None
+        self._classic_pb = None
+        self._classic_json = None
+        self._updates = None
+
+    def __len__(self) -> int:
+        return len(self.cols.keys)
+
+    def updates(self):
+        # Callers hold self._lock (or are single-threaded test code).
+        if self._updates is None:
+            self._updates = self.cols.to_updates()
+        return self._updates
+
+    def frame(self) -> bytes:
+        with self._lock:
+            if self._frame is None:
+                self._frame = encode_globals_frame(self.cols)
+            return self._frame
+
+    def columns_pb(self) -> pc_pb.GlobalsColumnsReq:
+        with self._lock:
+            if self._pb is None:
+                self._pb = globals_cols_to_pb(self.cols)
+            return self._pb
+
+    def classic_pb(self) -> peers_pb.UpdatePeerGlobalsReq:
+        with self._lock:
+            if self._classic_pb is None:
+                self._classic_pb = update_globals_req_to_pb(self.updates())
+            return self._classic_pb
+
+    def classic_json_bytes(self) -> bytes:
+        with self._lock:
+            if self._classic_json is None:
+                self._classic_json = json.dumps(
+                    {"globals": [u.to_json() for u in self.updates()]}
+                ).encode("utf-8")
+            return self._classic_json
+
+
 def update_global_to_pb(u: UpdatePeerGlobal) -> peers_pb.UpdatePeerGlobal:
     return peers_pb.UpdatePeerGlobal(
         key=u.key, status=resp_to_pb(u.status), algorithm=int(u.algorithm)

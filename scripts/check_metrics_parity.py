@@ -58,6 +58,11 @@ EXTENSIONS = frozenset(
         # PR 4: observability
         "gubernator_build_info",
         "gubernator_request_duration_seconds",
+        # PR 5: columnar GLOBAL replication plane
+        "gubernator_global_broadcast_batches",
+        "gubernator_global_fanout_concurrency",
+        "gubernator_global_requeued_hits",
+        "gubernator_global_dropped_hits",
     }
 )
 

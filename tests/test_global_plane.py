@@ -1,0 +1,564 @@
+"""Columnar GLOBAL replication plane (architecture.md "GLOBAL plane").
+
+Covers the acceptance legs of the encode-once / batched-commit design:
+
+* receiver — an N-item broadcast commits in O(1) device dispatches
+  (counted, not timed), and the batched commit is state-identical to
+  the per-item loop it replaced (eviction pressure and duplicate keys
+  included);
+* sender — the broadcast fan-out is concurrent and encode-once (every
+  peer receives the SAME BroadcastBatch object), and aggregated hits
+  whose owner is unroutable or whose send provably never applied
+  REQUEUE into the next tick instead of being dropped (the pre-columns
+  sender lost them — the regression this pins);
+* mixed-version interop — a columnar-plane daemon and a daemon running
+  GUBER_GLOBAL_COLUMNS=0 (+ GUBER_PEER_COLUMNS=0: the full pre-columns
+  wire behavior) replicate to each other in both directions, with the
+  negotiation landing where it must and health staying clean;
+* chaos — seeded FaultPlan drop/error/delay on the broadcast and
+  hit-forward RPCs: breaker interplay, and every hit lane accounted
+  (delivered exactly once, requeued, or counted dropped).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from gubernator_tpu import wire
+from gubernator_tpu.cluster import fast_test_behaviors
+from gubernator_tpu.config import BehaviorConfig, DaemonConfig
+from gubernator_tpu.daemon import Daemon
+from gubernator_tpu.faults import ERROR, DROP, FaultPlan, FaultRule
+from gubernator_tpu.parallel.global_mgr import GlobalsColumns
+from gubernator_tpu.parallel.mesh import MeshBucketStore
+from gubernator_tpu.service import ServiceConfig, V1Service
+from gubernator_tpu.types import (
+    Behavior,
+    GetRateLimitsRequest,
+    PeerInfo,
+    RateLimitRequest,
+    RateLimitResponse,
+    UpdatePeerGlobal,
+)
+from gubernator_tpu.utils.clock import Clock
+
+T0 = 1_573_430_430_000
+
+
+def _update(key, remaining=4, limit=5, reset=T0 + 60_000, algorithm=0):
+    return UpdatePeerGlobal(
+        key=key, algorithm=algorithm,
+        status=RateLimitResponse(
+            status=0, limit=limit, remaining=remaining, reset_time=reset
+        ),
+    )
+
+
+def _metric_value(counter) -> float:
+    return counter._value.get()  # noqa: SLF001 (test-only introspection)
+
+
+# ----------------------------------------------------------------------
+# Receiver: batched replica commit
+# ----------------------------------------------------------------------
+def test_set_replica_batch_commits_in_o1_dispatches():
+    """The acceptance criterion, by dispatch COUNT: a 64-item broadcast
+    commits with one scatter program (no evictions -> exactly one),
+    and the committed state matches the per-item loop exactly."""
+    batched = MeshBucketStore(capacity_per_shard=64, g_capacity=128)
+    reference = MeshBucketStore(capacity_per_shard=64, g_capacity=128)
+    updates = [
+        _update(f"gp_k{i}", remaining=i, limit=100, reset=T0 + 1000 + i)
+        for i in range(64)
+    ]
+    d0 = batched.replica_commit_dispatches
+    batched.set_replica_batch(GlobalsColumns.from_updates(updates), T0)
+    assert batched.replica_commit_dispatches - d0 == 1
+
+    for u in updates:
+        # Reference semantics: the per-item receive (itself a 1-lane
+        # batch — d0-delta 64 here, which is exactly what the batched
+        # path collapses).
+        reference.set_replica(u, T0)
+
+    for u in updates:
+        gb = batched.gtable.get(u.key)
+        gr = reference.gtable.get(u.key)
+        assert gb is not None and gr is not None
+        assert batched.gtable.rep_expire[gb] == reference.gtable.rep_expire[gr]
+        assert (
+            np.asarray(batched.gcols.rep_remaining)[:, gb]
+            == np.asarray(reference.gcols.rep_remaining)[:, gr]
+        ).all()
+        assert (
+            np.asarray(batched.gcols.rep_reset)[:, gb]
+            == np.asarray(reference.gcols.rep_reset)[:, gr]
+        ).all()
+
+
+def test_set_replica_batch_eviction_and_duplicates_match_per_item():
+    """Oracle under pressure: a batch larger than g_capacity (forcing
+    evictions mid-batch) with duplicate keys must leave the same final
+    host+device state as the per-item loop (keep-last for dupes)."""
+    cap = 8
+    batched = MeshBucketStore(capacity_per_shard=64, g_capacity=cap)
+    reference = MeshBucketStore(capacity_per_shard=64, g_capacity=cap)
+    updates = [
+        _update(f"gp_e{i}", remaining=i, reset=T0 + 100 + i) for i in range(12)
+    ]
+    # Duplicates: same key twice with different payloads (last wins).
+    updates.append(_update("gp_e11", remaining=77, reset=T0 + 777))
+    batched.set_replica_batch(GlobalsColumns.from_updates(updates), T0)
+    for u in updates:
+        reference.set_replica(u, T0)
+
+    b_rem = np.asarray(batched.gcols.rep_remaining)[0]
+    r_rem = np.asarray(reference.gcols.rep_remaining)[0]
+    for i in range(12):
+        key = f"gp_e{i}"
+        gb = batched.gtable.get(key)
+        gr = reference.gtable.get(key)
+        assert (gb is None) == (gr is None), key
+        if gb is None:
+            continue
+        assert b_rem[gb] == r_rem[gr], key
+        assert batched.gtable.rep_expire[gb] == reference.gtable.rep_expire[gr]
+    assert b_rem[batched.gtable.get("gp_e11")] == 77
+
+
+def _quiet_service(**kw) -> V1Service:
+    behaviors = BehaviorConfig(
+        global_sync_wait_s=3600.0, multi_region_sync_wait_s=3600.0, **kw
+    )
+    return V1Service(
+        ServiceConfig(
+            cache_size=1024, global_cache_size=64, behaviors=behaviors
+        )
+    )
+
+
+def test_update_peer_globals_batches_unless_knob_off():
+    """The service-level receive batches even CLASSIC-encoded
+    broadcasts into one commit; GUBER_GLOBAL_COLUMNS=0 restores the
+    pre-columns one-dispatch-per-item behavior exactly."""
+    svc = _quiet_service()
+    try:
+        store = svc.store
+        d0 = store.replica_commit_dispatches
+        svc.update_peer_globals([_update(f"gp_b{i}") for i in range(16)])
+        assert store.replica_commit_dispatches - d0 == 1
+
+        svc.conf.behaviors.global_columns = False  # live opt-out
+        d0 = store.replica_commit_dispatches
+        svc.update_peer_globals([_update(f"gp_c{i}") for i in range(16)])
+        assert store.replica_commit_dispatches - d0 == 16
+    finally:
+        svc.close()
+
+
+def test_globals_columns_receive_is_lane_capped():
+    """Like the forwarded-hits edge, the columnar broadcast receive
+    rejects oversized batches (the sender chunks at the same cap) —
+    an uncapped batch could churn the whole gslot table in one RPC."""
+    from gubernator_tpu.config import PEER_COLUMNS_MAX_LANES
+    from gubernator_tpu.service import ApiError
+
+    svc = _quiet_service()
+    try:
+        n = PEER_COLUMNS_MAX_LANES + 1
+        big = GlobalsColumns(
+            keys=[f"gp_x{i}" for i in range(n)],
+            algorithm=np.zeros(n, np.int32),
+            status=np.zeros(n, np.int32),
+            limit=np.ones(n, np.int64),
+            remaining=np.ones(n, np.int64),
+            reset_time=np.full(n, T0 + 60_000, np.int64),
+        )
+        with pytest.raises(ApiError):
+            svc.update_peer_globals_columns(big)
+    finally:
+        svc.close()
+
+
+# ----------------------------------------------------------------------
+# Sender: requeue accounting + concurrent encode-once fan-out
+# ----------------------------------------------------------------------
+def test_unroutable_owner_requeues_hits_until_delivered():
+    """REGRESSION (pre-columns run_once silently dropped aggregated
+    hits when get_peer raised PeerError): with an empty pool the lanes
+    carry across ticks without double-counting, and deliver intact
+    once an owner is routable."""
+    svc = _quiet_service()
+    try:
+        svc.set_peers([])  # empty pool: get_peer raises PeerError
+        req = RateLimitRequest(
+            name="glob", unique_key="rq", hits=3, limit=100,
+            duration=60_000, behavior=Behavior.GLOBAL,
+        )
+        svc.store.apply([req], T0, remote_global=True)
+        mgr = svc.global_mgr
+
+        mgr.run_once()
+        assert mgr._hit_carry["glob_rq"][4] == 3  # requeued, not dropped
+        assert _metric_value(svc.metrics.global_requeued_hits) == 1
+
+        mgr.run_once()  # still unroutable: carried again, hits UNCHANGED
+        assert mgr._hit_carry["glob_rq"][4] == 3
+
+        delivered = []
+
+        class _StubPeer:
+            info = PeerInfo(grpc_address="stub:1", is_owner=False)
+
+            def send_columns_direct(self, cols, timeout_s=None, trace_ctx=None):
+                delivered.append(cols)
+
+        svc.get_peer = lambda key: _StubPeer()
+        mgr.run_once()
+        assert not mgr._hit_carry
+        (cols,) = delivered
+        assert list(cols[0]) == ["glob"] and list(cols[1]) == ["rq"]
+        assert list(cols[4]) == [3]  # hits arrive exactly once
+        assert int(cols[3][0]) & int(Behavior.GLOBAL)  # wire keeps GLOBAL
+    finally:
+        svc.close()
+
+
+def test_broadcast_fanout_is_concurrent_and_encode_once():
+    """Two stub peers must be inside their sends AT THE SAME TIME (the
+    barrier only releases when both arrive — a serial fan-out would
+    deadlock and fail the timeout), and both must receive the SAME
+    BroadcastBatch object (encode-once across peers)."""
+    import threading
+
+    svc = _quiet_service()
+    try:
+        barrier = threading.Barrier(2, timeout=10.0)
+        received = []
+
+        class _StubPeer:
+            def __init__(self, addr):
+                self.info = PeerInfo(grpc_address=addr, is_owner=False)
+
+            def update_peer_globals_batch(self, batch, timeout_s=None,
+                                          trace_ctx=None):
+                barrier.wait()
+                received.append(batch)
+
+        stubs = [_StubPeer("stub:1"), _StubPeer("stub:2")]
+        svc.get_peer_list = lambda: stubs
+        bcols = GlobalsColumns.from_updates([_update("gp_f0")])
+        svc.global_mgr._broadcast(bcols, None)
+        assert len(received) == 2
+        assert received[0] is received[1]  # one encoded batch, all peers
+    finally:
+        svc.close()
+
+
+# ----------------------------------------------------------------------
+# Mixed-version interop: columnar plane <-> pre-columns daemon
+# ----------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def mixed_global_cluster():
+    """Daemon A runs the columnar GLOBAL plane; daemon B runs
+    GUBER_GLOBAL_COLUMNS=0 + GUBER_PEER_COLUMNS=0 — the full wire
+    behavior of a pre-columns build (no globals gRPC method, no frame
+    sniff, per-item replica commits, classic sender)."""
+    clock = Clock()
+    clock.freeze(T0)
+    daemons = []
+    for new_plane in (True, False):
+        behaviors = fast_test_behaviors()
+        behaviors.peer_columns = new_plane
+        behaviors.global_columns = new_plane
+        behaviors.global_sync_wait_s = 3600.0
+        behaviors.multi_region_sync_wait_s = 3600.0
+        d = Daemon(
+            DaemonConfig(
+                listen_address="127.0.0.1:0",
+                grpc_listen_address="127.0.0.1:0",
+                cache_size=4096,
+                global_cache_size=256,
+                behaviors=behaviors,
+                peer_discovery_type="static",
+            ),
+            clock=clock,
+        ).start()
+        daemons.append(d)
+    peers = [d.peer_info for d in daemons]
+    for d in daemons:
+        d.set_peers(peers)
+    yield daemons, clock
+    for d in daemons:
+        d.close()
+
+
+def _owned_key(owner, name, taken=()):
+    """A unique_key whose hash key is owned by `owner`."""
+    i = 0
+    while True:
+        key = f"k{i}"
+        if key not in taken and owner.service.get_peer(
+            f"{name}_{key}"
+        ).info.is_owner:
+            return key
+        i += 1
+
+
+def _global_req(name, key, hits=1, limit=50):
+    return RateLimitRequest(
+        name=name, unique_key=key, hits=hits, limit=limit,
+        duration=60_000, behavior=Behavior.GLOBAL,
+    )
+
+
+def _peer_client_for(entry, addr):
+    for p in entry.service.get_peer_list():
+        if p.info.grpc_address == addr:
+            return p
+    raise AssertionError(f"no client for {addr}")
+
+
+def test_interop_new_owner_broadcasts_to_old_peer(mixed_global_cluster):
+    daemons, clock = mixed_global_cluster
+    new, old = daemons
+    key = _owned_key(new, "iba")
+    hk = f"iba_{key}"
+    new.service.get_rate_limits(
+        GetRateLimitsRequest(requests=[_global_req("iba", key, hits=4)])
+    )
+    assert new.service.global_mgr.run_once()
+    # The probe got UNIMPLEMENTED from the pre-columns daemon; the
+    # classic resend landed inside the same guarded call.
+    client = _peer_client_for(new, old.peer_info.grpc_address)
+    assert client._globals_columnar is False
+    g = old.service.store.gtable.get(hk)
+    assert g is not None and old.service.store.gtable.rep_expire[g] > T0
+    # Breaker/health-neutral negotiation.
+    assert not client.breaker.is_open
+    assert new.service.health_check().status == "healthy"
+
+
+def test_interop_old_owner_broadcasts_to_new_peer(mixed_global_cluster):
+    daemons, clock = mixed_global_cluster
+    new, old = daemons
+    key = _owned_key(old, "ibb")
+    hk = f"ibb_{key}"
+    old.service.get_rate_limits(
+        GetRateLimitsRequest(requests=[_global_req("ibb", key, hits=2)])
+    )
+    d0 = new.service.store.replica_commit_dispatches
+    assert old.service.global_mgr.run_once()
+    # Old sender never probes (knob off at construction); the new
+    # receiver still commits the classic broadcast as ONE batch.
+    client = _peer_client_for(old, new.peer_info.grpc_address)
+    assert client._globals_columnar is False
+    g = new.service.store.gtable.get(hk)
+    assert g is not None and new.service.store.gtable.rep_expire[g] > T0
+    assert new.service.store.replica_commit_dispatches - d0 == 1
+
+
+def test_interop_hits_converge_new_entry_old_owner(mixed_global_cluster):
+    """Full loop: GLOBAL hits land at the NEW daemon for a key the OLD
+    daemon owns; the forwarded hits ride the (classic-negotiated)
+    GetPeerRateLimits leg, the old owner applies and broadcasts back,
+    and the authoritative count is exact."""
+    daemons, clock = mixed_global_cluster
+    new, old = daemons
+    key = _owned_key(old, "ibc")
+    total = 0
+    for hits in (3, 2):
+        new.service.get_rate_limits(
+            GetRateLimitsRequest(requests=[_global_req("ibc", key, hits=hits)])
+        )
+        total += hits
+    assert new.service.global_mgr.run_once()  # forward aggregated hits
+    old.service.global_mgr.run_once()  # owner applies + broadcasts
+    r = old.service.get_rate_limits(
+        GetRateLimitsRequest(requests=[_global_req("ibc", key, hits=0)])
+    ).responses[0]
+    assert not r.error
+    assert r.remaining == 50 - total
+
+
+def test_http_transport_globals_frame_and_fallback(mixed_global_cluster):
+    """The HTTP leg of the broadcast wire: a frame POST to the new
+    daemon's gateway commits batched; the same frame to the knob-off
+    daemon answers 4xx (its JSON parse rejects the magic, exactly like
+    a pre-columns build), the client downgrades inside the guarded
+    call, and health stays clean."""
+    daemons, clock = mixed_global_cluster
+    new, old = daemons
+    behaviors = fast_test_behaviors()
+    bcols = GlobalsColumns.from_updates(
+        [_update(f"http_h{i}", reset=T0 + 60_000) for i in range(8)]
+    )
+    for daemon, want_columnar, expect_batched in (
+        (new, True, True), (old, False, False)
+    ):
+        from gubernator_tpu.peer_client import PeerClient
+
+        client = PeerClient(
+            PeerInfo(
+                grpc_address=daemon.peer_info.grpc_address,
+                http_address=daemon.peer_info.http_address,
+            ),
+            behaviors,
+            transport="http",
+        )
+        try:
+            store = daemon.service.store
+            d0 = store.replica_commit_dispatches
+            client.update_peer_globals_batch(
+                wire.BroadcastBatch(bcols), timeout_s=5.0
+            )
+            assert client._globals_columnar is want_columnar
+            assert client.get_last_err() == []  # probe is health-neutral
+            g = store.gtable.get("http_h3")
+            assert g is not None and store.gtable.rep_expire[g] > T0
+            if expect_batched:
+                assert store.replica_commit_dispatches - d0 == 1
+            else:
+                assert store.replica_commit_dispatches - d0 == len(bcols)
+        finally:
+            client.shutdown(timeout_s=2.0)
+
+
+# ----------------------------------------------------------------------
+# Chaos: the GLOBAL plane under partition (seeded FaultPlan)
+# ----------------------------------------------------------------------
+@pytest.mark.chaos
+def test_global_plane_partition_breaker_and_no_lost_hits():
+    """ERROR-shaped partition on the hit-forward leg: every tick's
+    failed send requeues (never drops), the per-peer breaker opens at
+    its threshold and fast-fails the next tick (still requeueing), and
+    once the partition heals + the breaker's half-open probe passes,
+    the owner's authoritative count equals EXACTLY the hits taken —
+    nothing lost, nothing double-counted.  The broadcast leg runs
+    under an injected DELAY the whole time."""
+    clock = Clock()
+    clock.freeze(T0)
+    behaviors = fast_test_behaviors()
+    behaviors.global_sync_wait_s = 3600.0
+    behaviors.multi_region_sync_wait_s = 3600.0
+    behaviors.circuit_open_interval_s = 0.3
+    behaviors.retry_backoff_base_s = 0.001
+    behaviors.retry_backoff_max_s = 0.01
+
+    plans = [FaultPlan(seed=11), FaultPlan(seed=12)]
+    daemons = []
+    for plan in plans:
+        d = Daemon(
+            DaemonConfig(
+                listen_address="127.0.0.1:0",
+                grpc_listen_address="127.0.0.1:0",
+                cache_size=4096,
+                global_cache_size=256,
+                behaviors=behaviors,
+                peer_discovery_type="static",
+                fault_plan=plan,
+            ),
+            clock=clock,
+        ).start()
+        daemons.append(d)
+    try:
+        peers = [d.peer_info for d in daemons]
+        for d in daemons:
+            d.set_peers(peers)
+        entry, owner = daemons
+        key = _owned_key(owner, "chaos")
+        owner_addr = owner.peer_info.grpc_address
+        # Partition the hit-forward RPC for the first 6 calls from the
+        # entry daemon (connection-shaped: provably unapplied).
+        plans[0].add(FaultRule(
+            peer=owner_addr, op="GetPeerRateLimits", kind=ERROR, count=6,
+        ))
+        # The owner's broadcasts to the entry ride a 5ms injected delay
+        # throughout (the delay leg of the satellite).
+        plans[1].add(FaultRule(
+            peer=entry.peer_info.grpc_address, op="UpdatePeerGlobals",
+            kind="delay", delay_s=0.005,
+        ))
+
+        total = 5
+        entry.service.get_rate_limits(GetRateLimitsRequest(
+            requests=[_global_req("chaos", key, hits=total, limit=100)]
+        ))
+        mgr = entry.service.global_mgr
+        client = _peer_client_for(entry, owner_addr)
+        # Ticks 1-2 burn faulted calls 1-4 (global_send_retries=1 => 2
+        # attempts per tick); tick 3 burns call 5 — the breaker's 5th
+        # consecutive failure OPENS it — and its second attempt
+        # fast-fails circuit-open.  Every tick requeues.
+        for tick in range(3):
+            mgr.run_once()
+            assert mgr._hit_carry[f"chaos_{key}"][4] == total, tick
+        assert client.breaker.is_open
+        rq = _metric_value(entry.service.metrics.global_requeued_hits)
+        assert rq >= 3  # one requeued lane per failed tick
+        assert _metric_value(entry.service.metrics.global_dropped_hits) == 0
+
+        # Breaker open: the next tick never reaches the wire (the
+        # FaultPlan sees no call) and still requeues.
+        fired_before = plans[0].fired(plans[0]._rules[0])  # noqa: SLF001
+        mgr.run_once()
+        assert plans[0].fired(plans[0]._rules[0]) == fired_before
+        assert mgr._hit_carry[f"chaos_{key}"][4] == total
+
+        # Heal: wait out the open interval; the half-open probe burns
+        # faulted call 6 (re-opens), wait again, then the send lands.
+        deadline = time.time() + 10.0
+        while mgr._hit_carry and time.time() < deadline:
+            time.sleep(behaviors.circuit_open_interval_s + 0.05)
+            mgr.run_once()
+        assert not mgr._hit_carry, "hits never delivered after heal"
+
+        # Owner applies + broadcasts (through the injected delay).
+        assert owner.service.global_mgr.run_once()
+        r = owner.service.get_rate_limits(GetRateLimitsRequest(
+            requests=[_global_req("chaos", key, hits=0, limit=100)]
+        )).responses[0]
+        assert not r.error
+        assert r.remaining == 100 - total  # exactly once, nothing lost
+        # The entry's replica saw the (delayed) broadcast.
+        g = entry.service.store.gtable.get(f"chaos_{key}")
+        assert g is not None
+        assert entry.service.store.gtable.rep_expire[g] > T0
+    finally:
+        for d in daemons:
+            d.close()
+
+
+@pytest.mark.chaos
+def test_global_plane_drop_is_accounted_not_requeued():
+    """DROP-shaped (timeout) failures may have applied server-side:
+    requeueing would double-count, so the lanes are DROPPED and the
+    accounting shows up in gubernator_global_dropped_hits — every lane
+    is delivered, requeued, or counted, never silently lost."""
+    svc = _quiet_service()
+    try:
+        svc.set_peers([])
+        plan = FaultPlan(seed=7)
+        plan.add(FaultRule(peer="stub:1", op="*", kind=DROP))
+
+        class _StubPeer:
+            info = PeerInfo(grpc_address="stub:1", is_owner=False)
+
+            def send_columns_direct(self, cols, timeout_s=None, trace_ctx=None):
+                from gubernator_tpu.peer_client import PeerError
+
+                act = plan.intercept("stub:1", "GetPeerRateLimits")
+                raise PeerError("injected timeout", not_ready=act.not_ready)
+
+        svc.get_peer = lambda key: _StubPeer()
+        req = RateLimitRequest(
+            name="glob", unique_key="dr", hits=2, limit=100,
+            duration=60_000, behavior=Behavior.GLOBAL,
+        )
+        svc.store.apply([req], T0, remote_global=True)
+        svc.global_mgr.run_once()
+        assert not svc.global_mgr._hit_carry  # NOT requeued (double-count risk)
+        assert _metric_value(svc.metrics.global_dropped_hits) == 1
+    finally:
+        svc.close()

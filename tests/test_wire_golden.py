@@ -100,6 +100,95 @@ def test_peer_columns_req_golden():
     )
 
 
+def test_globals_columns_req_golden():
+    """peers_columns.proto GlobalsColumnsReq (the columnar GLOBAL
+    broadcast): packed numerics, field numbers pinned so the
+    protoc-less descriptor stays wire-identical to the schema."""
+    m = pc_pb.GlobalsColumnsReq(
+        keys=["k"], algorithm=[1], status=[1], limit=[2], remaining=[3],
+        reset_time=[1000],
+    )
+    assert m.SerializeToString() == bytes(
+        [
+            0x0A, 0x01, ord("k"),    # 1: keys[0]
+            0x12, 0x01, 0x01,        # 2: algorithm, packed
+            0x1A, 0x01, 0x01,        # 3: status, packed
+            0x22, 0x01, 0x02,        # 4: limit, packed
+            0x2A, 0x01, 0x03,        # 5: remaining, packed
+            0x32, 0x02, 0xE8, 0x07,  # 6: reset_time = 1000, packed
+        ]
+    )
+
+
+def test_globals_frame_golden():
+    """The GUBC globals frame (kind 3) byte layout is a wire contract:
+    header | key string column | algo i32 | status i32 | limit i64 |
+    remaining i64 | reset i64, all little-endian."""
+    import numpy as np
+
+    from gubernator_tpu import wire
+    from gubernator_tpu.parallel.global_mgr import GlobalsColumns
+
+    cols = GlobalsColumns(
+        keys=["a", "bc"],
+        algorithm=np.array([1, 0], np.int32),
+        status=np.array([0, 1], np.int32),
+        limit=np.array([5, 6], np.int64),
+        remaining=np.array([4, 5], np.int64),
+        reset_time=np.array([1000, 2000], np.int64),
+    )
+    raw = wire.encode_globals_frame(cols)
+    i32 = lambda v: int(v).to_bytes(4, "little")  # noqa: E731
+    i64 = lambda v: int(v).to_bytes(8, "little")  # noqa: E731
+    expected = (
+        b"GUBC" + bytes([1, 3]) + i32(2)          # magic, ver, kind, n
+        + i32(3) + i32(0) + i32(1) + i32(3) + b"abc"  # key column
+        + i32(1) + i32(0)                         # algorithm
+        + i32(0) + i32(1)                         # status
+        + i64(5) + i64(6)                         # limit
+        + i64(4) + i64(5)                         # remaining
+        + i64(1000) + i64(2000)                   # reset_time
+    )
+    assert raw == expected
+    assert wire.is_globals_frame(raw)
+    back = wire.decode_globals_frame(raw)
+    assert back.keys == ["a", "bc"]
+    assert list(back.reset_time) == [1000, 2000]
+
+
+def test_classic_broadcast_bytes_unchanged():
+    """GUBER_GLOBAL_COLUMNS=0 / classic-negotiated peers must see
+    byte-identical wire to the pre-columns sender in BOTH encodings:
+    the BroadcastBatch classic legs reproduce the legacy per-item
+    pb/JSON encoders exactly."""
+    import json
+
+    from gubernator_tpu import wire
+    from gubernator_tpu.parallel.global_mgr import GlobalsColumns
+    from gubernator_tpu.types import RateLimitResponse, UpdatePeerGlobal
+
+    updates = [
+        UpdatePeerGlobal(
+            key="gp_k", algorithm=1,
+            status=RateLimitResponse(
+                status=1, limit=5, remaining=0, reset_time=1_573_430_430_000
+            ),
+        ),
+        UpdatePeerGlobal(
+            key="gp_j",
+            status=RateLimitResponse(limit=9, remaining=9, reset_time=7),
+        ),
+    ]
+    bb = wire.BroadcastBatch(GlobalsColumns.from_updates(updates))
+    assert (
+        bb.classic_pb().SerializeToString()
+        == wire.update_globals_req_to_pb(updates).SerializeToString()
+    )
+    assert bb.classic_json_bytes() == json.dumps(
+        {"globals": [u.to_json() for u in updates]}
+    ).encode("utf-8")
+
+
 def test_peer_columns_resp_golden():
     m = pc_pb.PeerColumnsResp(
         status=[1], limit=[10], remaining=[9], reset_time=[1000],
