@@ -1,5 +1,5 @@
 # Reference Makefile:1-35 equivalents for the TPU build.
-.PHONY: test tier1 chaos bench bench-gate proto certs docker release clean
+.PHONY: test tier1 chaos bench bench-gate soak-smoke proto certs docker release clean
 
 # The whole suite on the virtual 8-device CPU mesh (conftest.py forces
 # it); -p no:cacheprovider keeps runs hermetic like -count=1.
@@ -36,6 +36,16 @@ bench-gate:
 # The five BASELINE.json configs (one JSON line each); --smoke for CI
 bench-full:
 	python bench_full.py
+
+# CPU-backend soak smoke: a short long_soak-derived run (slow-marked,
+# excluded from tier-1) driving mixed traffic at a 2-daemon cluster
+# while polling GET /debug/status and asserting steady-state
+# invariants (healthy, breakers closed, no shed, occupancy
+# monotone-consistent).  The one-command check of the saturation/SLO
+# observability plane; scripts/cluster_status.py renders the same doc.
+soak-smoke:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_soak_smoke.py -q \
+		-m slow -p no:cacheprovider
 
 proto:
 	bash scripts/proto.sh

@@ -28,6 +28,12 @@ import time
 
 import numpy as np
 
+# Shared ceil-rank (nearest-rank) percentile: ALL p50/p99 sites below
+# index the same way (the old `min(len-1, int(len*q))` floor-indexed,
+# judging thin tails against the wrong sample — round-6 satellite fix;
+# the shared implementation lives beside the /debug/latency snapshots).
+from gubernator_tpu.saturation import percentile
+
 
 def _jax_setup():
     import jax
@@ -230,8 +236,9 @@ def measure_device(jax, now, samples: int = 5):
         "device_cps": device_cps,
         "dispatch_batch_us": dispatch_batch_us,
         "small_batch_us": small_batch_us,
-        "dispatch_p50": dlat[len(dlat) // 2],
-        "dispatch_p99": dlat[min(len(dlat) - 1, int(len(dlat) * 0.99))],
+        "dispatch_p50": percentile(dlat, 0.50),
+        "dispatch_p99": percentile(dlat, 0.99),
+        "dispatch_lat_n_samples": len(dlat),
     }
 
 
@@ -450,7 +457,8 @@ def measure_service_ingress(n_threads: int = 32, svc_iters: int = 10,
     that the host cost is the measured ceiling (the reference benches
     100-way, benchmark_test.go:117).  Shared by main() and the --gate
     fallback so the ingress threshold is evaluable standalone.
-    Returns (checks_per_sec, p50_ms, p99_ms)."""
+    Returns (checks_per_sec, p50_ms, p99_ms, n_samples) — the sample
+    count rides along so gate verdicts can discount thin tails."""
     import threading
 
     from gubernator_tpu.service import IngressColumns, ServiceConfig, V1Service
@@ -505,10 +513,10 @@ def measure_service_ingress(n_threads: int = 32, svc_iters: int = 10,
     svc_dt = time.perf_counter() - t0
     service_cps = svc_batch * svc_iters * n_threads / svc_dt
     svc_lat.sort()
-    svc_p50 = svc_lat[len(svc_lat) // 2] * 1000.0
-    svc_p99 = svc_lat[min(len(svc_lat) - 1, int(len(svc_lat) * 0.99))] * 1000.0
+    svc_p50 = percentile(svc_lat, 0.50) * 1000.0
+    svc_p99 = percentile(svc_lat, 0.99) * 1000.0
     svc.close()
-    return service_cps, svc_p50, svc_p99
+    return service_cps, svc_p50, svc_p99, len(svc_lat)
 
 
 def measure_tracing_overhead(n_threads: int = 8, iters: int = 4):
@@ -525,12 +533,12 @@ def measure_tracing_overhead(n_threads: int = 8, iters: int = 4):
     prev_rate = tracing.sample_rate()
     tracing.force_disable(True)
     try:
-        off_cps, _, _ = measure_service_ingress(n_threads, iters)
+        off_cps, _, _, _ = measure_service_ingress(n_threads, iters)
     finally:
         tracing.force_disable(False)
     tracing.set_sample_rate(0.0)
     try:
-        s0_cps, _, _ = measure_service_ingress(n_threads, iters)
+        s0_cps, _, _, _ = measure_service_ingress(n_threads, iters)
     finally:
         tracing.set_sample_rate(prev_rate)
     return s0_cps / max(off_cps, 1.0), off_cps, s0_cps
@@ -886,9 +894,13 @@ def gate_verdict(value: float, spec: dict, noise: float = 0.0):
     straddling the limit is inconclusive (SKIP) — so timer noise can
     never flip a verdict, which is what makes the row trustworthy
     (round-5's b256 fired below_floor on noise_us 77 vs value 4.7;
-    4.7+77 is still far under the 250 limit, a clean PASS)."""
-    if "fail_above_us" in spec:
-        limit = spec["fail_above_us"]
+    4.7+77 is still far under the 250 limit, a clean PASS).
+
+    Ceiling rows come in two spellings: the historical `fail_above_us`
+    (device rows, µs) and the generic `fail_above` (lower-is-better in
+    the row's own unit — the ingress latency-ms ceilings)."""
+    if "fail_above_us" in spec or "fail_above" in spec:
+        limit = spec.get("fail_above_us", spec.get("fail_above"))
         if value + noise <= limit:
             return "PASS", limit
         if value - noise > limit:
@@ -926,6 +938,10 @@ def gate() -> int:
         if time.time() - saved["time"] < 3600:
             noise = saved.get("noise", {})
             rows = {k: saved[k] for k in thresholds if k in saved}
+            # Sample counts ride along for thin-tail discounting.
+            rows.update({
+                k: v for k, v in saved.items() if k.endswith("_n_samples")
+            })
             print(f"gate: using rows from {LAST_DEVICE_ROWS}")
     except (OSError, KeyError, ValueError):
         pass
@@ -944,8 +960,12 @@ def gate() -> int:
             # Daemon-spawning rows measure separately-guarded: host
             # weather (a corrupt compile cache, OOM) must cost a SKIP,
             # not the whole verdict.
-            ingress_cps, _, _ = measure_service_ingress()
+            ingress_cps, p50, p99, n_lat = measure_service_ingress()
             rows["service_ingress_checks_per_sec"] = ingress_cps
+            rows["service_ingress_latency_ms_p50"] = p50
+            rows["service_ingress_latency_ms_p99"] = p99
+            rows["service_ingress_latency_ms_p50_n_samples"] = n_lat
+            rows["service_ingress_latency_ms_p99_n_samples"] = n_lat
         except Exception as e:  # noqa: BLE001
             print(f"gate service_ingress_checks_per_sec: SKIP (measure failed: {e})")
         try:
@@ -997,8 +1017,23 @@ def gate() -> int:
         if value is None:
             print(f"gate {name}: SKIP (no fresh measurement)")
             continue
+        # Thin-tail discount: a percentile judged from too few samples
+        # is noise shaped like a verdict — rows record n_samples, and
+        # specs with min_samples SKIP below it.
+        n_min = spec.get("min_samples")
+        n_got = rows.get(f"{name}_n_samples")
+        if n_min and n_got is not None and n_got < n_min:
+            print(
+                f"gate {name}: SKIP (thin tail: {n_got} samples "
+                f"< min_samples {n_min})"
+            )
+            continue
         verdict, limit = gate_verdict(value, spec, noise.get(name, 0.0))
-        bound = "fail above" if "fail_above_us" in spec else "fail below"
+        bound = (
+            "fail above"
+            if ("fail_above_us" in spec or "fail_above" in spec)
+            else "fail below"
+        )
         n_txt = f" +-{noise[name]:.1f} noise" if noise.get(name) else ""
         print(f"gate {name}: {value:.2f}{n_txt} ({bound} {limit:.2f}) {verdict}"
               + (" (noise straddles the limit)" if verdict == "SKIP" else ""))
@@ -1082,6 +1117,9 @@ def main():
     # of the software's own cost.
     columnar_cps, step = 0.0, 2 + n_disp * iters
     store.take_pipeline_stats()  # reset the depth high-water mark
+    from gubernator_tpu import saturation as _saturation
+
+    _saturation.lane_util.take()  # reset: measure the headline epochs only
     for _ in range(3):
         t0 = time.perf_counter()
         disp_epoch(step)
@@ -1089,6 +1127,7 @@ def main():
         step += n_disp * iters
         columnar_cps = max(columnar_cps, batch_size * iters * n_disp / dt)
     stage_stats, _, pipeline_depth_hwm = store.take_pipeline_stats()
+    util_lanes, util_padded, util_launches = _saturation.lane_util.take()
     pipeline_stage_ms = {
         stage: round(total / max(count, 1) * 1000.0, 3)
         for stage, (count, total, _mx) in stage_stats.items()
@@ -1103,7 +1142,12 @@ def main():
         dispatch(100 + i).result()
         lat.append(time.perf_counter() - t_b)
     lat.sort()
-    batch_latency_ms = lat[len(lat) // 2] * 1000.0
+    batch_latency_ms = percentile(lat, 0.50) * 1000.0
+    # Occupancy rows from the headline store (host tables only — the
+    # same zero-extra-dispatch read /debug/status serves).
+    occupancy_used = store.size()
+    occupancy_capacity = store.capacity
+    occupancy_evictions = int(store.table.evictions)
 
     # ---- device-only kernel timing -----------------------------------
     dev = measure_device(jax, now)
@@ -1126,7 +1170,7 @@ def main():
     zipf = measure_device_zipf(jax, now)
 
     # ---- service-tier columnar ingress -------------------------------
-    service_cps, svc_p50, svc_p99 = measure_service_ingress()
+    service_cps, svc_p50, svc_p99, svc_lat_n = measure_service_ingress()
 
     # ---- peer hop: loopback two-daemon forward (CPU-pinned) ----------
     peer_forward_cps = measure_peer_forward("columns")
@@ -1144,6 +1188,10 @@ def main():
     # (round-4 verdict: the headline regressed ungated across rounds).
     _save_device_rows(dev, {
         "service_ingress_checks_per_sec": service_cps,
+        "service_ingress_latency_ms_p50": svc_p50,
+        "service_ingress_latency_ms_p99": svc_p99,
+        "service_ingress_latency_ms_p50_n_samples": svc_lat_n,
+        "service_ingress_latency_ms_p99_n_samples": svc_lat_n,
         "peer_forward_checks_per_sec": peer_forward_cps,
         "peer_forward_vs_classic": (
             peer_forward_cps / max(peer_forward_classic_cps, 1.0)
@@ -1188,6 +1236,7 @@ def main():
                 "service_ingress_checks_per_sec": round(service_cps, 1),
                 "service_ingress_latency_ms_p50": round(svc_p50, 2),
                 "service_ingress_latency_ms_p99": round(svc_p99, 2),
+                "service_ingress_latency_n_samples": svc_lat_n,
                 "service_ingress_includes_tunnel_rtt": True,
                 "peer_forward_checks_per_sec": round(peer_forward_cps, 1),
                 "peer_forward_classic_checks_per_sec": round(
@@ -1211,6 +1260,23 @@ def main():
                 "global_plane_vs_classic": round(global_plane_ratio, 2),
                 "batch_size": batch_size,
                 "batch_latency_ms_median": round(batch_latency_ms, 2),
+                "batch_latency_n_samples": len(lat),
+                # Saturation plane rows (PR 6): occupancy + lane
+                # utilization of the headline run, and the always-on
+                # per-phase attribution snapshot (what /debug/latency
+                # serves in a live daemon).
+                "store_occupancy_used": occupancy_used,
+                "store_occupancy_capacity": occupancy_capacity,
+                "store_occupancy_evictions": occupancy_evictions,
+                "lane_utilization_ratio": round(
+                    util_lanes / max(util_padded, 1), 4
+                ),
+                "lane_utilization_launches": util_launches,
+                "attribution_ms_p99": {
+                    phase: snap["p99_ms"]
+                    for phase, snap in _saturation.phase_snapshot().items()
+                    if phase.startswith(("dispatch.", "batch.", "queue."))
+                },
                 "device_batch_us": round(device_batch_us, 1),
                 "device_checks_per_sec": round(device_cps, 1),
                 "device_vs_northstar_50m": round(device_cps / 50e6, 4),
@@ -1243,6 +1309,7 @@ def main():
                 "device_us_b4096_noise_us": round(small_batch_us[4096][3], 1),
                 "dispatch_latency_ms_p50": round(dispatch_p50, 2),
                 "dispatch_latency_ms_p99": round(dispatch_p99, 2),
+                "dispatch_latency_n_samples": dev["dispatch_lat_n_samples"],
                 "dispatch_latency_includes_tunnel_rtt": True,
             }
         )

@@ -120,6 +120,20 @@ class BehaviorConfig:
     # Env: GUBER_TRACE_SAMPLE.
     trace_sample: float = 0.0
 
+    # -- latency SLO engine (saturation.py) ----------------------------
+    # Ingress latency target in ms.  > 0 turns on the SLO burn-rate
+    # engine: every V1/GetRateLimits is judged good/bad against the
+    # target, multi-window (5m/1h) error-budget burn rates export as
+    # gubernator_slo_burn_rate, and a page-level fast burn (>= 14.4x
+    # on the 5m window) dumps the flight recorder.  0 (default)
+    # disables the engine — observe degrades to one comparison.
+    # Env: GUBER_LATENCY_TARGET_MS.
+    latency_target_ms: float = 0.0
+    # SLO objective: the fraction of ingress requests that must answer
+    # under the target (the error budget is 1 - objective).
+    # Env: GUBER_SLO_OBJECTIVE.
+    slo_objective: float = 0.99
+
 
 @dataclass
 class DaemonConfig:
@@ -444,6 +458,30 @@ def setup_daemon_config(
                 f"GUBER_TRACE_SAMPLE must be a float in [0, 1], got '{v}'"
             )
         b.trace_sample = rate
+    v = merged.get("GUBER_LATENCY_TARGET_MS", "")
+    if v:
+        try:
+            target = float(v)
+        except ValueError:
+            raise ValueError(
+                f"GUBER_LATENCY_TARGET_MS must be a number (ms), got '{v}'"
+            ) from None
+        if target < 0:
+            raise ValueError("GUBER_LATENCY_TARGET_MS must be >= 0")
+        b.latency_target_ms = target
+    v = merged.get("GUBER_SLO_OBJECTIVE", "")
+    if v:
+        try:
+            obj = float(v)
+        except ValueError:
+            obj = -1.0
+        if not 0.0 < obj < 1.0:
+            # Loud, not clamped: GUBER_SLO_OBJECTIVE=99 meaning "99%"
+            # would silently demand a zero error budget.
+            raise ValueError(
+                f"GUBER_SLO_OBJECTIVE must be a fraction in (0, 1), got '{v}'"
+            )
+        b.slo_objective = obj
     conf.gossip_seed = _env_int(merged, "GUBER_GOSSIP_SEED", conf.gossip_seed)
 
     # Static peers: GUBER_STATIC_PEERS=grpcAddr[|httpAddr],... (our

@@ -24,6 +24,7 @@ from urllib.parse import parse_qs, urlsplit
 import numpy as np
 
 from . import native as _native
+from . import saturation
 from . import tracing
 from . import wire
 from .config import MAX_BATCH_SIZE, PEER_COLUMNS_MAX_LANES
@@ -237,6 +238,7 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
                 with service.metrics.scrape_lock:
                     service.metrics.observe_cache(service.store)
                     service.metrics.observe_dispatch(service.store)
+                    service.metrics.observe_saturation(service)
                     service.metrics.observe_peers(
                         service.get_peer_list()
                         + list(service.get_region_picker().peers())
@@ -245,8 +247,26 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
                         headers.get("Accept", "") if headers else ""
                     )
                 return 200, ctype, payload
-            if urlsplit(path).path in ("/debug/traces", "/debug/events"):
+            qpath = urlsplit(path).path
+            if qpath in ("/debug/traces", "/debug/events"):
                 return _debug_dump(path)
+            if qpath == "/debug/status":
+                # The cluster-status surface: one JSON doc per daemon
+                # (scripts/cluster_status.py polls these).
+                return 200, "application/json", _json_bytes(
+                    service.debug_status()
+                )
+            if qpath == "/debug/latency":
+                # Live per-phase percentile snapshots from the always-on
+                # attribution reservoirs (saturation.py).
+                return 200, "application/json", _json_bytes({
+                    "phases": saturation.phase_snapshot(),
+                    "slo": service.slo.snapshot(),
+                })
+            if qpath == "/debug/hotkeys":
+                return 200, "application/json", _json_bytes(
+                    service.hotkeys.snapshot()
+                )
             return 404, "application/json", _json_bytes(
                 {"code": 5, "message": f"no handler for {path}"}
             )
@@ -260,18 +280,23 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
             # attaches a trace exemplar from the still-active context.
             with tracing.ingress_span("http", path, tp):
                 with service.metrics.observe_rpc("/pb.gubernator.V1/GetRateLimits"):
+                    t_parse = time.perf_counter()
                     cols = parse_body_native(raw) if raw else None
-                    if cols is not None:
-                        result = service.get_rate_limits_columns(cols)
-                        rendered = render_result_native(result)
-                    else:
+                    native = cols is not None
+                    if not native:
                         body = json.loads(raw) if raw else {}
-                        result = service.get_rate_limits_columns(
-                            parse_columns(body.get("requests", []))
-                        )
-                        rendered = None
+                        cols = parse_columns(body.get("requests", []))
+                    saturation.observe_phase(
+                        "ingress.parse", time.perf_counter() - t_parse
+                    )
+                    result = service.get_rate_limits_columns(cols)
+                    t_enc = time.perf_counter()
+                    rendered = render_result_native(result) if native else None
                     if rendered is None:
                         rendered = _json_bytes(render_columns(result))
+                    saturation.observe_phase(
+                        "response.encode", time.perf_counter() - t_enc
+                    )
             return 200, "application/json", rendered
         if path == "/v1/peer.GetPeerRateLimits":
             # Body parsing happens INSIDE the metrics span on BOTH
@@ -509,11 +534,15 @@ def handle_request_async(service: V1Service, method: str, path: str,
 
     try:
         if path == "/v1/GetRateLimits":
+            t_parse = time.perf_counter()
             cols = parse_body_native(raw) if raw else None
             native = cols is not None
             if cols is None:
                 body = json.loads(raw) if raw else {}
                 cols = parse_columns(body.get("requests", []))
+            saturation.observe_phase(
+                "ingress.parse", time.perf_counter() - t_parse
+            )
 
             def cb(result, exc):
                 # Guarded like the sync catch-all: a render failure on a
@@ -523,11 +552,15 @@ def handle_request_async(service: V1Service, method: str, path: str,
                     if exc is not None:
                         finish("1", _error_triplet(exc))
                         return
+                    t_enc = time.perf_counter()
                     rendered = (
                         render_result_native(result) if native else None
                     )
                     if rendered is None:  # native render unavailable/cap
                         rendered = _json_bytes(render_columns(result))
+                    saturation.observe_phase(
+                        "response.encode", time.perf_counter() - t_enc
+                    )
                     finish("0", (200, "application/json", rendered))
                 except Exception as e:  # noqa: BLE001
                     finish("1", _error_triplet(e))

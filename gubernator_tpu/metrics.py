@@ -23,7 +23,7 @@ from prometheus_client import (
     generate_latest,
 )
 
-from . import tracing
+from . import saturation, tracing
 
 try:  # OpenMetrics exposition carries trace exemplars; text 0.0.4 cannot
     from prometheus_client.openmetrics.exposition import (
@@ -194,6 +194,118 @@ class Metrics:
             ["stage", "stat"],
             registry=self.registry,
         )
+        # -- saturation & SLO observability plane (saturation.py) ------
+        self.latency_attribution = Histogram(
+            "gubernator_latency_attribution_seconds",
+            "Per-phase latency attribution across the request "
+            "waterfall (ingress parse -> batch-window wait -> queue "
+            "wait -> dispatch prepare/stage/launch/fetch/commit -> "
+            "peer-wire RTT -> response encode).  Always-on; the same "
+            "observations back GET /debug/latency's percentile "
+            "snapshots.",
+            ["phase"],
+            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                     0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0),
+            registry=self.registry,
+        )
+        # This instance becomes the plane's histogram sink (last-wins,
+        # like the tracing flight recorder: one daemon per process in
+        # production).
+        saturation.register_sink(self.latency_attribution)
+        self.occupancy_slots = Gauge(
+            "gubernator_occupancy_slots",
+            "Mapped bucket-table slots per shard and tier, read from "
+            "the host tables the existing dispatch readbacks maintain "
+            "(ZERO extra device programs — pinned by a dispatch-count "
+            "test).",
+            ["shard", "tier"],
+            registry=self.registry,
+        )
+        self.occupancy_capacity = Gauge(
+            "gubernator_occupancy_capacity",
+            "Bucket-table slot capacity per shard and tier.",
+            ["shard", "tier"],
+            registry=self.registry,
+        )
+        self.occupancy_evictions = Counter(
+            "gubernator_occupancy_evictions",
+            "LRU evictions per shard (capacity pressure; an eviction "
+            "under load is reference-grade state loss).",
+            ["shard"],
+            registry=self.registry,
+        )
+        self.ingress_queue_lanes = Gauge(
+            "gubernator_ingress_queue_lanes",
+            "Lanes currently queued in the bounded ingress gates "
+            "(sum of the local and columnar batchers) at scrape time; "
+            "GET /debug/status carries the admit-time depth "
+            "distribution.",
+            registry=self.registry,
+        )
+        self.batch_window_wait_seconds = Gauge(
+            "gubernator_batch_window_wait_seconds",
+            "EFFECTIVE coalescing-window wait the next ingress flush "
+            "will use (the adaptive window's current estimate; upper-"
+            "bounded by GUBER_BATCH_WAIT).",
+            registry=self.registry,
+        )
+        self.lane_utilization = Gauge(
+            "gubernator_lane_utilization",
+            "Per-launch lane utilization since the previous scrape: "
+            "stat=lanes (real), stat=padded (pow2-padded shape "
+            "scattered), stat=ratio (fill fraction), stat=launches.  "
+            "Cleared per scrape.",
+            ["stat"],
+            registry=self.registry,
+        )
+        self.dispatcher_busy_ratio = Gauge(
+            "gubernator_dispatcher_busy_ratio",
+            "Fraction of wall time the ingress dispatcher (batch-"
+            "window flush worker) spent flushing since the previous "
+            "scrape — the USE utilization signal for the host "
+            "dispatch tier.",
+            registry=self.registry,
+        )
+        self.slo_latency_target_ms = Gauge(
+            "gubernator_slo_latency_target_ms",
+            "Configured ingress latency SLO target "
+            "(GUBER_LATENCY_TARGET_MS; 0 = SLO engine disabled).",
+            registry=self.registry,
+        )
+        self.slo_burn_rate = Gauge(
+            "gubernator_slo_burn_rate",
+            "Error-budget burn rate per window (bad-fraction / "
+            "budget-fraction; 1.0 burns the budget exactly at accrual "
+            "rate, >=14.4 on the 5m window trips the flight-recorder "
+            "dump).",
+            ["window"],
+            registry=self.registry,
+        )
+        self.slo_requests = Counter(
+            "gubernator_slo_requests",
+            "Ingress requests judged against the latency SLO target.",
+            ["verdict"],  # good | bad
+            registry=self.registry,
+        )
+        self._slo_good = self.slo_requests.labels(verdict="good")
+        self._slo_bad = self.slo_requests.labels(verdict="bad")
+        self.hotkey_lanes = Counter(
+            "gubernator_hotkey_lanes",
+            "Lanes folded into the hot-key count-min sketch "
+            "(hash_ring owner-code hashes; GET /debug/hotkeys serves "
+            "the top-K).",
+            registry=self.registry,
+        )
+        self.hotkey_topk = Gauge(
+            "gubernator_hotkey_topk",
+            "Decayed count-min estimates of the current hot-key "
+            "top-K (bounded cardinality; rebuilt per scrape).",
+            ["key"],
+            registry=self.registry,
+        )
+        # SloEngine (saturation.py), attached by the owning V1Service;
+        # observe_latency judges GetRateLimits requests against it.
+        self.slo = None
 
     @contextmanager
     def observe_rpc(self, method: str):
@@ -220,6 +332,15 @@ class Metrics:
         sync observe_rpc (ambient per-thread context) and the async
         gateway finish path (which passes its span's context explicitly:
         completion threads have no ambient one)."""
+        if method == "/pb.gubernator.V1/GetRateLimits":
+            # SLO + attribution accounting for the public ingress RPC:
+            # the whole-request wall time is the waterfall's root row,
+            # and the SLO engine judges it against the latency target.
+            saturation.observe_phase("ingress.total", dt)
+            if self.slo is not None:
+                good = self.slo.observe(dt)
+                if good is not None:
+                    (self._slo_good if good else self._slo_bad).inc()
         hist = self.request_duration_hist.labels(method=method)
         if ctx is None and tracing.enabled():
             ctx = tracing.current()
@@ -304,6 +425,57 @@ class Metrics:
             lab(stage=stage, stat="count").set(count)
             lab(stage=stage, stat="sum").set(total_s)
             lab(stage=stage, stat="max").set(max_s)
+
+    def observe_saturation(self, service) -> None:
+        """Refresh the saturation/SLO plane gauges (collect-on-scrape,
+        under the gateway's scrape lock like every other observer).
+        Everything read here is host-side state the dispatch path
+        already maintains — the scrape launches no device program."""
+        store = service.store
+        occupancy = getattr(store, "occupancy_stats", None)
+        self.occupancy_slots.clear()
+        self.occupancy_capacity.clear()
+        if occupancy is not None:
+            for row in occupancy():
+                sh = str(row["shard"])
+                slots, caps = self.occupancy_slots, self.occupancy_capacity
+                slots.labels(shard=sh, tier="front").set(row["used"])
+                caps.labels(shard=sh, tier="front").set(row["capacity"])
+                self._bump(
+                    self.occupancy_evictions.labels(shard=sh),
+                    row["evictions"],
+                )
+                if "back_used" in row:
+                    slots.labels(shard=sh, tier="back").set(row["back_used"])
+                    caps.labels(shard=sh, tier="back").set(
+                        row["back_capacity"]
+                    )
+        self.ingress_queue_lanes.set(service.ingress_queued_lanes())
+        self.batch_window_wait_seconds.set(
+            service.columnar_batcher._window.effective_wait_s()
+        )
+        lanes, padded, launches = saturation.lane_util.take()
+        self.lane_utilization.clear()
+        lab = self.lane_utilization.labels
+        lab(stat="lanes").set(lanes)
+        lab(stat="padded").set(padded)
+        lab(stat="launches").set(launches)
+        if padded:
+            lab(stat="ratio").set(lanes / padded)
+        busy, elapsed = saturation.dispatcher_busy.take()
+        self.dispatcher_busy_ratio.set(min(busy / elapsed, 1.0))
+        slo = self.slo
+        if slo is not None:
+            self.slo_latency_target_ms.set(slo.target_ms if slo.enabled else 0)
+            for name, w in slo.WINDOWS.items():
+                self.slo_burn_rate.labels(window=name).set(slo.burn_rate(w))
+        sketch = getattr(service, "hotkeys", None)
+        if sketch is not None:
+            snap = sketch.snapshot()
+            self._bump(self.hotkey_lanes, snap["total_lanes"])
+            self.hotkey_topk.clear()
+            for row in snap["topk"]:
+                self.hotkey_topk.labels(key=row["key"]).set(row["estimate"])
 
     def _bump(self, counter, absolute: float) -> None:
         current = counter._value.get()  # noqa: SLF001

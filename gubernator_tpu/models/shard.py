@@ -24,6 +24,7 @@ import jax
 import numpy as np
 
 from .. import native
+from .. import saturation
 from .. import tracing
 from ..ops import buckets
 from ..types import (
@@ -669,9 +670,18 @@ class ColumnarPipeline:
         self._stats_lock = threading.Lock()
         self._depth_hwm = 0
         self._seen_wire_shapes: set = set()  # (W, narrow) staged so far
+        # Device programs launched by this store's columnar pipeline —
+        # the "telemetry adds zero device dispatches" contract is
+        # pinned by COUNTING this (tests/test_observability.py), the
+        # replica_commit_dispatches playbook.
+        self.device_dispatches = 0
 
     # -- observability (metrics.observe_dispatch scrapes these) --------
     def _observe_stage(self, stage: str, dt: float) -> None:
+        # Always-on latency attribution (saturation.py): the same
+        # number feeds the per-scrape stage gauge below and the
+        # gubernator_latency_attribution_seconds{phase} reservoir.
+        saturation.observe_phase(f"dispatch.{stage}", dt)
         with self._stats_lock:
             st = self._stage_stats.setdefault(stage, [0, 0.0, 0.0])
             st[0] += 1
@@ -681,6 +691,31 @@ class ColumnarPipeline:
     def pipeline_depth(self) -> int:
         """Batches dispatched but not yet resolved (gauge value)."""
         return len(self._inflight)
+
+    def occupancy_stats(self) -> "List[dict]":
+        """Per-shard occupancy from the HOST slot tables the dispatch
+        commits already maintain — THE one occupancy read of the
+        saturation plane (zero device programs; consumed by
+        Metrics.observe_saturation and V1Service.debug_status).  Works
+        for both stores: ShardStore exposes `table`, the mesh store
+        `tables` (+ the optional back tier)."""
+        tables = getattr(self, "tables", None) or [self.table]
+        back_cap = int(getattr(self, "back_capacity_per_shard", 0) or 0)
+        out = []
+        for s, t in enumerate(tables):
+            row = {
+                "shard": s,
+                "used": len(t),
+                "capacity": int(t.capacity),
+                "evictions": int(t.evictions),
+            }
+            if back_cap:
+                # tier_stats: (total, back_keys, demotions, promotions,
+                # back_evictions).
+                row["back_used"] = int(t.tier_stats[1])
+                row["back_capacity"] = back_cap
+            out.append(row)
+        return out
 
     def take_pipeline_stats(self):
         """Drain the per-stage timing aggregates accumulated since the
@@ -717,6 +752,9 @@ class ColumnarPipeline:
         self._observe_stage("prepare", dt)
         tracing.stage_span("prepare", dt, bt, ticket=handle.ticket,
                            lanes=prep.n)
+        # Lane utilization: real lanes vs the pow2-padded shape the
+        # launch will scatter (saturation plane; drained per scrape).
+        saturation.lane_util.add(prep.n, self._padded_lanes(prep))
         try:
             t1 = time.perf_counter()
             staged = self._stage_columns(prep)
@@ -817,6 +855,11 @@ class ColumnarPipeline:
         if exc is not None:
             raise exc
 
+    def _padded_lanes(self, prep) -> int:
+        """Total padded lanes one launch of `prep` scatters (the mesh
+        store overrides: its pad is per shard)."""
+        return prep.padded
+
     # -- launch implementations (shared by ShardStore / MeshBucketStore)
     def _pre_launch(self) -> None:
         """Hook: device work that must precede the group's programs
@@ -833,6 +876,9 @@ class ColumnarPipeline:
         program; each handle's fetch reads its slice of the shared
         stacked result, transferred once."""
         self._pre_launch()
+        # One program per group (fused or solo) — counted, not timed:
+        # the zero-extra-dispatch telemetry contract asserts on this.
+        self.device_dispatches += 1
         if len(group) == 1:
             staged, h = group[0]
             self.state, packed = staged.solo(self.state)

@@ -114,11 +114,15 @@ class ReplicatedConsistentHash:
         n = len(self._vnode_owner)
         return [self._vnode_owner[i if i < n else 0] for i in idxs]
 
-    def get_batch_codes(self, keys) -> "tuple[np.ndarray, List[str]]":
+    def get_batch_codes(self, keys, sketch=None) -> "tuple[np.ndarray, List[str]]":
         """Fully vectorized owner lookup: (codes i32[n], id_list) where
         codes index id_list (one entry per peer, insertion order).
         `keys` may be a list of strings or a native.PackedKeys — either
-        way no per-lane Python objects are created here."""
+        way no per-lane Python objects are created here.
+
+        `sketch` (saturation.HotKeySketch) piggybacks on the hashes
+        this lookup computes anyway: hot-key detection costs zero
+        extra hashing on the routing hot path."""
         if not self._peers:
             raise RuntimeError("unable to pick a peer; pool is empty")
         if self.hash_fn in (_fnv1_str, _fnv1a_str):
@@ -127,6 +131,8 @@ class ReplicatedConsistentHash:
             hs = native.fnv1_batch(keys, variant_1a=self.hash_fn is _fnv1a_str)
         else:
             hs = np.array([self.hash_fn(k) for k in keys], dtype=np.uint64)
+        if sketch is not None:
+            sketch.update(hs, keys)
         idxs = np.searchsorted(self._vnode_hashes, hs, side="left")
         idxs[idxs == len(self._vnode_owner)] = 0
         return self._vnode_code[idxs], self._code_ids

@@ -806,6 +806,10 @@ class MeshBucketStore(ColumnarPipeline):
             solo=lambda state: fn(state, batch, rid_dev, n_rounds, now_ms)
         )
 
+    def _padded_lanes(self, prep) -> int:
+        # Mesh pads PER SHARD: one launch scatters S * padded lanes.
+        return prep.padded * self.n_shards
+
     def _pre_launch(self) -> None:
         # Tier moves queued by the group's plans (and any stale window)
         # must land before the batch programs read front rows.  One

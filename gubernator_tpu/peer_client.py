@@ -38,6 +38,7 @@ import grpc
 import numpy as np
 
 from . import faults as faults_mod
+from . import saturation
 from . import tracing
 from . import wire
 from .config import MAX_BATCH_SIZE, PEER_COLUMNS_MAX_LANES, BehaviorConfig
@@ -525,6 +526,11 @@ class PeerClient:
                 rpc_err = e
                 raise
             finally:
+                # Always-on attribution: the forwarded hop's round trip
+                # is one of the waterfall's phases (saturation.py).
+                saturation.observe_phase(
+                    "peer.rpc", (time.monotonic_ns() - t0) / 1e9
+                )
                 bt = tracing.new_batch(links)
                 if bt is not None:
                     # The client half of the cross-daemon hop: one span

@@ -23,6 +23,7 @@ from .utils.logging import category_logger
 
 import numpy as np
 
+from . import saturation
 from . import tracing
 from . import wire
 from .config import MAX_BATCH_SIZE, PEER_COLUMNS_MAX_LANES, BehaviorConfig
@@ -95,6 +96,10 @@ class _IngressGate:
         self._queued = 0
         self._mu = threading.Lock()
 
+    @property
+    def queued(self) -> int:
+        return self._queued
+
     def admit(self, lanes: int) -> None:
         """Reserve `lanes` or raise IngressShedError (counted)."""
         if self.cap <= 0:
@@ -106,6 +111,10 @@ class _IngressGate:
             else:
                 self._queued += lanes
                 shed = False
+                queued = self._queued
+        # Saturation plane: sample the post-admit depth (sheds sample
+        # the at-capacity depth) — /debug/status serves the p50/p99.
+        saturation.observe_queue_depth(queued)
         if shed:
             if self.metrics is not None:
                 self.metrics.ingress_shed.inc(lanes)
@@ -203,6 +212,9 @@ class LocalBatcher:
         except IngressShedError as e:
             fut.set_exception(e)
             return fut
+        # Attribution stamp: the flush measures each submission's
+        # coalescing-window wait from this instant (saturation.py).
+        fut._submit_t = time.monotonic()
         # A submit racing past the stopped check is still safe: stop()
         # drains and flushes the queue after joining the worker.
         self._window.submit((req, fut))
@@ -210,6 +222,11 @@ class LocalBatcher:
 
     def _flush(self, batch) -> None:
         self._gate.release(len(batch))
+        t_flush = time.monotonic()
+        for _, fut in batch:
+            st = getattr(fut, "_submit_t", None)
+            if st is not None:
+                saturation.observe_phase("batch.window", t_flush - st)
         try:
             resps = self.store.apply(
                 [r for r, _ in batch], self.clock.now_ms()
@@ -733,6 +750,9 @@ class ColumnarBatcher:
         except IngressShedError as e:
             fut.set_exception(e)
             return fut
+        # Attribution stamp (always-on): the flush measures this
+        # submission's coalescing-window wait (saturation.py).
+        fut._submit_t = time.monotonic()
         if trace_links:
             # Per-lane span handles (tracing.py): the flush joins every
             # submission's links into the batch.window span and the
@@ -748,6 +768,13 @@ class ColumnarBatcher:
 
     def _flush(self, batch) -> None:
         self._gate.release(sum(len(item[0][0]) for item in batch))
+        # Saturation plane: per-submission window-wait attribution and
+        # the dispatcher's busy fraction (flush wall time over elapsed).
+        t_flush = time.monotonic()
+        for _, fut in batch:
+            st = getattr(fut, "_submit_t", None)
+            if st is not None:
+                saturation.observe_phase("batch.window", t_flush - st)
         # The window admits the submission that CROSSES the lane limit
         # (it cannot un-take from the queue), so one flush can overshoot
         # MAX_LANES by up to a submission; re-chunk so no single device
@@ -763,8 +790,10 @@ class ColumnarBatcher:
             lanes += n
         if chunk:
             self._flush_chunk(chunk)
+        saturation.dispatcher_busy.add(time.monotonic() - t_flush)
 
     def _flush_chunk(self, batch) -> None:
+        t_chunk = time.monotonic()
         try:
             # Overload backstop (see MAX_INFLIGHT): block on the oldest
             # unresolved dispatch only when the pipeline is pathologically
@@ -799,6 +828,13 @@ class ColumnarBatcher:
                     for i in range(1, 8)
                 )
             algo, beh, hits, limit, duration, ge, gd = arrays
+            # queue.wait: flush start -> dispatch submit — the backstop
+            # wait on a pathologically deep pipeline plus the concat
+            # (near-zero in steady state; the phase that grows when the
+            # device falls behind the arrival rate).
+            saturation.observe_phase(
+                "queue.wait", time.monotonic() - t_chunk
+            )
             bt = self._batch_trace(batch)
             if bt is not None:
                 tracing.stage_batch_trace(bt)
@@ -931,6 +967,18 @@ class V1Service:
         self.columnar_batcher = ColumnarBatcher(
             self.store, conf.behaviors, self.clock, metrics=self.metrics
         )
+        # Saturation & SLO plane (saturation.py): the latency-SLO burn
+        # engine (GUBER_LATENCY_TARGET_MS; disabled at 0) judges every
+        # ingress RPC via metrics.observe_latency, and the hot-key
+        # sketch rides the ring's owner-code hashes (zero extra
+        # hashing) for GET /debug/hotkeys.
+        self.slo = saturation.SloEngine(
+            getattr(conf.behaviors, "latency_target_ms", 0.0),
+            getattr(conf.behaviors, "slo_objective", 0.99),
+        )
+        self.metrics.slo = self.slo
+        self.hotkeys = saturation.HotKeySketch()
+        self._started_monotonic = time.monotonic()
         self.global_mgr = GlobalManager(self)
         self.multi_region_mgr = MultiRegionManager(self)
 
@@ -1121,7 +1169,7 @@ class V1Service:
                 else:  # PackedKeys (native edge / peer frame decode)
                     keys_for_ring = hash_keys.subset(np.nonzero(valid)[0])
                 codes, code_ids = self.local_picker.get_batch_codes(
-                    keys_for_ring
+                    keys_for_ring, sketch=self.hotkeys
                 )
                 if all_valid:
                     lane_code = codes
@@ -1998,6 +2046,93 @@ class V1Service:
                 breaker_open_count=self._health.breaker_open_count,
                 version=__version__,
             )
+
+    # ------------------------------------------------------------------
+    def ingress_queued_lanes(self) -> int:
+        """Lanes currently admitted into the bounded ingress gates
+        (both batchers share the GUBER_INGRESS_QUEUE_LANES budget but
+        account separately)."""
+        return (
+            self.local_batcher._gate.queued
+            + self.columnar_batcher._gate.queued
+        )
+
+    _BREAKER_NAMES = {0: "closed", 1: "half-open", 2: "open"}
+
+    def debug_status(self) -> dict:
+        """The cluster-status surface (GET /debug/status): one JSON doc
+        aggregating version, health, per-peer breaker state, bucket-
+        table occupancy, ingress-queue depth, and SLO burn — what
+        scripts/cluster_status.py polls and the soak harness asserts
+        against.  Reads only host-side state: zero device programs."""
+        from . import __version__
+
+        hc = self._health_check()
+        peers = []
+        with self._peer_mutex:
+            peer_list = list(self.local_picker.peers()) + list(
+                self.region_picker.peers()
+            )
+        for p in peer_list:
+            breaker = getattr(p, "breaker", None)
+            info = getattr(p, "info", None)
+            if info is None:
+                continue
+            peers.append({
+                "peer": info.grpc_address,
+                "isOwner": bool(info.is_owner),
+                "breaker": self._BREAKER_NAMES.get(
+                    breaker.state_code if breaker is not None else 0,
+                    "closed",
+                ),
+            })
+        store = self.store
+        occupancy = getattr(store, "occupancy_stats", None)
+        shards = occupancy() if occupancy is not None else []
+        used_total = sum(r["used"] for r in shards)
+        cap_total = sum(r["capacity"] for r in shards)
+        ev_total = sum(r["evictions"] for r in shards)
+        gate_cap = getattr(
+            self.conf.behaviors, "ingress_queue_lanes", 0
+        )
+        status = {
+            "version": __version__,
+            "uptimeS": round(time.monotonic() - self._started_monotonic, 1),
+            "health": {
+                "status": hc.status,
+                "message": hc.message,
+                "peerCount": hc.peer_count,
+                "breakerOpenCount": hc.breaker_open_count,
+            },
+            "peers": peers,
+            "occupancy": {
+                "used": used_total,
+                "capacity": cap_total,
+                "evictions": ev_total,
+                "ratio": round(used_total / cap_total, 4) if cap_total else 0.0,
+                "shards": shards,
+            },
+            "ingress": {
+                "queuedLanes": self.ingress_queued_lanes(),
+                "capLanes": gate_cap,
+                "shedLanes": int(
+                    self.metrics.ingress_shed._value.get()  # noqa: SLF001
+                ),
+                "depth": saturation.queue_depth_snapshot(),
+                "windowWaitS": round(
+                    self.columnar_batcher._window.effective_wait_s(), 6
+                ),
+            },
+            "dispatch": {
+                "inflight": int(getattr(store, "pipeline_depth", lambda: 0)()),
+                "deviceDispatches": int(
+                    getattr(store, "device_dispatches", 0)
+                ),
+            },
+            "slo": self.slo.snapshot(),
+            "hotkeys": self.hotkeys.snapshot()["topk"][:5],
+        }
+        return status
 
     # ------------------------------------------------------------------
     def set_peers(self, peer_infos: Sequence[PeerInfo]) -> None:

@@ -233,7 +233,11 @@ _events = _Ring(EVENT_RING_CAPACITY)
 # structured log (rate-limited so an open breaker can't storm it).
 # global-send-failed: a GLOBAL broadcast/hit-forward send exhausted its
 # retry budget — the same lost-progress signal a breaker trip is.
-_DUMP_KINDS = frozenset({"breaker-open", "shed", "fault", "global-send-failed"})
+# slo-fast-burn: the SLO engine (saturation.py) measured a page-level
+# error-budget burn on its short window — dump while the evidence of
+# WHERE the latency went is still in the ring.
+_DUMP_KINDS = frozenset({"breaker-open", "shed", "fault",
+                         "global-send-failed", "slo-fast-burn"})
 _DUMP_MIN_INTERVAL_S = 5.0
 _last_dump = [0.0]
 _dump_lock = threading.Lock()

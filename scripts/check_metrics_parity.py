@@ -63,6 +63,20 @@ EXTENSIONS = frozenset(
         "gubernator_global_fanout_concurrency",
         "gubernator_global_requeued_hits",
         "gubernator_global_dropped_hits",
+        # PR 6: saturation & SLO observability plane (saturation.py)
+        "gubernator_latency_attribution_seconds",
+        "gubernator_occupancy_slots",
+        "gubernator_occupancy_capacity",
+        "gubernator_occupancy_evictions",
+        "gubernator_ingress_queue_lanes",
+        "gubernator_batch_window_wait_seconds",
+        "gubernator_lane_utilization",
+        "gubernator_dispatcher_busy_ratio",
+        "gubernator_slo_latency_target_ms",
+        "gubernator_slo_burn_rate",
+        "gubernator_slo_requests",
+        "gubernator_hotkey_lanes",
+        "gubernator_hotkey_topk",
     }
 )
 

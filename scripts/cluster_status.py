@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Cluster status poller: render every daemon's GET /debug/status as
+one table — the whole-cluster view of the saturation & SLO plane
+(health, breaker state, bucket-table occupancy, ingress queue, SLO
+burn).  The soak harness (make soak-smoke, tests/test_soak_smoke.py)
+asserts against the same JSON doc this renders.
+
+Usage:
+    python scripts/cluster_status.py HOST:PORT [HOST:PORT ...]
+    python scripts/cluster_status.py --watch 5 10.0.0.1:1050 10.0.0.2:1050
+    python scripts/cluster_status.py --json HOST:PORT      # raw docs
+
+Exit status: 0 when every polled daemon answered and reports healthy
+with all breakers closed; 1 otherwise — so a deploy script can gate on
+it directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+COLUMNS = ("daemon", "health", "peers", "brk-open", "occupancy",
+           "evict", "queue", "shed", "burn-5m", "burn-1h", "hot-key")
+
+
+def fetch_status(addr: str, timeout_s: float = 5.0) -> dict:
+    url = f"http://{addr}/debug/status"
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+def summarize(addr: str, doc: dict) -> dict:
+    occ = doc.get("occupancy", {})
+    ingress = doc.get("ingress", {})
+    slo = doc.get("slo", {})
+    hot = doc.get("hotkeys") or []
+    return {
+        "daemon": addr,
+        "health": doc.get("health", {}).get("status", "?"),
+        "peers": doc.get("health", {}).get("peerCount", 0),
+        "brk-open": doc.get("health", {}).get("breakerOpenCount", 0),
+        "occupancy": f"{occ.get('used', 0)}/{occ.get('capacity', 0)}",
+        "evict": occ.get("evictions", 0),
+        "queue": ingress.get("queuedLanes", 0),
+        "shed": ingress.get("shedLanes", 0),
+        "burn-5m": slo.get("burn_rate_5m", "-") if slo.get("enabled") else "-",
+        "burn-1h": slo.get("burn_rate_1h", "-") if slo.get("enabled") else "-",
+        "hot-key": hot[0]["key"] if hot else "-",
+    }
+
+
+def render(rows: list) -> str:
+    widths = {
+        c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+        for c in COLUMNS
+    }
+    lines = ["  ".join(c.ljust(widths[c]) for c in COLUMNS)]
+    for r in rows:
+        lines.append(
+            "  ".join(str(r.get(c, "")).ljust(widths[c]) for c in COLUMNS)
+        )
+    return "\n".join(lines)
+
+
+def poll_once(addrs: list, as_json: bool) -> int:
+    rows, docs, rc = [], {}, 0
+    for addr in addrs:
+        try:
+            doc = fetch_status(addr)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            rows.append({"daemon": addr, "health": f"UNREACHABLE ({e})"})
+            rc = 1
+            continue
+        docs[addr] = doc
+        row = summarize(addr, doc)
+        if row["health"] != "healthy" or row["brk-open"]:
+            rc = 1
+        rows.append(row)
+    if as_json:
+        print(json.dumps(docs, indent=2))
+    else:
+        print(render(rows))
+    return rc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("addrs", nargs="+", metavar="HOST:PORT",
+                    help="daemon HTTP gateway addresses")
+    ap.add_argument("--watch", type=float, metavar="SECONDS", default=0,
+                    help="re-poll every N seconds until interrupted")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print raw /debug/status docs instead of the table")
+    args = ap.parse_args()
+    if not args.watch:
+        return poll_once(args.addrs, args.as_json)
+    rc = 0
+    try:
+        while True:
+            print(f"-- {time.strftime('%H:%M:%S')} --")
+            rc = max(rc, poll_once(args.addrs, args.as_json))
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        # Exit-code contract holds in watch mode too: nonzero if ANY
+        # poll saw an unreachable/unhealthy daemon.
+        return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
