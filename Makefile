@@ -15,7 +15,9 @@ tier1:
 		--continue-on-collection-errors -p no:cacheprovider
 
 # Fault-injection suite (pytest.ini `chaos` marker): breaker /
-# backoff / degraded-eval behavior under seeded fault plans,
+# backoff / degraded-eval behavior under seeded fault plans, the
+# resharding scenarios (owner death mid-transfer, DROP/DELAY on
+# transfer frames, exactly-once oracle — tests/test_reshard_chaos.py),
 # including the slow soaks tier-1 skips.
 chaos:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \

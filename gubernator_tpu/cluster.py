@@ -21,13 +21,22 @@ DATA_CENTER_ONE = "datacenter-1"
 
 
 def fast_test_behaviors() -> BehaviorConfig:
-    """Shortened windows (cluster/cluster.go:104-110)."""
+    """Shortened windows (cluster/cluster.go:104-110).
+
+    reshard_handoff_s=0: the double-dispatch read window after a
+    membership change is OFF by default in tests — every cluster
+    fixture's startup (spawn -> feed full peer list) is a membership
+    change, and a 2s window of peeked reads would shadow what most
+    tests mean to measure.  State transfers still run; suites that
+    exercise the window set their own value
+    (tests/test_reshard_chaos.py)."""
     return BehaviorConfig(
         global_sync_wait_s=0.05,
         global_timeout_s=5.0,
         batch_timeout_s=5.0,
         multi_region_sync_wait_s=0.05,
         multi_region_timeout_s=5.0,
+        reshard_handoff_s=0.0,
     )
 
 

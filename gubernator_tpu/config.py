@@ -134,6 +134,24 @@ class BehaviorConfig:
     # Env: GUBER_SLO_OBJECTIVE.
     slo_objective: float = 0.99
 
+    # -- elastic membership / live resharding (reshard.py) -------------
+    # On a ring delta, drain moved device-resident counters off the old
+    # owner and ship them to the new owner as a columnar transfer
+    # (GUBC frame kind 4 / PeersV1.TransferOwnership), instead of
+    # silently orphaning them — a scale-out event stops being a
+    # cluster-wide rate-limit reset.  False = the pre-reshard interop
+    # mode: no transfer surface is served (senders negotiate down,
+    # exactly like talking to an old build), no handoff is initiated,
+    # and a ring change resets moved buckets (legacy semantics).
+    # Env: GUBER_RESHARD.
+    reshard: bool = True
+    # Double-dispatch read window after a membership change: for this
+    # long, reads of keys whose owner moved are also peeked (hits=0) at
+    # the OLD owner and merged monotonically, so no request observes a
+    # reset bucket while the state transfer is in flight.  0 disables
+    # the window (transfers still run).  Env: GUBER_RESHARD_HANDOFF.
+    reshard_handoff_s: float = 2.0
+
 
 @dataclass
 class DaemonConfig:
@@ -445,6 +463,12 @@ def setup_daemon_config(
     b.global_send_retries = _env_int(
         merged, "GUBER_GLOBAL_SEND_RETRIES", b.global_send_retries
     )
+    b.reshard = _env_bool(merged, "GUBER_RESHARD", b.reshard)
+    b.reshard_handoff_s = _env_float_ms(
+        merged, "GUBER_RESHARD_HANDOFF", b.reshard_handoff_s
+    )
+    if b.reshard_handoff_s < 0:
+        raise ValueError("GUBER_RESHARD_HANDOFF must be >= 0")
     v = merged.get("GUBER_TRACE_SAMPLE", "")
     if v:
         try:

@@ -169,7 +169,13 @@ class Daemon:
         (daemon.go:277-287).  Both of this daemon's addresses count as
         "me": a static peer list naming only the HTTP address (the
         reference's lists name gRPC addresses, but a gateway-only config
-        is legal here) must still self-identify."""
+        is legal here) must still self-identify.
+
+        Late updates after close() are dropped: a discovery poller
+        thread racing shutdown must not rebuild pickers (or trigger a
+        resharding handoff) against a half-torn-down service."""
+        if self._closed or self.service is None:
+            return
         mine = {self.service.conf.advertise_address, self.http_advertise}
         stamped = []
         for p in peers:

@@ -311,6 +311,13 @@ class FaultPlan:
         """Time out exactly the Nth matching RPC (1-based)."""
         return self.add(FaultRule(peer=peer, op=op, kind=DROP, after=n - 1, count=1))
 
+    def drop(self, peer: str = "*", op: str = "*", rate: float = 1.0) -> FaultRule:
+        """Time out matching RPCs (timeout-shaped: the call may have
+        executed server-side, so callers must not blind-retry) at the
+        seeded `rate` until healed — lossy-network chaos, e.g. DROP on
+        the resharding transfer frames."""
+        return self.add(FaultRule(peer=peer, op=op, kind=DROP, rate=rate))
+
     def error_nth(self, peer: str, n: int, op: str = "*", count: int = 1) -> FaultRule:
         """Fail connection-shaped starting at the Nth matching RPC."""
         return self.add(

@@ -33,7 +33,8 @@ from .types import Algorithm, RateLimitRequest, UpdatePeerGlobal, _parse_behavio
 
 
 
-_GRPC_CODES = {"InvalidArgument": 3, "OutOfRange": 11, "Internal": 13}
+_GRPC_CODES = {"InvalidArgument": 3, "OutOfRange": 11, "Internal": 13,
+               "FailedPrecondition": 9}
 
 _STATUS_NAMES = ("UNDER_LIMIT", "OVER_LIMIT")
 
@@ -325,6 +326,31 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
             )
         if path == "/debug/profile":
             return _debug_profile(raw)
+        if path == "/v1/peer.TransferOwnership" and service.serves_reshard:
+            # Ownership-transfer receive (elastic membership): GUBC
+            # transfer frame in, ONE batched merge-commit.  A daemon
+            # with the plane off (GUBER_RESHARD=0) never reaches here —
+            # it falls through to the 404 below, exactly what a
+            # pre-reshard build answers, which is the sender's version
+            # probe (sticky classic fallback).
+            with service.metrics.observe_rpc(
+                "/pb.gubernator.PeersV1/TransferOwnership"
+            ):
+                if not wire.is_transfer_frame(raw):
+                    raise ApiError(
+                        "InvalidArgument",
+                        "TransferOwnership expects a GUBC transfer frame",
+                    )
+                try:
+                    cols = wire.decode_transfer_frame(raw)
+                except ValueError as e:
+                    raise ApiError(
+                        "InvalidArgument", f"invalid transfer frame: {e}"
+                    ) from e
+                committed, rejected = service.transfer_ownership(cols)
+            return 200, "application/json", _json_bytes(
+                {"committed": committed, "rejected": rejected}
+            )
         if path == "/v1/peer.UpdatePeerGlobals":
             with service.metrics.observe_rpc(
                 "/pb.gubernator.PeersV1/UpdatePeerGlobals"

@@ -81,6 +81,10 @@ _STATUS_CODES = {
     "InvalidArgument": grpc.StatusCode.INVALID_ARGUMENT,
     "OutOfRange": grpc.StatusCode.OUT_OF_RANGE,
     "Internal": grpc.StatusCode.INTERNAL,
+    # The reshard epoch fence (service.transfer_ownership): a transfer
+    # stamped with a dead ring's fingerprint must not commit, and the
+    # sender must see a distinct, non-retryable answer.
+    "FailedPrecondition": grpc.StatusCode.FAILED_PRECONDITION,
 }
 
 
@@ -261,6 +265,21 @@ def _peers_v1_handler(service: V1Service) -> grpc.GenericRpcHandler:
         except ApiError as e:
             _abort_api_error(context, e)
 
+    def transfer_ownership(
+        request: pc_pb.TransferColumnsReq, context
+    ) -> pc_pb.TransferResp:
+        """Ownership-transfer receive (elastic membership, reshard.py):
+        the whole batch merge-commits through ONE batched device
+        gather+scatter (store.commit_transfer); a dead-epoch batch is
+        fenced with FAILED_PRECONDITION."""
+        try:
+            committed, rejected = service.transfer_ownership(
+                wire.transfer_cols_from_pb(request)
+            )
+            return pc_pb.TransferResp(committed=committed, rejected=rejected)
+        except ApiError as e:
+            _abort_api_error(context, e)
+
     methods = {
         "GetPeerRateLimits": grpc.unary_unary_rpc_method_handler(
             get_peer_rate_limits,
@@ -294,5 +313,16 @@ def _peers_v1_handler(service: V1Service) -> grpc.GenericRpcHandler:
             update_peer_globals_columns,
             request_deserializer=pc_pb.GlobalsColumnsReq.FromString,
             response_serializer=peers_pb.UpdatePeerGlobalsResp.SerializeToString,
+        )
+    if service.serves_reshard:
+        # Same advertisement rule on the reshard knob
+        # (V1Service.serves_reshard): GUBER_RESHARD=0 withholds the
+        # method so senders see UNIMPLEMENTED — exactly what a
+        # pre-reshard daemon answers — and degrade sticky to the
+        # classic (reset-on-move) behavior for this peer.
+        methods["TransferOwnership"] = grpc.unary_unary_rpc_method_handler(
+            transfer_ownership,
+            request_deserializer=pc_pb.TransferColumnsReq.FromString,
+            response_serializer=pc_pb.TransferResp.SerializeToString,
         )
     return grpc.method_handlers_generic_handler(PEERS_V1_SERVICE, methods)

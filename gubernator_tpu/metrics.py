@@ -303,6 +303,38 @@ class Metrics:
             ["key"],
             registry=self.registry,
         )
+        # -- elastic membership / resharding (reshard.py) --------------
+        self.reshard_transfers = Counter(
+            "gubernator_reshard_transfers",
+            "Ownership-transfer batches by outcome: started (drained "
+            "and sent), committed (merge-applied by the new owner), "
+            "aborted (reinstalled locally after a send failure, "
+            "unsupported peer, or epoch fence — the bounded "
+            "reset-on-move fallback), fenced (receive-side dead-epoch "
+            "rejections).",
+            ["result"],
+            registry=self.registry,
+        )
+        self.reshard_lanes = Counter(
+            "gubernator_reshard_lanes",
+            "Transferred counter lanes by direction: out (drained and "
+            "committed at a new owner), in (merge-committed here), "
+            "rejected (received but not owned under the current ring).",
+            ["direction"],
+            registry=self.registry,
+        )
+        self.reshard_handoff_seconds = Gauge(
+            "gubernator_reshard_handoff_seconds",
+            "Wall time of the last drain->transfer handoff pass "
+            "(set per scrape).",
+            registry=self.registry,
+        )
+        self.ring_generation = Gauge(
+            "gubernator_ring_generation",
+            "Monotonic membership-change counter of this daemon's peer "
+            "ring (bumped by every set_peers that changes membership).",
+            registry=self.registry,
+        )
         # SloEngine (saturation.py), attached by the owning V1Service;
         # observe_latency judges GetRateLimits requests against it.
         self.slo = None
@@ -476,6 +508,12 @@ class Metrics:
             self.hotkey_topk.clear()
             for row in snap["topk"]:
                 self.hotkey_topk.labels(key=row["key"]).set(row["estimate"])
+        # Elastic membership: ring generation + last handoff wall time
+        # (the counters are incremented live by the ReshardManager).
+        self.ring_generation.set(getattr(service, "ring_generation", 0))
+        mgr = getattr(service, "reshard", None)
+        if mgr is not None:
+            self.reshard_handoff_seconds.set(mgr.last_handoff_seconds)
 
     def _bump(self, counter, absolute: float) -> None:
         current = counter._value.get()  # noqa: SLF001

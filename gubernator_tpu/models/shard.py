@@ -1359,7 +1359,133 @@ class ShardStore(ColumnarPipeline):
         finally:
             self._unlock_drained()
 
+    # ------------------------------------------------------------------
+    # Elastic membership: columnar state handoff (reshard.py) — the
+    # single-shard twin of MeshBucketStore.drain_keys/commit_transfer.
+    # ------------------------------------------------------------------
+    def resident_keys(self) -> List[str]:
+        """Keys currently resident in the slot table (ring-delta scan
+        input).  Host-only, no device programs — held under the plan
+        lock (like snapshot_items): the native key enumeration is a
+        size-then-fill marshal that a concurrent planner growing the
+        table would overrun."""
+        self._drain_then_lock()
+        try:
+            return list(self.table.keys())
+        finally:
+            self._unlock_drained()
 
+    def resident_mask(self, keys) -> np.ndarray:
+        """Which keys currently map to a slot (the handoff peek's
+        observe-don't-create filter; see MeshBucketStore)."""
+        out = np.zeros(len(keys), dtype=bool)
+        for j, k in enumerate(keys):
+            out[j] = self.table.get_slot(k) is not None
+        return out
+
+    def drain_keys(self, keys, now_ms: int, remove: bool = True):
+        """Drain moved keys: ONE gather program for the whole batch
+        (atomic w.r.t. dispatches — the pipeline is drained and the
+        plan lock held).  remove=False leaves the table untouched (the
+        handoff's gather-then-forget-on-ack protocol); expired rows are
+        never shipped."""
+        from ..reshard import TransferColumns
+
+        self._drain_then_lock()
+        try:
+            found = [
+                (k, s) for k in keys
+                if (s := self.table.get_slot(k)) is not None
+            ]
+            if not found:
+                return TransferColumns.empty()
+            slots = np.asarray([s for _, s in found], np.int32)
+            rows = jax.tree.map(
+                np.asarray, buckets.read_rows(self.state, slots)
+            )
+            self.device_dispatches += 1
+            if remove:
+                for k, _ in found:
+                    self.table.remove(k)
+            live = np.nonzero(np.asarray(rows.expire_at) >= now_ms)[0]
+            return TransferColumns(
+                keys=[found[int(i)][0] for i in live],
+                algorithm=np.asarray(rows.algo)[live].astype(np.int32),
+                status=np.asarray(rows.status)[live].astype(np.int32),
+                limit=np.asarray(rows.limit)[live].astype(np.int64),
+                remaining=np.asarray(rows.remaining)[live].astype(np.int64),
+                duration=np.asarray(rows.duration)[live].astype(np.int64),
+                stamp=np.asarray(rows.stamp)[live].astype(np.int64),
+                expire_at=np.asarray(rows.expire_at)[live].astype(np.int64),
+            )
+        finally:
+            self._unlock_drained()
+
+    def forget_keys(self, keys) -> None:
+        """Drop keys from the table after a transfer ACK (no device
+        program; see MeshBucketStore.forget_keys)."""
+        self._drain_then_lock()
+        try:
+            for k in keys:
+                self.table.remove(k)
+        finally:
+            self._unlock_drained()
+
+    def commit_transfer(self, cols, now_ms: int) -> int:
+        """Receive side of an ownership transfer: assign slots, gather
+        the CURRENT rows for already-resident keys, merge monotonically
+        (reshard.merge_transfer_rows — idempotent under re-delivery),
+        and scatter back.  O(1) device programs per batch (gather +
+        scatter), counted in `device_dispatches`."""
+        from ..reshard import merge_transfer_rows
+
+        n = len(cols)
+        if n == 0:
+            return 0
+        self._drain_then_lock()
+        try:
+            fresh = np.nonzero(np.asarray(cols.expire_at) >= now_ms)[0]
+            seen: Dict[str, int] = {}
+            for j in fresh:
+                seen[cols.keys[int(j)]] = int(j)
+            idx = np.fromiter(seen.values(), np.int64, count=len(seen))
+            if not idx.size:
+                return 0
+            slots = np.empty(idx.size, np.int32)
+            exists = np.zeros(idx.size, dtype=bool)
+            for j, i in enumerate(idx):
+                slots[j], exists[j] = self.table.lookup_or_assign(
+                    cols.keys[int(i)], now_ms
+                )
+            cur = jax.tree.map(
+                np.asarray, buckets.read_rows(self.state, slots)
+            )
+            merged = merge_transfer_rows(
+                {
+                    "algo": cur.algo, "status": cur.status,
+                    "limit": cur.limit, "remaining": cur.remaining,
+                    "stamp": cur.stamp, "expire_at": cur.expire_at,
+                },
+                cols, idx, now_ms, exists,
+            )
+            self.state = buckets.write_rows(
+                self.state, slots,
+                buckets.BucketRows(
+                    algo=merged["algo"], limit=merged["limit"],
+                    remaining=merged["remaining"],
+                    duration=merged["duration"], stamp=merged["stamp"],
+                    expire_at=merged["expire_at"], status=merged["status"],
+                ),
+            )
+            self.device_dispatches += 2
+            self.algo_mirror[slots] = merged["algo"]
+            for j in range(idx.size):
+                self.table.set_expire(
+                    int(slots[j]), int(merged["expire_at"][j])
+                )
+            return int(idx.size)
+        finally:
+            self._unlock_drained()
 
     # ------------------------------------------------------------------
     def _run_round(

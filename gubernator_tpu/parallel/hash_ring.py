@@ -114,6 +114,16 @@ class ReplicatedConsistentHash:
         n = len(self._vnode_owner)
         return [self._vnode_owner[i if i < n else 0] for i in idxs]
 
+    def fingerprint(self) -> int:
+        """Order-independent 64-bit identity of this ring's MEMBERSHIP
+        (+ vnode count): the epoch stamp ownership transfers are fenced
+        on (reshard.ring_fingerprint).  Two daemons that were handed
+        the same peer list compute the same fingerprint with no
+        coordination."""
+        from ..reshard import ring_fingerprint
+
+        return ring_fingerprint(sorted(self._peers.keys()), self.replicas)
+
     def get_batch_codes(self, keys, sketch=None) -> "tuple[np.ndarray, List[str]]":
         """Fully vectorized owner lookup: (codes i32[n], id_list) where
         codes index id_list (one entry per peer, insertion order).

@@ -28,7 +28,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 import threading
 from functools import partial
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -269,6 +269,22 @@ def _write_row_jit(state, s, slot, rows):
     return jax.tree.map(lambda col, val: col.at[s, slot].set(val[0]), state, vals)
 
 
+@jax.jit
+def _gather_rows_mesh_jit(state, slots):
+    """Reshard drain/merge gather: full bucket rows for [S, P] padded
+    slot arrays — ONE device program per drain batch regardless of lane
+    count (padding lanes carry slot sentinels whose garbage rows the
+    host masks by per-shard count)."""
+    return jax.vmap(buckets.read_rows)(state, slots)
+
+
+@partial(jax.jit, donate_argnums=0)
+def _write_rows_mesh_jit(state, slots, rows):
+    """Reshard commit scatter: [S, P] transferred rows in one donated
+    program (slot -1 = padding, dropped inside buckets.write_rows)."""
+    return jax.vmap(buckets.write_rows)(state, slots, rows)
+
+
 _SYNC_FN_CACHE: dict = {}
 
 # Process-wide serialization of the GLOBAL sync collective — the mesh's
@@ -501,6 +517,11 @@ class MeshBucketStore(ColumnarPipeline):
         # O(1)-dispatch-per-broadcast contract is pinned by counting,
         # not timing (tests/test_global_plane.py).
         self.replica_commit_dispatches = 0
+        # Same counting contract for the resharding plane
+        # (tests/test_reshard.py): one gather program per drain batch,
+        # gather+scatter (2) per transfer commit.
+        self.transfer_drain_dispatches = 0
+        self.transfer_commit_dispatches = 0
 
         self._sharding = NamedSharding(self.mesh, P(self.axis))
         # Wire donation (launch stage): accelerators copy uploads, so
@@ -1015,6 +1036,238 @@ class MeshBucketStore(ColumnarPipeline):
                     )
                     items.extend(_rows_to_items(bkeys, rows))
         return items
+
+    # ------------------------------------------------------------------
+    # Elastic membership: columnar state handoff (reshard.py).
+    # ------------------------------------------------------------------
+    @_drained_locked
+    def resident_keys(self) -> "List[str]":
+        """Every key currently resident in the FRONT slot tables (the
+        ring-delta scan input).  Back-tier rows do not migrate: they
+        are the cold long tail by construction, and a stale row at the
+        old owner is unreachable once routing moves — it ages out of
+        the FIFO (architecture.md "Membership & resharding" documents
+        the bound).  Host-only, no device programs — but it must hold
+        the PLAN lock like snapshot_items: the native table's key
+        enumeration is a size-then-fill marshal, and a concurrent
+        batch planner growing the table between the two calls would
+        overrun the fill buffer."""
+        out: List[str] = []
+        for t in self.tables:
+            out.extend(t.keys())
+        return out
+
+    def resident_mask(self, keys) -> np.ndarray:
+        """Which keys currently map to a slot — the handoff peek's
+        observe-don't-create filter (a zero-hit dispatch for an absent
+        key would mint a shadow bucket that later rides the transfer
+        plane as noise).  Single guarded C++ lookups per key: safe
+        without the plan lock, unlike the size-then-fill enumeration
+        resident_keys needs it for."""
+        out = np.zeros(len(keys), dtype=bool)
+        for j, k in enumerate(keys):
+            t = self.tables[shard_of_key(k, self.n_shards)]
+            out[j] = t.get_slot(k) is not None
+        return out
+
+    @_drained_locked
+    def drain_keys(self, keys, now_ms: int, remove: bool = True):
+        """Drain moved keys off the device: resolve their slots in the
+        host tables and gather the full bucket rows with ONE mesh-wide
+        device program (the PR 5 readback playbook in reverse) —
+        atomically with respect to dispatches (the pipeline is drained
+        and the plan lock held).  With remove=True the keys also leave
+        the tables immediately; the resharding handoff passes
+        remove=False and calls forget_keys() only after the transfer is
+        ACKED, so the old owner's copy stays readable (the
+        double-dispatch peek target) for the whole in-flight window and
+        an aborted transfer loses nothing.  Keys no longer resident
+        (evicted/expired since the ring-delta scan) and GLOBAL keys
+        (they migrate through their own replication plane — every peer
+        already holds replica state and the new owner's first sync
+        takes over aggregation) are skipped.  Returns a
+        reshard.TransferColumns."""
+        from ..reshard import TransferColumns
+
+        per_slot: List[List[int]] = [[] for _ in range(self.n_shards)]
+        per_keys: List[List[str]] = [[] for _ in range(self.n_shards)]
+        gkeys = self.gtable._key_to_gslot  # noqa: SLF001
+        for k in keys:
+            if k in gkeys:
+                continue
+            s = shard_of_key(k, self.n_shards)
+            slot = self.tables[s].get_slot(k)
+            if slot is None:
+                continue
+            per_slot[s].append(slot)
+            per_keys[s].append(k)
+        max_n = max((len(x) for x in per_slot), default=0)
+        if max_n == 0:
+            return TransferColumns.empty()
+        # Two-tier: get_slot may have queued promotions; land them so
+        # the front rows we gather are current.
+        self._drain_moves()
+        S = self.n_shards
+        P = _pad_pow2(max_n)
+        slots = np.full((S, P), -1, dtype=np.int32)
+        for s in range(S):
+            if per_slot[s]:
+                slots[s, : len(per_slot[s])] = per_slot[s]
+        rows = jax.tree.map(
+            np.asarray,
+            _gather_rows_mesh_jit(
+                self.state, jax.device_put(slots, self._sharding)
+            ),
+        )
+        self.transfer_drain_dispatches += 1
+        self.device_dispatches += 1
+        out_keys: List[str] = []
+        cols = {
+            name: [] for name in (
+                "algo", "status", "limit", "remaining", "duration",
+                "stamp", "expire_at",
+            )
+        }
+        for s in range(S):
+            n = len(per_keys[s])
+            if n == 0:
+                continue
+            out_keys.extend(per_keys[s])
+            cols["algo"].append(rows.algo[s, :n])
+            cols["status"].append(rows.status[s, :n])
+            cols["limit"].append(rows.limit[s, :n])
+            cols["remaining"].append(rows.remaining[s, :n])
+            cols["duration"].append(rows.duration[s, :n])
+            cols["stamp"].append(rows.stamp[s, :n])
+            cols["expire_at"].append(rows.expire_at[s, :n])
+            if remove:
+                for k in per_keys[s]:
+                    self.tables[s].remove(k)
+        cat = {k: np.concatenate(v) for k, v in cols.items()}
+        # Expired rows (warmup keys, long-idle buckets) are removed
+        # from the tables like everything else but carry no state worth
+        # shipping: filter them out of the wire payload.
+        live = np.nonzero(cat["expire_at"] >= now_ms)[0]
+        return TransferColumns(
+            keys=[out_keys[int(i)] for i in live],
+            algorithm=cat["algo"][live].astype(np.int32),
+            status=cat["status"][live].astype(np.int32),
+            limit=cat["limit"][live].astype(np.int64),
+            remaining=cat["remaining"][live].astype(np.int64),
+            duration=cat["duration"][live].astype(np.int64),
+            stamp=cat["stamp"][live].astype(np.int64),
+            expire_at=cat["expire_at"][live].astype(np.int64),
+        )
+
+    @_drained_locked
+    def forget_keys(self, keys) -> None:
+        """Drop keys from the host tables (no device program: a freed
+        slot's stale row is overwritten on reassignment, exists=False).
+        The resharding handoff calls this after a transfer is ACKED —
+        hits the old owner admitted between the drain gather and this
+        point are the documented in-flight slack."""
+        for k in keys:
+            self.tables[shard_of_key(k, self.n_shards)].remove(k)
+
+    @_drained_locked
+    def commit_transfer(self, cols, now_ms: int) -> int:
+        """Receive side of an ownership transfer: assign slots for the
+        whole batch in the host tables, gather the CURRENT rows for
+        keys already resident (they admitted traffic during the handoff
+        window), MERGE monotonically (reshard.merge_transfer_rows:
+        remaining=min, status/stamp/expire=max — idempotent, so a
+        re-delivered transfer cannot double-count), and scatter the
+        merged rows back with ONE donated program.  O(1) device
+        dispatches per batch (gather + scatter), pinned by counting
+        `transfer_commit_dispatches` / `device_dispatches` — the
+        set_replica_batch playbook applied to the main bucket tables.
+        Returns the number of lanes committed."""
+        from ..reshard import merge_transfer_rows
+
+        n = len(cols)
+        if n == 0:
+            return 0
+        # Dead rows (already expired in transit) are not worth a slot.
+        fresh = np.nonzero(np.asarray(cols.expire_at) >= now_ms)[0]
+        # Duplicate keys keep the LAST lane (dict semantics; also keeps
+        # the scatter's indices unique — duplicate scatter order is
+        # unspecified).
+        seen: Dict[str, int] = {}
+        for j in fresh:
+            seen[cols.keys[int(j)]] = int(j)
+        idx = np.fromiter(seen.values(), dtype=np.int64, count=len(seen))
+        if not idx.size:
+            return 0
+        m = idx.size
+        shard_ix = np.empty(m, np.int32)
+        slot_ix = np.empty(m, np.int32)
+        exists_ix = np.zeros(m, dtype=bool)
+        for j, i in enumerate(idx):
+            k = cols.keys[int(i)]
+            s = shard_of_key(k, self.n_shards)
+            slot, exists = self.tables[s].lookup_or_assign(k, now_ms)
+            shard_ix[j] = s
+            slot_ix[j] = slot
+            exists_ix[j] = exists
+        # Two-tier: lookup_or_assign may queue promotions for keys that
+        # lived in the back tier; land them before reading front rows.
+        self._drain_moves()
+        S = self.n_shards
+        counts = np.bincount(shard_ix, minlength=S)
+        P = _pad_pow2(int(counts.max()))
+        slots = np.full((S, P), -1, dtype=np.int32)
+        lane_of = np.empty(m, np.int64)  # (shard, col) -> flat lane j
+        fill = np.zeros(S, np.int64)
+        for j in range(m):
+            s = int(shard_ix[j])
+            slots[s, fill[s]] = slot_ix[j]
+            lane_of[j] = s * P + fill[s]
+            fill[s] += 1
+        slots_dev = jax.device_put(slots, self._sharding)
+        cur = jax.tree.map(
+            np.asarray, _gather_rows_mesh_jit(self.state, slots_dev)
+        )
+        flat = lambda a: a.reshape(-1)[lane_of]  # noqa: E731
+        merged = merge_transfer_rows(
+            {
+                "algo": flat(cur.algo),
+                "status": flat(cur.status),
+                "limit": flat(cur.limit),
+                "remaining": flat(cur.remaining),
+                "stamp": flat(cur.stamp),
+                "expire_at": flat(cur.expire_at),
+            },
+            cols, idx, now_ms, exists_ix,
+        )
+        pack = {}
+        for name, dtype in (
+            ("algo", np.int32), ("status", np.int32), ("limit", np.int64),
+            ("remaining", np.int64), ("duration", np.int64),
+            ("stamp", np.int64), ("expire_at", np.int64),
+        ):
+            buf = np.zeros((S * P,), dtype=dtype)
+            buf[lane_of] = merged[name]
+            pack[name] = buf.reshape(S, P)
+        self.state = _write_rows_mesh_jit(
+            self.state,
+            slots_dev,
+            buckets.BucketRows(
+                algo=pack["algo"], limit=pack["limit"],
+                remaining=pack["remaining"], duration=pack["duration"],
+                stamp=pack["stamp"], expire_at=pack["expire_at"],
+                status=pack["status"],
+            ),
+        )
+        self.transfer_commit_dispatches += 2
+        self.device_dispatches += 2
+        # Host mirrors: the algo mirror feeds algorithm-switch
+        # detection; the table expiry feeds planning/eviction.
+        self.algo_mirror[shard_ix, slot_ix] = merged["algo"]
+        for j in range(m):
+            self.tables[int(shard_ix[j])].set_expire(
+                int(slot_ix[j]), int(merged["expire_at"][j])
+            )
+        return int(m)
 
     # ------------------------------------------------------------------
     def set_replica(self, update, now_ms: int) -> None:

@@ -137,6 +137,7 @@ class PeerClient:
         self._rpc_get_peer_rate_limits_columns = None
         self._rpc_update_peer_globals = None
         self._rpc_update_peer_globals_columns = None
+        self._rpc_transfer_ownership = None
         self._shutdown = threading.Event()
         self._err_lock = threading.Lock()
         self._last_err: Dict[str, float] = {}  # message -> expiry timestamp
@@ -162,6 +163,15 @@ class PeerClient:
         # the client's lifetime, like _columnar.
         self._globals_columnar: Optional[bool] = (
             None if getattr(self.behaviors, "global_columns", True) else False
+        )
+        # Ownership-transfer plane negotiation (reshard.py), on its own
+        # GUBER_RESHARD knob: None = untried (the first transfer
+        # probes), True = peer accepts transfers, False = no transfer
+        # surface (pre-reshard peer, or its knob is off) — sticky for
+        # the client's lifetime like the other planes; churn rebuilds
+        # the client and re-negotiates.
+        self._transfer_supported: Optional[bool] = (
+            None if getattr(self.behaviors, "reshard", True) else False
         )
         # Per-RPC lane caps.  The operator's GUBER_BATCH_LIMIT keeps
         # meaning on both encodings: it is the classic per-RPC cap
@@ -428,6 +438,94 @@ class PeerClient:
         )
 
     # ------------------------------------------------------------------
+    def transfer_ownership(
+        self, cols, timeout_s: Optional[float] = None
+    ) -> str:
+        """Ship one ownership-transfer batch (reshard.TransferColumns)
+        to this peer — the new owner of the batch's keys after a ring
+        delta.  Returns:
+
+          * "ok"          — the peer merge-committed the batch.
+          * "unsupported" — the peer has no transfer surface
+            (pre-reshard build or GUBER_RESHARD=0).  Sticky per client
+            and breaker/health-neutral: a version answer, not a fault.
+          * "fenced"      — the peer's ring changed again and it
+            rejected this dead-epoch batch (FAILED_PRECONDITION / 409).
+            Also breaker/health-neutral — the fence is the protocol
+            working, not the peer failing.
+
+        Raises PeerError on real transport failures (breaker-counted).
+        The receive-side commit is monotone/idempotent, so retrying a
+        timeout-shaped failure can never double-count."""
+        if self._shutdown.is_set():
+            raise PeerError(ERR_CLOSING, not_ready=True)
+        if self._transfer_supported is False:
+            return "unsupported"
+        if self.transport == "http":
+            return self._guarded_call(
+                "TransferOwnership",
+                lambda: self._post_transfer_inner(cols, timeout_s),
+            )
+        return self._guarded_call(
+            "TransferOwnership",
+            lambda: self._grpc_transfer_inner(cols, timeout_s),
+        )
+
+    def _grpc_transfer_inner(self, cols, timeout_s: Optional[float]) -> str:
+        timeout = (
+            timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
+        )
+        try:
+            self._ensure_channel()
+            with self._conn_lock:
+                rpc = self._rpc_transfer_ownership
+            if rpc is None:  # torn down by a concurrent reset
+                raise PeerError(ERR_CLOSING, not_ready=True)
+            try:
+                rpc(wire.transfer_cols_to_pb(cols), timeout=timeout)
+                self._transfer_supported = True
+                return "ok"
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if code == grpc.StatusCode.UNIMPLEMENTED:
+                    # The method never executed: remember and let the
+                    # caller fall back to classic (pre-reshard)
+                    # semantics; the probe is breaker/health-neutral.
+                    self._transfer_supported = False
+                    return "unsupported"
+                if code == grpc.StatusCode.FAILED_PRECONDITION:
+                    return "fenced"
+                raise
+        except grpc.RpcError as e:
+            raise self._wrap_grpc_error("TransferOwnership", e) from e
+        except ValueError as e:
+            raise self._wrap_value_error("TransferOwnership", e) from e
+
+    def _post_transfer_inner(self, cols, timeout_s: Optional[float]) -> str:
+        """Transfer over HTTP: the GUBC transfer frame against
+        /v1/peer.TransferOwnership.  An old peer (or GUBER_RESHARD=0)
+        has no handler on that path — 404, provably unapplied — and a
+        receiver that fenced the epoch answers 409; both are remembered
+        /returned without counting against breaker or health."""
+        try:
+            self._http_roundtrip(
+                "/v1/peer.TransferOwnership",
+                wire.encode_transfer_frame(cols),
+                timeout_s, wire.COLUMNS_CONTENT_TYPE,
+            )
+            self._transfer_supported = True
+            return "ok"
+        except PeerError as e:
+            if e.http_status in (400, 404, 415, 501):
+                self._transfer_supported = False
+                self._clear_last_err(str(e))
+                return "unsupported"
+            if e.http_status == 409:
+                self._clear_last_err(str(e))
+                return "fenced"
+            raise
+
+    # ------------------------------------------------------------------
     def _send_batch(self, batch: List[tuple]) -> None:
         """peer_client.go:316-348 sendQueue, columnar: concatenate the
         queued column sub-batches and send ONE columnar RPC per chunk.
@@ -646,6 +744,11 @@ class PeerClient:
                     request_serializer=pc_pb.GlobalsColumnsReq.SerializeToString,
                     response_deserializer=peers_pb.UpdatePeerGlobalsResp.FromString,
                 )
+                self._rpc_transfer_ownership = self._channel.unary_unary(
+                    f"/{PEERS_V1_SERVICE}/TransferOwnership",
+                    request_serializer=pc_pb.TransferColumnsReq.SerializeToString,
+                    response_deserializer=pc_pb.TransferResp.FromString,
+                )
             return (
                 self._rpc_get_peer_rate_limits,
                 self._rpc_update_peer_globals,
@@ -822,6 +925,7 @@ class PeerClient:
                 self._rpc_update_peer_globals = None
                 self._rpc_get_peer_rate_limits_columns = None
                 self._rpc_update_peer_globals_columns = None
+                self._rpc_transfer_ownership = None
 
     # ------------------------------------------------------------------
     # HTTP/JSON fallback transport (the peer's gateway surface)

@@ -54,6 +54,7 @@ class FilePool:
         self.poll_s = poll_s
         self._stop = threading.Event()
         self._mtime = 0.0
+        self._last_peers: "Optional[List[PeerInfo]]" = None
         try:
             # A torn/invalid file at construction is transient the same
             # way it is mid-poll: log and let the first tick retry
@@ -85,6 +86,15 @@ class FilePool:
         # file must retry on the next tick, not mark the content as
         # seen and drop the update forever.
         self._mtime = mtime
+        if peers == self._last_peers:
+            # Touched-but-unchanged file (config management rewrites,
+            # atomic-replace deploy loops): membership didn't change,
+            # so don't push a spurious update downstream — set_peers
+            # would rebuild the pickers for nothing, and membership
+            # no-ops must never look like ring churn to the resharding
+            # plane.
+            return
+        self._last_peers = peers
         self.on_update(peers)
 
     def _run(self) -> None:

@@ -26,6 +26,7 @@ import numpy as np
 from . import saturation
 from . import tracing
 from . import wire
+from .reshard import ReshardManager, TransferColumns
 from .config import MAX_BATCH_SIZE, PEER_COLUMNS_MAX_LANES, BehaviorConfig
 from .faults import Backoff
 from .metrics import Metrics
@@ -356,6 +357,12 @@ class _ColumnsPlan:
     slow_idx: list  # lanes for the dataclass router
     slow_fn: "Optional[Callable[[], list]]"  # blocking slow-lane resolver
     hash_keys: object  # List[str] | PackedKeys
+    # Handoff double-dispatch peeks (elastic membership): one grouped
+    # zero-hit read per PREVIOUS owner for lanes whose ownership moved,
+    # merged monotonically after the primary legs resolve.  Entries are
+    # ("remote", forward future, lanes) | ("local", (handle, lo, hi),
+    # lanes); all best-effort.
+    peeks: list = field(default_factory=list)
 
 
 def _lane_response(out: dict, lo: int) -> RateLimitResponse:
@@ -456,6 +463,54 @@ def _merge_group_result(result, idxs, addr, resps) -> None:
         return
     for i, r in zip(idxs, resps):
         result.overrides[int(i)] = r
+
+
+def _merge_peek_result(result, lanes, payload) -> None:
+    """Monotone-merge one resolved zero-hit peek group (the handoff
+    double-dispatch, architecture.md "Membership & resharding") into
+    the result arrays: status = max (OVER_LIMIT wins), remaining = min,
+    reset_time = max — never more permissive than either side, so bulk
+    columnar reads cannot observe a reset bucket mid-transfer.  Lanes
+    that resolved as overrides (errors, fallback legs) and peek lanes
+    that themselves errored are left untouched; payload None (a failed
+    peek — the old owner dying is exactly when this runs) leaves every
+    primary answer standing."""
+    if payload is None:
+        return
+    kind, data = payload
+    m = len(lanes)
+    keep = np.fromiter(
+        (int(i) not in result.overrides for i in lanes), bool, count=m
+    )
+    if kind == "remote":
+        rc, lo, hi = data
+        if rc.overrides:
+            keep &= np.fromiter(
+                ((lo + j) not in rc.overrides for j in range(m)),
+                bool, count=m,
+            )
+        st = np.asarray(rc.status[lo:hi])
+        rem = np.asarray(rc.remaining[lo:hi])
+        rst = np.asarray(rc.reset_time[lo:hi])
+        lim = np.asarray(rc.limit[lo:hi])
+    else:
+        out, sl = data
+        st = np.asarray(out["status"][sl])
+        rem = np.asarray(out["remaining"][sl])
+        rst = np.asarray(out["reset_time"][sl])
+        lim = np.asarray(out["limit"][sl])
+    # Consumption evidence only: a REMOTE peek cannot be residency-
+    # filtered at the sender, so a key already forgotten at the old
+    # owner answers as a fresh bucket (remaining == limit, UNDER) —
+    # merging that would only inflate reset_time.  An untouched
+    # genuine bucket is skipped identically (nothing to carry).
+    keep &= (rem < lim) | (st > 0)
+    if not keep.any():
+        return
+    idx = np.asarray(lanes, dtype=np.int64)[keep]
+    result.status[idx] = np.maximum(result.status[idx], st[keep])
+    result.remaining[idx] = np.minimum(result.remaining[idx], rem[keep])
+    result.reset_time[idx] = np.maximum(result.reset_time[idx], rst[keep])
 
 
 def _merge_fast_result(result, hash_keys, fast_idx, out, sl, exc) -> None:
@@ -603,6 +658,7 @@ class _ColumnsJoin:
         self._fast_outs: list = []  # (fast_idx, out, slice, exc)
         self._group_res: dict = {}  # addr -> resps | Exception
         self._slow_resps: "Optional[list]" = None
+        self._peek_res: list = []  # (lanes, payload | None)
 
     def start(self) -> None:
         svc, plan = self.svc, self.plan
@@ -610,6 +666,7 @@ class _ColumnsJoin:
             len(plan.pendings)
             + len(plan.group_futs)
             + (1 if plan.slow_idx else 0)
+            + len(plan.peeks)
         )
         if parts == 0:
             self._finish()
@@ -634,6 +691,18 @@ class _ColumnsJoin:
                 handle, lo, hi = pending
                 drainer.register(
                     handle, partial(self._on_out, fast_idx, slice(lo, hi))
+                )
+        for kind, payload, lanes in plan.peeks:
+            # Handoff peeks: window flushes resolve every forward
+            # future (result or exception) and the drainer resolves
+            # every handle, so the countdown can never hang on one.
+            if kind == "remote":
+                _attach_done(payload, partial(self._on_peek_remote, lanes))
+            else:
+                handle, lo, hi = payload
+                drainer.register(
+                    handle,
+                    partial(self._on_peek_local, lanes, slice(lo, hi)),
                 )
 
     # -- sub-completion handlers (any thread) --------------------------
@@ -669,6 +738,22 @@ class _ColumnsJoin:
                 self._failure = e
         self._countdown()
 
+    def _on_peek_remote(self, lanes, fut) -> None:
+        try:
+            rc, lo, hi = fut.result()
+            payload = ("remote", (rc, lo, hi))
+        except Exception:  # noqa: BLE001 — peek is best-effort
+            payload = None
+        with self._lock:
+            self._peek_res.append((lanes, payload))
+        self._countdown()
+
+    def _on_peek_local(self, lanes, sl, out, exc) -> None:
+        payload = None if exc is not None else ("local", (out, sl))
+        with self._lock:
+            self._peek_res.append((lanes, payload))
+        self._countdown()
+
     def _countdown(self) -> None:
         with self._lock:
             self._remaining -= 1
@@ -692,6 +777,8 @@ class _ColumnsJoin:
                     _merge_fast_result(
                         result, plan.hash_keys, fast_idx, out, sl, exc
                     )
+                for lanes, payload in self._peek_res:
+                    _merge_peek_result(result, lanes, payload)
             except Exception as e:  # noqa: BLE001
                 result, err = None, e
         self.callback(result if err is None else None, err)
@@ -929,6 +1016,17 @@ class V1Service:
         self.local_picker = conf.local_picker or ReplicatedConsistentHash()
         self.region_picker = conf.region_picker or RegionPicker()
         self._peer_mutex = threading.RLock()
+        # Elastic membership (reshard.py): the ring's generation counter
+        # and membership fingerprint (the transfer epoch fence), the
+        # previous ring retained for the double-dispatch read window,
+        # and the manager running drains/transfers + dropped-peer
+        # shutdowns on one bounded pool.  All ring fields are guarded by
+        # _peer_mutex.
+        self.ring_generation = 0
+        self.ring_hash = 0
+        self._prev_picker: "Optional[ReplicatedConsistentHash]" = None
+        self._handoff_deadline = 0.0  # monotonic; 0 = no window
+        self.reshard = ReshardManager(self)
         self._health = HealthCheckResponse(status=HEALTHY)
         self._forward_pool = ThreadPoolExecutor(max_workers=64)
         # Async slow-lane / dataclass-fallback work runs on its OWN pool:
@@ -1013,6 +1111,20 @@ class V1Service:
         replica commit."""
         return getattr(self.conf.behaviors, "global_columns", True) and hasattr(
             self.store, "set_replica_batch"
+        )
+
+    @property
+    def serves_reshard(self) -> bool:
+        """Whether this daemon SPEAKS the ownership-transfer plane —
+        the single rule both transport edges consult (gRPC method
+        registration, gateway path gate) AND the sender-side switch
+        (set_peers only schedules a handoff when it holds).  False
+        under the GUBER_RESHARD opt-out (the pre-reshard interop mode:
+        a ring change is metadata-only and moved buckets reset, exactly
+        the legacy behavior) and for stores without the columnar
+        drain/commit pair."""
+        return getattr(self.conf.behaviors, "reshard", True) and hasattr(
+            self.store, "commit_transfer"
         )
 
     def get_peer(self, key: str) -> PeerClient:
@@ -1135,10 +1247,15 @@ class V1Service:
         # keep the replica-cache dataclass path.
         remote_groups: Dict[str, list] = {}  # owner addr -> [lane idx]
         remote_peers: Dict[str, PeerClient] = {}
+        peek_plan: list = []  # [(prev owner PeerClient, lane idx array)]
         with self._peer_mutex:
+            pp = self._handoff_prev_picker()  # handoff window: old ring
             psize = self.local_picker.size()
             single_owner = False
-            if psize == 1:
+            if psize == 1 and pp is None:
+                # The single-self shortcut is disabled during a handoff
+                # window: a just-scaled-in ring still owes moved lanes
+                # the double-dispatch peek at their old owner.
                 (only,) = self.local_picker.peers()
                 single_owner = only.info.is_owner
             if psize == 0:
@@ -1176,6 +1293,56 @@ class V1Service:
                 else:
                     lane_code = np.full(n, -1, dtype=np.int32)
                     lane_code[valid] = codes
+                if pp is not None and pp.size():
+                    # Handoff window: lanes whose owner moved between
+                    # the two rings double-dispatch COLUMNAR-natively —
+                    # routing stays on the fast path under the NEW
+                    # ring, and one grouped zero-hit peek per PREVIOUS
+                    # owner merges monotonically at finalize
+                    # (_merge_peek_result), so bulk reads never observe
+                    # a reset bucket mid-transfer and never pay per-
+                    # lane dataclass legs.  One extra vectorized ring
+                    # pass + one extra RPC/dispatch per prev-owner per
+                    # batch, only while the window is open.  GLOBAL
+                    # lanes keep replica semantics; Gregorian lanes
+                    # skip the peek (their duration column is an enum a
+                    # raw zero-hit batch cannot carry safely).
+                    pcodes, pids = pp.get_batch_codes(keys_for_ring)
+                    moved_sel = (
+                        np.asarray(code_ids, dtype=object)[codes]
+                        != np.asarray(pids, dtype=object)[pcodes]
+                    )
+                    if moved_sel.any():
+                        valid_idx = (
+                            np.arange(n) if all_valid
+                            else np.nonzero(valid)[0]
+                        )
+                        beh_v = np.asarray(beh)[valid_idx]
+                        mv = (
+                            moved_sel
+                            & ((beh_v & int(Behavior.GLOBAL)) == 0)
+                            & (
+                                (beh_v
+                                 & int(Behavior.DURATION_IS_GREGORIAN))
+                                == 0
+                            )
+                        )
+                        for pc in np.unique(pcodes[mv]):
+                            prev_peer = pp.get_by_peer_id(pids[int(pc)])
+                            if prev_peer is None:
+                                continue
+                            breaker = getattr(prev_peer, "breaker", None)
+                            if (
+                                breaker is not None
+                                and breaker.is_open
+                                and not prev_peer.info.is_owner
+                            ):
+                                # A dead old owner: the peek would only
+                                # fast-fail — skip it outright.
+                                continue
+                            lanes = valid_idx[mv & (pcodes == pc)]
+                            if lanes.size:
+                                peek_plan.append((prev_peer, lanes))
                 for c, pid in enumerate(code_ids):
                     peer = self.local_picker.get_by_peer_id(pid)
                     if peer is not None and peer.info.is_owner:
@@ -1229,6 +1396,64 @@ class V1Service:
                 tracing.current(),
             )
 
+        # Handoff double-dispatch: submit the grouped zero-hit peeks
+        # (one per previous owner) alongside the in-flight primary
+        # legs.  Local groups (the previous owner is THIS daemon,
+        # draining away) dispatch one batched device read; remote
+        # groups ride the peer's coalescing window.  Strictly
+        # best-effort: a submit failure simply drops the peek.
+        peeks: list = []
+        for prev_peer, lanes in peek_plan:
+            idx = np.asarray(lanes, dtype=np.int64)
+            zero_hits = np.zeros(idx.size, np.int64)
+            try:
+                if prev_peer.info.is_owner:
+                    if isinstance(hash_keys, list):
+                        keys_sel = [hash_keys[int(i)] for i in idx]
+                    else:
+                        keys_sel = hash_keys.subset(idx)
+                    # Peeks OBSERVE, they must not create: drop lanes
+                    # with no resident bucket here — nothing to peek,
+                    # and a zero-hit shadow bucket would later ride the
+                    # transfer plane as noise.  resident_mask iterates
+                    # plain lists and PackedKeys alike.
+                    res = self.store.resident_mask(keys_sel)
+                    if not res.all():
+                        idx = idx[res]
+                        if not idx.size:
+                            continue
+                        keys_sel = (
+                            [k for k, r in zip(keys_sel, res) if r]
+                            if isinstance(keys_sel, list)
+                            else keys_sel.subset(np.nonzero(res)[0])
+                        )
+                        zero_hits = np.zeros(idx.size, np.int64)
+                    handle = self.store.apply_columns_async(
+                        keys_sel,
+                        np.asarray(cols.algorithm[idx], dtype=np.int32),
+                        np.asarray(beh[idx], dtype=np.int32),
+                        zero_hits,
+                        np.asarray(cols.limit[idx], dtype=np.int64),
+                        np.asarray(cols.duration[idx], dtype=np.int64),
+                        self.clock.now_ms(),
+                    )
+                    peeks.append(("local", (handle, 0, idx.size), idx))
+                else:
+                    sub = (
+                        [cols.names[int(i)] for i in idx],
+                        [cols.unique_keys[int(i)] for i in idx],
+                        np.asarray(cols.algorithm[idx], dtype=np.int32),
+                        np.asarray(beh[idx], dtype=np.int32),
+                        zero_hits,
+                        np.asarray(cols.limit[idx], dtype=np.int64),
+                        np.asarray(cols.duration[idx], dtype=np.int64),
+                    )
+                    peeks.append(
+                        ("remote", prev_peer.forward_columns(sub), idx)
+                    )
+            except Exception:  # noqa: BLE001 — peek is best-effort
+                continue
+
         # Remaining slow lanes (GLOBAL remote/local specials) ride the
         # dataclass router.
         slow_idx = [
@@ -1245,11 +1470,14 @@ class V1Service:
                 (lambda: self._route(slow_reqs).responses) if slow_idx else None
             ),
             hash_keys=hash_keys,
+            peeks=peeks,
         )
 
     def _finalize_columns(self, plan: "_ColumnsPlan", result) -> ColumnarResult:
         """Phase 2, blocking form: resolve every submission from phase 1
-        and merge into `result` (the async twin is _ColumnsJoin)."""
+        and merge into `result` (the async twin is _ColumnsJoin).  The
+        handoff peeks merge LAST — they adjust the arrays the primary
+        merges populate."""
         if plan.slow_idx:
             resps = plan.slow_fn()
             for i, r in zip(plan.slow_idx, resps):
@@ -1259,6 +1487,20 @@ class V1Service:
                 result, plan.remote_groups[addr], addr, fut.result()
             )
         self._resolve_fast(plan.pendings, plan.hash_keys, result)
+        for kind, payload, lanes in plan.peeks:
+            data = None
+            try:
+                if kind == "remote":
+                    rc, lo, hi = payload.result(
+                        timeout=self.conf.behaviors.batch_timeout_s + 1.0
+                    )
+                    data = ("remote", (rc, lo, hi))
+                else:
+                    handle, lo, hi = payload
+                    data = ("local", (handle.result(), slice(lo, hi)))
+            except Exception:  # noqa: BLE001 — peek is best-effort
+                data = None
+            _merge_peek_result(result, lanes, data)
         return result
 
     # -- shared fast-lane halves of the two columnar entry points ------
@@ -1381,6 +1623,7 @@ class V1Service:
         global_remote: List[int] = []
         owner_by_idx: Dict[int, str] = {}
         forwards: List[tuple] = []  # (idx, req, peer)
+        peeks: Dict[int, Future] = {}  # handoff double-dispatch legs
 
         for i, r in enumerate(requests):
             # Validation (gubernator.go:142-152; note the reference's
@@ -1398,6 +1641,19 @@ class V1Service:
                     error=f"while finding peer that owns rate limit '{key}' - '{err}'"
                 )
                 continue
+            if not has_behavior(r.behavior, Behavior.GLOBAL):
+                # Handoff window (elastic membership): a lane whose
+                # ownership moved between the previous and current ring
+                # DOUBLE-DISPATCHES — the hit is served by the new
+                # owner (the normal legs below) plus a concurrent
+                # zero-hit peek at the old owner, merged monotonically
+                # at the end, so the read can never observe a reset
+                # bucket while the state transfer is in flight.
+                prev = self._handoff_peek_peer(key, peer)
+                if prev is not None:
+                    peeks[i] = self._forward_pool.submit(
+                        self._peek_one, r, prev
+                    )
             if peer.info.is_owner:
                 local.append(i)
                 if has_behavior(r.behavior, Behavior.MULTI_REGION):
@@ -1476,6 +1732,16 @@ class V1Service:
             }
             for i, fut in futures.items():
                 out[i] = fut.result()
+
+        for i, fut in peeks.items():
+            try:
+                peek = fut.result(
+                    timeout=self.conf.behaviors.batch_timeout_s + 1.0
+                )
+            except Exception:  # noqa: BLE001 — peek is best-effort
+                peek = None
+            if out[i] is not None:
+                out[i] = self._merge_handoff(out[i], peek)
 
         return GetRateLimitsResponse(
             responses=[r if r is not None else RateLimitResponse() for r in out]
@@ -1683,6 +1949,97 @@ class V1Service:
                 return RateLimitResponse(
                     error=f"while fetching rate limit '{key}' from peer - '{e}'"
                 )
+
+    # -- double-dispatch reads during a handoff window -----------------
+    def _handoff_prev_picker(self):
+        """The previous ring's picker while the double-dispatch window
+        is open, else None (and the reference is dropped once the
+        window lapses, so steady state pays one None check).  Caller
+        holds _peer_mutex."""
+        if self._prev_picker is None:
+            return None
+        if time.monotonic() >= self._handoff_deadline:
+            self._prev_picker = None
+            return None
+        return self._prev_picker
+
+    def _handoff_peek_peer(self, key: str, cur_peer: PeerClient):
+        """The OLD owner to peek for `key` during the handoff window —
+        None when no window is open, ownership didn't move, or the old
+        owner is the current one."""
+        if self._prev_picker is None:  # unlocked fast path (benign race)
+            return None
+        with self._peer_mutex:
+            pp = self._handoff_prev_picker()
+            if pp is None or pp.size() == 0:
+                return None
+            try:
+                prev = pp.get_by_peer_id(pp.get(key))
+            except RuntimeError:
+                return None
+        if prev is None or prev is cur_peer:
+            return None
+        pinfo = getattr(prev, "info", None)
+        if pinfo is not None and pinfo.grpc_address == cur_peer.info.grpc_address:
+            return None
+        breaker = getattr(prev, "breaker", None)
+        if (
+            breaker is not None and breaker.is_open
+            and not (pinfo is not None and pinfo.is_owner)
+        ):
+            # A dead old owner (breaker open): the peek would only
+            # fast-fail — skip it so churn against unreachable peers
+            # never taxes the request path.
+            return None
+        return prev
+
+    def _peek_one(self, r: RateLimitRequest, prev_peer):
+        """Zero-hit read at the PREVIOUS owner: the second leg of the
+        double-dispatch.  hits=0 never consumes budget, so the peek
+        cannot double-count — it only observes the bucket the transfer
+        hasn't landed yet.  Best-effort: any failure (old owner dying
+        is exactly when this runs) returns None and the primary answer
+        stands."""
+        r0 = replace(r, hits=0)
+        try:
+            if prev_peer.info.is_owner:
+                # The previous owner is THIS daemon (we are draining
+                # away): read our own store — only if the bucket is
+                # actually resident (peeks observe, never create).
+                mask_fn = getattr(self.store, "resident_mask", None)
+                if mask_fn is not None and not mask_fn([r0.hash_key()])[0]:
+                    return None
+                return self.store.apply([r0], self.clock.now_ms())[0]
+            return prev_peer.get_peer_rate_limit(r0)
+        except Exception:  # noqa: BLE001 — peek is strictly best-effort
+            return None
+
+    @staticmethod
+    def _merge_handoff(primary: RateLimitResponse,
+                       peek: Optional[RateLimitResponse]) -> RateLimitResponse:
+        """Monotone merge of a double-dispatched read (the documented
+        rule, architecture.md "Membership & resharding"): status = max
+        (OVER_LIMIT wins), remaining = min, reset_time = max.  Both
+        sides answered about the same limit config; the merged view is
+        never more permissive than either — so no request observes a
+        reset bucket mid-handoff.  Error answers on either side leave
+        the primary untouched."""
+        if peek is None or peek.error or primary.error:
+            return primary
+        if int(peek.remaining) >= int(peek.limit) and int(peek.status) == 0:
+            # No consumption evidence: the old owner answered a
+            # fresh/untouched bucket (it may have already forgotten the
+            # key post-ACK) — nothing to carry, and merging would only
+            # inflate reset_time.
+            return primary
+        primary.status = max(int(primary.status), int(peek.status))
+        primary.remaining = min(int(primary.remaining), int(peek.remaining))
+        primary.reset_time = max(int(primary.reset_time), int(peek.reset_time))
+        if primary.metadata:
+            primary.metadata.setdefault("handoff", "true")
+        else:
+            primary.metadata = {"handoff": "true"}
+        return primary
 
     def _peer_send(self, op: str, fn: Callable[[], object]) -> bool:
         """Host-tier peer send (GLOBAL hits/broadcast fan-out,
@@ -2013,6 +2370,57 @@ class V1Service:
         for u in cols.to_updates():
             self.store.set_replica(u, now)
 
+    def transfer_ownership(self, cols: "TransferColumns") -> "tuple[int, int]":
+        """Receive side of an ownership transfer (elastic membership,
+        reshard.py): fence the epoch, drop lanes this daemon does not
+        own under its CURRENT ring, and merge-commit the rest through
+        the store's batched transfer commit (O(1) device programs).
+        Returns (committed, rejected)."""
+        n = len(cols)
+        if n > PEER_COLUMNS_MAX_LANES:
+            raise ApiError(
+                "OutOfRange",
+                f"'TransferOwnership' columns list too large; "
+                f"max size is '{PEER_COLUMNS_MAX_LANES}'",
+            )
+        if n == 0:
+            return 0, 0
+        with self._peer_mutex:
+            cur_hash = self.ring_hash
+            picker = self.local_picker
+            psize = picker.size()
+        if cols.ring_hash and cur_hash and cols.ring_hash != cur_hash:
+            # Epoch fence: this batch was routed under a ring this
+            # daemon no longer runs — committing it could resurrect
+            # state for keys that moved AGAIN.  The sender sees a
+            # distinct non-retryable answer and aborts.
+            self.reshard.note_fenced(n)
+            raise ApiError(
+                "FailedPrecondition",
+                f"transfer fenced: batch ring {cols.ring_hash:#018x} != "
+                f"current ring {cur_hash:#018x}",
+                http_status=409,
+            )
+        keep = np.arange(n)
+        if psize > 1:
+            codes, code_ids = picker.get_batch_codes(cols.keys)
+            own = np.zeros(len(code_ids), dtype=bool)
+            for c, pid in enumerate(code_ids):
+                peer = picker.get_by_peer_id(pid)
+                own[c] = peer is not None and peer.info.is_owner
+            keep = np.nonzero(own[codes])[0]
+        elif psize == 1:
+            (only,) = picker.peers()
+            if not only.info.is_owner:
+                keep = np.zeros(0, dtype=np.int64)
+        committed = 0
+        if keep.size:
+            sub = cols if keep.size == n else cols.subset(keep)
+            committed = self.store.commit_transfer(sub, self.clock.now_ms())
+        rejected = n - int(keep.size)
+        self.reshard.note_received(committed, rejected)
+        return committed, rejected
+
     # ------------------------------------------------------------------
     def health_check(self) -> HealthCheckResponse:
         """gubernator.go:295-333.  Counted + timed at the transport
@@ -2073,6 +2481,17 @@ class V1Service:
             peer_list = list(self.local_picker.peers()) + list(
                 self.region_picker.peers()
             )
+            handoff_active = self._handoff_prev_picker() is not None
+            ring = {
+                "generation": self.ring_generation,
+                "hash": format(self.ring_hash, "016x"),
+                "handoffActive": handoff_active,
+                "handoffRemainingS": (
+                    round(max(self._handoff_deadline - time.monotonic(), 0.0), 3)
+                    if handoff_active else 0.0
+                ),
+                "reshardEnabled": self.serves_reshard,
+            }
         for p in peer_list:
             breaker = getattr(p, "breaker", None)
             info = getattr(p, "info", None)
@@ -2131,13 +2550,20 @@ class V1Service:
             },
             "slo": self.slo.snapshot(),
             "hotkeys": self.hotkeys.snapshot()["topk"][:5],
+            "ring": {**ring, "reshard": self.reshard.snapshot()},
         }
         return status
 
     # ------------------------------------------------------------------
     def set_peers(self, peer_infos: Sequence[PeerInfo]) -> None:
         """Rebuild pickers, reusing existing clients by address; drain
-        dropped peers in the background (gubernator.go:357-437)."""
+        dropped peers through the bounded reshard pool
+        (gubernator.go:357-437).  A MEMBERSHIP change additionally bumps
+        the ring generation + fingerprint, opens the double-dispatch
+        handoff window (the previous ring is retained so reads can peek
+        the old owner), and — when the reshard plane is on — schedules
+        the columnar state handoff: moved resident keys drain off the
+        device and ship to their new owners (reshard.py)."""
         local = [p for p in peer_infos if not p.data_center or p.data_center == self.conf.data_center]
         regional = [p for p in peer_infos if p.data_center and p.data_center != self.conf.data_center]
 
@@ -2147,6 +2573,7 @@ class V1Service:
                 for c in list(self.local_picker.peers()) + list(self.region_picker.peers())
                 if isinstance(c, PeerClient)
             }
+            old_ids = set(self.local_picker.peer_ids())
             new_local = self.local_picker.new()
             for info in local:
                 client = old_clients.pop(info.grpc_address, None)
@@ -2173,12 +2600,46 @@ class V1Service:
                     )
                 client.info = info
                 new_region.add(client)
+            prev_picker = self.local_picker
             self.local_picker = new_local
             self.region_picker = new_region
+            new_ids = set(new_local.peer_ids())
+            # Ring delta only on a real MEMBERSHIP change: re-pushes of
+            # the same list (discovery heartbeats, is_owner restamps)
+            # must not bump the epoch or churn a handoff.
+            membership_changed = new_ids != old_ids
+            handoff = False
+            if membership_changed:
+                self.ring_generation += 1
+                self.ring_hash = new_local.fingerprint()
+                if old_ids and self.serves_reshard:
+                    # Not the bootstrap call (and the reshard plane is
+                    # on — GUBER_RESHARD=0 must be exactly the legacy
+                    # metadata-only behavior, peeks included): open the
+                    # double-dispatch window against the OLD ring.
+                    # (prev_picker holds
+                    # the surviving clients by reference — they are
+                    # reused in the new picker — and shut-down dropped
+                    # clients fast-fail, which the peek path tolerates.)
+                    self._prev_picker = prev_picker
+                    self._handoff_deadline = (
+                        time.monotonic()
+                        + getattr(self.conf.behaviors, "reshard_handoff_s", 2.0)
+                    )
+                    handoff = True
+            gen, rh = self.ring_generation, self.ring_hash
 
-        # Shutdown dropped peers without blocking (gubernator.go:398-428).
+        # Handoff FIRST, then dropped-peer shutdowns: both ride the
+        # same bounded FIFO pool, and a delta dropping several peers
+        # must not park every worker in blocking client drains while
+        # the state transfer waits out its double-dispatch window.
+        if handoff and self.serves_reshard and not self._closed:
+            self.reshard.schedule_handoff(new_local, rh, gen)
+        # Shutdown dropped peers without blocking — through the bounded
+        # drain pool, tracked so close() can't race a half-shutdown
+        # client (previously one unbounded daemon thread per peer).
         for client in old_clients.values():
-            threading.Thread(target=client.shutdown, daemon=True).start()
+            self.reshard.submit_shutdown(client)
 
     # ------------------------------------------------------------------
     def close(self) -> None:
@@ -2196,6 +2657,10 @@ class V1Service:
             drainer.stop()
         self.global_mgr.stop()
         self.multi_region_mgr.stop()
+        # Drain the membership pool BEFORE tearing down peers/store: an
+        # in-flight handoff or dropped-peer shutdown must finish (or
+        # abort cleanly) rather than race the teardown below.
+        self.reshard.close(timeout_s=5.0)
         self._forward_pool.shutdown(wait=False)
         self._slow_pool.shutdown(wait=False)
         if self.conf.loader is not None:

@@ -236,8 +236,12 @@ _events = _Ring(EVENT_RING_CAPACITY)
 # slo-fast-burn: the SLO engine (saturation.py) measured a page-level
 # error-budget burn on its short window — dump while the evidence of
 # WHERE the latency went is still in the ring.
+# reshard-aborted: an ownership transfer failed/was fenced and its
+# lanes degraded to reset-on-move (reshard.py) — the state-loss moment
+# the recorder exists to preserve.
 _DUMP_KINDS = frozenset({"breaker-open", "shed", "fault",
-                         "global-send-failed", "slo-fast-burn"})
+                         "global-send-failed", "slo-fast-burn",
+                         "reshard-aborted"})
 _DUMP_MIN_INTERVAL_S = 5.0
 _last_dump = [0.0]
 _dump_lock = threading.Lock()

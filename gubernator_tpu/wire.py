@@ -884,6 +884,114 @@ class BroadcastBatch:
             return self._classic_json
 
 
+# ---- Ownership transfer (elastic membership, reshard.py) -------------
+# A ring delta ships the moved keys' FULL device bucket rows from the
+# old owner to the new one:
+#   * proto columns (TransferColumnsReq) served as the gRPC
+#     PeersV1/TransferOwnership method;
+#   * a GUBC frame (kind 4) POSTed to /v1/peer.TransferOwnership on the
+#     HTTP transport.
+# Both carry the destination ring's fingerprint so a receiver whose
+# ring changed again FENCES the batch (dead-epoch transfer).  A peer
+# without the transfer surface answers UNIMPLEMENTED / 404 — provably
+# unapplied — and the sender falls back sticky to the classic
+# (pre-reshard) behavior for that peer: the moved keys reset there,
+# counted as aborts.
+
+_FRAME_KIND_TRANSFER = 4
+
+
+def is_transfer_frame(raw: bytes) -> bool:
+    return is_columns_frame(raw) and raw[5] == _FRAME_KIND_TRANSFER
+
+
+def encode_transfer_frame(cols) -> bytes:
+    """TransferColumns -> binary transfer frame: GUBC header (kind 4)
+    + `<Q` ring_hash + key string column + algo/status i32 +
+    limit/remaining/duration/stamp/expire_at i64."""
+    n = len(cols.keys)
+    return b"".join(
+        (
+            FRAME_MAGIC,
+            struct.pack("<BBI", FRAME_VERSION, _FRAME_KIND_TRANSFER, n),
+            struct.pack("<Q", cols.ring_hash & 0xFFFFFFFFFFFFFFFF),
+            _pack_str_column(cols.keys),
+            np.ascontiguousarray(cols.algorithm, dtype=np.int32).tobytes(),
+            np.ascontiguousarray(cols.status, dtype=np.int32).tobytes(),
+            np.ascontiguousarray(cols.limit, dtype=np.int64).tobytes(),
+            np.ascontiguousarray(cols.remaining, dtype=np.int64).tobytes(),
+            np.ascontiguousarray(cols.duration, dtype=np.int64).tobytes(),
+            np.ascontiguousarray(cols.stamp, dtype=np.int64).tobytes(),
+            np.ascontiguousarray(cols.expire_at, dtype=np.int64).tobytes(),
+        )
+    )
+
+
+def decode_transfer_frame(raw: bytes):
+    """Binary transfer frame -> reshard.TransferColumns.  Raises
+    ValueError on a malformed/foreign frame (the gateway maps it to a
+    400)."""
+    from .reshard import TransferColumns
+
+    if not is_columns_frame(raw):
+        raise ValueError("not a columns frame")
+    version, kind, n = struct.unpack_from("<BBI", raw, 4)
+    if version != FRAME_VERSION or kind != _FRAME_KIND_TRANSFER:
+        raise ValueError(
+            f"unsupported transfer frame (version={version}, kind={kind})"
+        )
+    pos = _FRAME_HEADER_LEN
+    (ring_hash,) = struct.unpack_from("<Q", raw, pos)
+    pos += 8
+    ko, kb, pos = _read_str_blob(raw, pos, n)
+    algo, pos = _read_array(raw, pos, np.int32, n)
+    status, pos = _read_array(raw, pos, np.int32, n)
+    limit, pos = _read_array(raw, pos, np.int64, n)
+    remaining, pos = _read_array(raw, pos, np.int64, n)
+    duration, pos = _read_array(raw, pos, np.int64, n)
+    stamp, pos = _read_array(raw, pos, np.int64, n)
+    expire, pos = _read_array(raw, pos, np.int64, n)
+    if pos != len(raw):
+        raise ValueError("columns frame length mismatch")
+    return TransferColumns(
+        keys=[kb[ko[i]:ko[i + 1]].decode("utf-8") for i in range(n)],
+        algorithm=algo, status=status, limit=limit, remaining=remaining,
+        duration=duration, stamp=stamp, expire_at=expire,
+        ring_hash=int(ring_hash),
+    )
+
+
+def transfer_cols_to_pb(cols) -> "pc_pb.TransferColumnsReq":
+    m = pc_pb.TransferColumnsReq()
+    m.ring_hash = cols.ring_hash & 0xFFFFFFFFFFFFFFFF
+    m.keys.extend(cols.keys)
+    m.algorithm.extend(np.asarray(cols.algorithm, dtype=np.int32).tolist())
+    m.status.extend(np.asarray(cols.status, dtype=np.int32).tolist())
+    m.limit.extend(np.asarray(cols.limit, dtype=np.int64).tolist())
+    m.remaining.extend(np.asarray(cols.remaining, dtype=np.int64).tolist())
+    m.duration.extend(np.asarray(cols.duration, dtype=np.int64).tolist())
+    m.stamp.extend(np.asarray(cols.stamp, dtype=np.int64).tolist())
+    m.expire_at.extend(np.asarray(cols.expire_at, dtype=np.int64).tolist())
+    return m
+
+
+def transfer_cols_from_pb(m) -> "object":
+    from .reshard import TransferColumns
+
+    n = len(m.keys)
+    return TransferColumns(
+        keys=list(m.keys),
+        algorithm=np.fromiter(m.algorithm, np.int32, count=n),
+        status=np.fromiter(m.status, np.int32, count=n),
+        limit=np.fromiter(m.limit, np.int64, count=n),
+        remaining=np.fromiter(m.remaining, np.int64, count=n),
+        duration=np.fromiter(m.duration, np.int64, count=n),
+        stamp=np.fromiter(m.stamp, np.int64, count=n),
+        expire_at=np.fromiter(m.expire_at, np.int64, count=n),
+        ring_hash=int(m.ring_hash),
+    )
+
+
 def update_global_to_pb(u: UpdatePeerGlobal) -> peers_pb.UpdatePeerGlobal:
     return peers_pb.UpdatePeerGlobal(
         key=u.key, status=resp_to_pb(u.status), algorithm=int(u.algorithm)

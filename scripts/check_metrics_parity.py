@@ -77,6 +77,11 @@ EXTENSIONS = frozenset(
         "gubernator_slo_requests",
         "gubernator_hotkey_lanes",
         "gubernator_hotkey_topk",
+        # PR 7: elastic membership / live resharding (reshard.py)
+        "gubernator_reshard_transfers",
+        "gubernator_reshard_lanes",
+        "gubernator_reshard_handoff_seconds",
+        "gubernator_ring_generation",
     }
 )
 

@@ -24,8 +24,9 @@ import time
 import urllib.error
 import urllib.request
 
-COLUMNS = ("daemon", "health", "peers", "brk-open", "occupancy",
-           "evict", "queue", "shed", "burn-5m", "burn-1h", "hot-key")
+COLUMNS = ("daemon", "health", "peers", "brk-open", "ring", "handoff",
+           "occupancy", "evict", "queue", "shed", "burn-5m", "burn-1h",
+           "hot-key")
 
 
 def fetch_status(addr: str, timeout_s: float = 5.0) -> dict:
@@ -39,11 +40,24 @@ def summarize(addr: str, doc: dict) -> dict:
     ingress = doc.get("ingress", {})
     slo = doc.get("slo", {})
     hot = doc.get("hotkeys") or []
+    ring = doc.get("ring", {})
+    reshard = ring.get("reshard", {})
+    # gen@hash (short), e.g. "3@13db0387"; handoff column shows the
+    # live double-dispatch window or the abort count when nonzero.
+    ring_cell = f"{ring.get('generation', 0)}@{ring.get('hash', '')[:8]}"
+    if ring.get("handoffActive"):
+        handoff_cell = f"active {ring.get('handoffRemainingS', 0)}s"
+    elif reshard.get("transfersAborted"):
+        handoff_cell = f"aborts:{reshard['transfersAborted']}"
+    else:
+        handoff_cell = "-"
     return {
         "daemon": addr,
         "health": doc.get("health", {}).get("status", "?"),
         "peers": doc.get("health", {}).get("peerCount", 0),
         "brk-open": doc.get("health", {}).get("breakerOpenCount", 0),
+        "ring": ring_cell,
+        "handoff": handoff_cell,
         "occupancy": f"{occ.get('used', 0)}/{occ.get('capacity', 0)}",
         "evict": occ.get("evictions", 0),
         "queue": ingress.get("queuedLanes", 0),

@@ -57,3 +57,92 @@ def test_get_matches_get_batch():
         ring.add(h)
     keys = [f"key_{i}" for i in range(500)]
     assert ring.get_batch(keys) == [ring.get(k) for k in keys]
+
+
+# ---------------------------------------------------------------------
+# Ring-delta ownership math (elastic membership, reshard.py): the
+# vectorized get_batch_codes diff between two rings must EXACTLY
+# partition any key set into stay/move — the resharding plane's drain
+# scan and the double-dispatch window both hang off this property.
+# ---------------------------------------------------------------------
+def _owners(ring, keys):
+    """Per-key owner ids via the vectorized code path."""
+    codes, ids = ring.get_batch_codes(keys)
+    return [ids[c] for c in codes]
+
+
+def _build(hosts, replicas):
+    ring = ReplicatedConsistentHash(replicas=replicas)
+    for h in hosts:
+        ring.add(h)
+    return ring
+
+
+DELTA_KEYS = [f"user_{i}" for i in range(2000)]
+
+
+@pytest.mark.parametrize("replicas", [16, 128, DEFAULT_REPLICAS])
+@pytest.mark.parametrize(
+    "old_hosts,new_hosts",
+    [
+        (HOSTS[:2], HOSTS),                 # join
+        (HOSTS, HOSTS[:2]),                 # leave
+        (HOSTS[:2], [HOSTS[0], "d.svc.local"]),  # replace
+        (HOSTS, ["d.svc.local", "e.svc.local"]),  # multi-replace
+    ],
+    ids=["join", "leave", "replace", "multi-replace"],
+)
+def test_ownership_diff_partitions_keys(replicas, old_hosts, new_hosts):
+    old = _build(old_hosts, replicas)
+    new = _build(new_hosts, replicas)
+    before = _owners(old, DELTA_KEYS)
+    after = _owners(new, DELTA_KEYS)
+    stay = {k for k, o, n in zip(DELTA_KEYS, before, after) if o == n}
+    move = {k for k, o, n in zip(DELTA_KEYS, before, after) if o != n}
+    # Exact partition: disjoint, exhaustive.
+    assert stay | move == set(DELTA_KEYS)
+    assert not (stay & move)
+    # The codes diff agrees with the scalar reference lookup per key.
+    for k, o, n in zip(DELTA_KEYS, before, after):
+        assert o == old.get(k)
+        assert n == new.get(k)
+    surviving = set(old_hosts) & set(new_hosts)
+    joined = set(new_hosts) - set(old_hosts)
+    for k in move:
+        # A moved key's new owner is a ring member; keys never move
+        # BETWEEN two surviving peers on a pure join (consistent
+        # hashing only reassigns ranges claimed by new vnodes).
+        assert new.get(k) in new_hosts
+        if not joined:
+            continue
+        if old.get(k) in surviving and not (set(old_hosts) - set(new_hosts)):
+            assert new.get(k) in joined
+
+
+@pytest.mark.parametrize("replicas", [16, DEFAULT_REPLICAS])
+def test_pure_join_moves_only_to_new_peer(replicas):
+    old = _build(HOSTS[:2], replicas)
+    new = _build(HOSTS, replicas)
+    before = _owners(old, DELTA_KEYS)
+    after = _owners(new, DELTA_KEYS)
+    moved_to = {n for o, n in zip(before, after) if o != n}
+    assert moved_to == {HOSTS[2]}  # every moved key lands on the joiner
+    # And a pure LEAVE moves exactly the departed peer's keys.
+    back = _owners(old, DELTA_KEYS)
+    lost = [k for k, o in zip(DELTA_KEYS, after) if o == HOSTS[2]]
+    relocated = {
+        k: n for k, o, n in zip(DELTA_KEYS, after, back) if o != n
+    }
+    assert set(relocated) == set(lost)
+    assert all(n in HOSTS[:2] for n in relocated.values())
+
+
+def test_fingerprint_tracks_membership_not_order():
+    r1 = _build(HOSTS, DEFAULT_REPLICAS)
+    r2 = _build(list(reversed(HOSTS)), DEFAULT_REPLICAS)
+    assert r1.fingerprint() == r2.fingerprint()
+    r3 = _build(HOSTS[:2], DEFAULT_REPLICAS)
+    assert r3.fingerprint() != r1.fingerprint()
+    # replicas participate: same members, different vnode count, a
+    # DIFFERENT ownership map — must be a different epoch.
+    assert _build(HOSTS, 16).fingerprint() != r1.fingerprint()

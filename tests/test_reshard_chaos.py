@@ -1,0 +1,399 @@
+"""Elastic-membership chaos: live resharding against a real in-process
+cluster, under seeded FaultPlans.
+
+The acceptance scenarios for the state-handoff plane:
+
+  * REGRESSION — with GUBER_RESHARD on (default), a ring change no
+    longer resets moved buckets: the exactly-once oracle sees the same
+    per-key totals a single-owner run would.
+  * The GUBER_RESHARD=0 interop mode reproduces the pre-reshard
+    behavior bit-for-bit (moved buckets reset, no transfer surface,
+    senders negotiate down sticky + breaker/health-neutral).
+  * DELAY on transfer frames — reads during the in-flight window
+    double-dispatch (new owner + zero-hit peek at the old) and never
+    observe a reset bucket; the delayed transfer still commits and the
+    final accounting is exact.
+  * DROP on transfer frames / owner death mid-transfer, under two
+    FaultPlan seeds — transfers abort (counted + flight-recorder
+    event), and the oracle's bounds hold: no double-commit ever, and
+    over-admission is bounded by the documented slack (the consumption
+    that failed to ship).
+
+Every scenario runs under explicit fault-plan seeds so failures replay
+bit-for-bit in CI (`make chaos` runs the marker; the fast ones ride
+tier-1, the multi-cluster heavy ones are `slow`).
+"""
+
+import time
+
+import pytest
+
+from gubernator_tpu import faults, tracing
+from gubernator_tpu.cluster import Cluster, fast_test_behaviors
+from gubernator_tpu.config import DaemonConfig
+from gubernator_tpu.daemon import Daemon
+from gubernator_tpu.faults import FaultPlan, FaultRule
+from gubernator_tpu.parallel.hash_ring import ReplicatedConsistentHash
+from gubernator_tpu.types import (
+    Algorithm,
+    GetRateLimitsRequest,
+    RateLimitRequest,
+    SECOND,
+)
+from gubernator_tpu.utils.clock import Clock
+
+T0 = 1_573_430_430_000
+LIMIT = 1000
+# Enough keys that every membership delta moves SOME of them, with the
+# index LEADING the key: FNV-1 folds trailing bytes in after the last
+# multiply, so keys differing only in a suffix ("userN") cluster into
+# one vnode gap and move all-or-nothing — index-first keys spread over
+# the whole ring (~1/3 move on a 2->3 join, never zero).
+KEYS = [f"{i}user" for i in range(64)]
+
+pytestmark = pytest.mark.chaos
+
+
+def _behaviors(**over):
+    beh = fast_test_behaviors()
+    # No GLOBAL / MULTI_REGION traffic here: park the sync ticks so the
+    # shared 8-device CPU mesh only runs this module's dispatches.
+    beh.global_sync_wait_s = 3600.0
+    beh.multi_region_sync_wait_s = 3600.0
+    beh.retry_backoff_base_s = 0.002
+    beh.retry_backoff_max_s = 0.01
+    for k, v in over.items():
+        setattr(beh, k, v)
+    return beh
+
+
+def _mk(key, hits):
+    return RateLimitRequest(
+        name="ns", unique_key=key, hits=hits, limit=LIMIT,
+        duration=3600 * SECOND, algorithm=Algorithm.TOKEN_BUCKET,
+    )
+
+
+def _hit_all(daemon, hits):
+    resp = daemon.service.get_rate_limits(
+        GetRateLimitsRequest(requests=[_mk(k, hits) for k in KEYS])
+    )
+    for k, r in zip(KEYS, resp.responses):
+        assert not r.error, (k, r.error)
+    return resp.responses
+
+
+def _remaining(daemon, keys=KEYS):
+    resp = daemon.service.get_rate_limits(
+        GetRateLimitsRequest(requests=[_mk(k, 0) for k in keys])
+    )
+    for k, r in zip(keys, resp.responses):
+        assert not r.error, (k, r.error)
+    return {k: r.remaining for k, r in zip(keys, resp.responses)}
+
+
+def _spawn_extra(cluster, behaviors):
+    conf = DaemonConfig(
+        listen_address="127.0.0.1:0", grpc_listen_address="127.0.0.1:0",
+        cache_size=2048, global_cache_size=256, behaviors=behaviors,
+        peer_discovery_type="static",
+    )
+    d = Daemon(conf, clock=cluster.daemons[0].clock).start()
+    cluster.daemons.append(d)
+    cluster.peers = [dm.peer_info for dm in cluster.daemons]
+    for dm in cluster.daemons:
+        dm.set_peers(cluster.peers)
+    return d
+
+
+def _wait_handoffs(cluster, timeout=30.0):
+    for d in cluster.daemons:
+        assert d.service.reshard.wait_idle(timeout)
+
+
+def _moved_keys(old_addrs, new_addrs):
+    """Keys whose OWNER differs between the two membership sets (the
+    same vectorized diff the drain scan uses)."""
+    old, new = ReplicatedConsistentHash(), ReplicatedConsistentHash()
+    for a in old_addrs:
+        old.add(a)
+    for a in new_addrs:
+        new.add(a)
+    hk = lambda k: _mk(k, 0).hash_key()  # noqa: E731
+    return [k for k in KEYS if old.get(hk(k)) != new.get(hk(k))]
+
+
+@pytest.fixture
+def clock():
+    c = Clock()
+    c.freeze(T0)
+    return c
+
+
+def _start_pair(clock, behaviors=None):
+    cl = Cluster().start_with(
+        ["", ""], clock=clock, behaviors=behaviors or _behaviors(),
+        cache_size=2048,
+    )
+    # Pre-compile the shapes the scenarios hit so fault timing below
+    # never races a first-call device compile.
+    for d in cl.daemons:
+        d.service.store.apply([_mk("warm", 0)], clock.now_ms())
+    return cl
+
+
+# ---------------------------------------------------------------------
+# The headline regression: a ring change no longer resets moved buckets
+# ---------------------------------------------------------------------
+def test_join_does_not_reset_moved_buckets(clock):
+    cl = _start_pair(clock)
+    try:
+        _hit_all(cl.daemons[0], 7)
+        old_addrs = [d.service.advertise_address for d in cl.daemons]
+        _spawn_extra(cl, _behaviors())
+        _wait_handoffs(cl)
+        new_addrs = [d.service.advertise_address for d in cl.daemons]
+        moved = _moved_keys(old_addrs, new_addrs)
+        assert moved, "expected some keys to move to the joiner"
+        committed = sum(
+            d.service.reshard.snapshot()["transfersCommitted"]
+            for d in cl.daemons
+        )
+        assert committed >= 1
+        # Phase 2 through a different daemon, then the oracle: every
+        # key — moved or not — carries BOTH phases.  Pre-PR, moved keys
+        # came back with remaining == LIMIT - 7 (reset).
+        _hit_all(cl.daemons[1], 7)
+        final = _remaining(cl.daemons[2])
+        assert all(v == LIMIT - 14 for v in final.values()), {
+            k: v for k, v in final.items() if v != LIMIT - 14
+        }
+        aborted = sum(
+            d.service.reshard.snapshot()["transfersAborted"]
+            for d in cl.daemons
+        )
+        assert aborted == 0
+    finally:
+        cl.stop()
+
+
+def test_knob_off_reproduces_legacy_reset(clock):
+    """GUBER_RESHARD=0 everywhere: the ring change is metadata-only and
+    moved buckets DO reset — the documented pre-reshard semantics this
+    plane exists to remove (and the contrast proving the regression
+    test above tests the plane, not luck)."""
+    beh = _behaviors(reshard=False)
+    cl = _start_pair(clock, behaviors=beh)
+    try:
+        _hit_all(cl.daemons[0], 7)
+        old_addrs = [d.service.advertise_address for d in cl.daemons]
+        _spawn_extra(cl, beh)
+        for d in cl.daemons:
+            d.service.reshard.wait_idle(5)
+            assert d.service.reshard.snapshot()["transfersStarted"] == 0
+        new_addrs = [d.service.advertise_address for d in cl.daemons]
+        moved = _moved_keys(old_addrs, new_addrs)
+        assert moved
+        _hit_all(cl.daemons[1], 7)
+        final = _remaining(cl.daemons[0])
+        for k in KEYS:
+            expect = LIMIT - 7 if k in moved else LIMIT - 14
+            assert final[k] == expect, (k, final[k], expect)
+    finally:
+        cl.stop()
+
+
+# ---------------------------------------------------------------------
+# DELAY on transfer frames: double-dispatch reads bridge the window
+# ---------------------------------------------------------------------
+def test_delayed_transfer_reads_never_see_reset(clock):
+    beh = _behaviors(reshard_handoff_s=8.0)
+    cl = _start_pair(clock, behaviors=beh)
+    try:
+        _hit_all(cl.daemons[0], 7)
+        old_addrs = [d.service.advertise_address for d in cl.daemons]
+        plan = FaultPlan(seed=7)
+        plan.add(FaultRule(op="TransferOwnership", kind=faults.DELAY,
+                           delay_s=2.5))
+        with faults.injected(plan):
+            d3 = _spawn_extra(cl, beh)
+            new_addrs = [d.service.advertise_address for d in cl.daemons]
+            moved = _moved_keys(old_addrs, new_addrs)
+            assert moved
+            # Reads WHILE the transfer frames are still in flight (the
+            # 2.5s injected delay): the primary answer comes from the
+            # new owner's fresh bucket, the zero-hit peek from the old
+            # owner's still-resident copy; the monotone merge must
+            # surface the pre-handoff consumption.
+            during = _remaining(cl.daemons[1], moved)
+            assert all(v == LIMIT - 7 for v in during.values()), during
+            # Same guarantee on the COLUMNAR ingress path (the grouped
+            # per-prev-owner peek, not the per-lane dataclass leg).
+            import numpy as np
+
+            from gubernator_tpu.service import IngressColumns
+
+            m = len(moved)
+            rc = cl.daemons[1].service.get_rate_limits_columns(
+                IngressColumns(
+                    names=["ns"] * m,
+                    unique_keys=list(moved),
+                    algorithm=np.zeros(m, np.int32),
+                    behavior=np.zeros(m, np.int32),
+                    hits=np.zeros(m, np.int64),
+                    limit=np.full(m, LIMIT, np.int64),
+                    duration=np.full(m, 3600 * SECOND, np.int64),
+                )
+            )
+            cols_during = {
+                k: rc.response_at(j) for j, k in enumerate(moved)
+            }
+            for k, r in cols_during.items():
+                assert not r.error, (k, r.error)
+                assert r.remaining == LIMIT - 7, (k, r.remaining)
+            _wait_handoffs(cl, timeout=60.0)
+        # The delayed frames still committed: accounting stays exact.
+        _hit_all(cl.daemons[1], 7)
+        final = _remaining(d3)
+        assert all(v == LIMIT - 14 for v in final.values()), final
+    finally:
+        cl.stop()
+
+
+# ---------------------------------------------------------------------
+# DROP on transfer frames under two seeds: aborts are counted, bounds
+# hold (the exactly-once oracle's slack contract)
+# ---------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [11, 23])
+def test_dropped_transfers_abort_with_bounded_slack(clock, seed):
+    beh = _behaviors(reshard_handoff_s=0.2)
+    cl = _start_pair(clock, behaviors=beh)
+    try:
+        _hit_all(cl.daemons[0], 7)
+        plan = FaultPlan(seed=seed)
+        # Seeded partial drop: some transfer chunks vanish in flight
+        # (timeout-shaped — the receiver MAY have applied them), the
+        # rest land.  Both outcomes must satisfy the oracle bounds.
+        plan.drop(op="TransferOwnership", rate=0.7)
+        ev_before = len(
+            [e for e in tracing.events_snapshot()
+             if e.get("kind") == "reshard-aborted"]
+        )
+        with faults.injected(plan):
+            _spawn_extra(cl, beh)
+            _wait_handoffs(cl, timeout=60.0)
+        snaps = [d.service.reshard.snapshot() for d in cl.daemons]
+        started = sum(s["transfersStarted"] for s in snaps)
+        aborted = sum(s["transfersAborted"] for s in snaps)
+        assert started >= 1
+        if aborted:
+            # Counted AND flight-recorded (the PR 4 auto-dump path).
+            ev_after = [
+                e for e in tracing.events_snapshot()
+                if e.get("kind") == "reshard-aborted"
+            ]
+            assert len(ev_after) > ev_before
+        # Let the double-dispatch window lapse so the oracle reads the
+        # settled (post-handoff) state.
+        time.sleep(0.3)
+        _hit_all(cl.daemons[1], 7)
+        final = _remaining(cl.daemons[0])
+        for k, rem in final.items():
+            consumed = LIMIT - rem
+            # No double-commit, ever: a key can never have consumed
+            # more than the hits actually sent.
+            assert consumed <= 14, (k, consumed)
+            # Bounded loss: at worst the pre-handoff consumption (7)
+            # failed to ship — phase 2 is always accounted.
+            assert consumed >= 7, (k, consumed)
+    finally:
+        cl.stop()
+
+
+# ---------------------------------------------------------------------
+# Owner death mid-transfer under two seeds
+# ---------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [5, 17])
+def test_owner_death_mid_transfer(clock, seed):
+    beh = _behaviors(reshard_handoff_s=0.2)
+    cl = _start_pair(clock, behaviors=beh)
+    try:
+        _hit_all(cl.daemons[0], 7)
+        victim = cl.daemons[0]
+        plan = FaultPlan(seed=seed)
+        # The victim's transfers all vanish (its frames never arrive) —
+        # then the process dies.
+        plan.drop(op="TransferOwnership")
+        with faults.injected(plan):
+            _spawn_extra(cl, beh)
+            # Kill the old owner while its handoff is mid-flight.
+            victim.close()
+            cl.daemons.remove(victim)
+        plan.heal()
+        # The survivors re-converge on a ring without the dead owner.
+        cl.peers = [dm.peer_info for dm in cl.daemons]
+        for dm in cl.daemons:
+            dm.set_peers(cl.peers)
+        _wait_handoffs(cl, timeout=60.0)
+        time.sleep(0.3)  # let the double-dispatch window lapse
+        _hit_all(cl.daemons[0], 7)
+        final = _remaining(cl.daemons[1])
+        for k, rem in final.items():
+            consumed = LIMIT - rem
+            # No double-commit: never more than the hits sent.
+            assert consumed <= 14, (k, consumed)
+            # Bounded loss: the dead owner's unshipped phase-1
+            # consumption is the documented slack; phase 2 is always
+            # accounted.
+            assert consumed >= 7, (k, consumed)
+        # And the cluster is healthy again.
+        for dm in cl.daemons:
+            hc = dm.service.health_check()
+            assert hc.peer_count == len(cl.daemons)
+    finally:
+        cl.stop()
+
+
+# ---------------------------------------------------------------------
+# Mixed-version interop: a GUBER_RESHARD=0 receiver negotiates cleanly
+# ---------------------------------------------------------------------
+def test_knob_off_receiver_negotiates_sticky_and_neutral(clock):
+    beh = _behaviors(reshard_handoff_s=0.2)
+    cl = _start_pair(clock, behaviors=beh)
+    try:
+        _hit_all(cl.daemons[0], 7)
+        old_addrs = [d.service.advertise_address for d in cl.daemons]
+        # The joiner speaks NO transfer plane (GUBER_RESHARD=0): its
+        # gRPC server never registers TransferOwnership, exactly like a
+        # pre-reshard build.
+        d3 = _spawn_extra(cl, _behaviors(reshard=False))
+        _wait_handoffs(cl)
+        new_addrs = [d.service.advertise_address for d in cl.daemons]
+        moved = _moved_keys(old_addrs, new_addrs)
+        assert moved
+        aborted = sum(
+            d.service.reshard.snapshot()["transfersAborted"]
+            for d in cl.daemons[:2]
+        )
+        assert aborted >= 1  # classic fallback: counted, not silent
+        for d in cl.daemons[:2]:
+            for p in d.service.get_peer_list():
+                if p.info.grpc_address == d3.service.advertise_address:
+                    # Sticky downgrade, breaker- and health-neutral:
+                    # the version probe is an answer, not a fault.
+                    assert p._transfer_supported is False
+                    assert p.breaker.state_code == 0  # closed
+            hc = d.service.health_check()
+            assert hc.status == "healthy", hc.message
+        # Legacy semantics for the moved keys after the window lapses:
+        # they reset on the new owner (the documented fallback).
+        time.sleep(0.3)
+        _hit_all(cl.daemons[1], 7)
+        final = _remaining(cl.daemons[0])
+        for k in KEYS:
+            expect = LIMIT - 7 if k in moved else LIMIT - 14
+            assert final[k] == expect, (k, final[k], expect)
+    finally:
+        cl.stop()

@@ -156,6 +156,78 @@ def test_globals_frame_golden():
     assert list(back.reset_time) == [1000, 2000]
 
 
+def test_transfer_columns_req_golden():
+    """peers_columns.proto TransferColumnsReq (the ownership-transfer
+    RPC): field numbers pinned so the protoc-less descriptor stays
+    wire-identical to the schema."""
+    m = pc_pb.TransferColumnsReq(
+        ring_hash=5, keys=["k"], algorithm=[1], status=[1], limit=[2],
+        remaining=[3], duration=[4], stamp=[6], expire_at=[7],
+    )
+    assert m.SerializeToString() == bytes(
+        [
+            0x08, 0x05,              # 1: ring_hash = 5 (varint)
+            0x12, 0x01, ord("k"),    # 2: keys[0]
+            0x1A, 0x01, 0x01,        # 3: algorithm, packed
+            0x22, 0x01, 0x01,        # 4: status, packed
+            0x2A, 0x01, 0x02,        # 5: limit, packed
+            0x32, 0x01, 0x03,        # 6: remaining, packed
+            0x3A, 0x01, 0x04,        # 7: duration, packed
+            0x42, 0x01, 0x06,        # 8: stamp, packed
+            0x4A, 0x01, 0x07,        # 9: expire_at, packed
+        ]
+    )
+    resp = pc_pb.TransferResp(committed=2, rejected=1)
+    assert resp.SerializeToString() == bytes(
+        [0x08, 0x02, 0x10, 0x01]     # 1: committed, 2: rejected
+    )
+
+
+def test_transfer_frame_golden():
+    """The GUBC transfer frame (kind 4) byte layout is a wire contract:
+    header | ring_hash u64 | key string column | algo i32 | status i32
+    | limit i64 | remaining i64 | duration i64 | stamp i64 | expire_at
+    i64, all little-endian."""
+    import numpy as np
+
+    from gubernator_tpu import wire
+    from gubernator_tpu.reshard import TransferColumns
+
+    cols = TransferColumns(
+        keys=["a", "bc"],
+        algorithm=np.array([1, 0], np.int32),
+        status=np.array([0, 1], np.int32),
+        limit=np.array([5, 6], np.int64),
+        remaining=np.array([4, 5], np.int64),
+        duration=np.array([60, 70], np.int64),
+        stamp=np.array([1000, 2000], np.int64),
+        expire_at=np.array([3000, 4000], np.int64),
+        ring_hash=0x0102030405060708,
+    )
+    raw = wire.encode_transfer_frame(cols)
+    i32 = lambda v: int(v).to_bytes(4, "little")  # noqa: E731
+    i64 = lambda v: int(v).to_bytes(8, "little")  # noqa: E731
+    expected = (
+        b"GUBC" + bytes([1, 4]) + i32(2)          # magic, ver, kind, n
+        + i64(0x0102030405060708)                 # ring_hash (epoch fence)
+        + i32(3) + i32(0) + i32(1) + i32(3) + b"abc"  # key column
+        + i32(1) + i32(0)                         # algorithm
+        + i32(0) + i32(1)                         # status
+        + i64(5) + i64(6)                         # limit
+        + i64(4) + i64(5)                         # remaining
+        + i64(60) + i64(70)                       # duration
+        + i64(1000) + i64(2000)                   # stamp
+        + i64(3000) + i64(4000)                   # expire_at
+    )
+    assert raw == expected
+    assert wire.is_transfer_frame(raw)
+    assert not wire.is_globals_frame(raw)
+    back = wire.decode_transfer_frame(raw)
+    assert back.keys == ["a", "bc"]
+    assert back.ring_hash == 0x0102030405060708
+    assert list(back.expire_at) == [3000, 4000]
+
+
 def test_classic_broadcast_bytes_unchanged():
     """GUBER_GLOBAL_COLUMNS=0 / classic-negotiated peers must see
     byte-identical wire to the pre-columns sender in BOTH encodings:
