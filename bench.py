@@ -22,6 +22,7 @@ verdict.  Exit 1 on regression; wired into `make bench` /
 `make bench-gate`.
 """
 
+import contextlib
 import json
 import sys
 import time
@@ -544,6 +545,64 @@ def measure_tracing_overhead(n_threads: int = 8, iters: int = 4):
     return s0_cps / max(off_cps, 1.0), off_cps, s0_cps
 
 
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@contextlib.contextmanager
+def _bench_daemon(extra_env=None, extra_env_fn=None, what="bench daemon"):
+    """Spawn one CPU-pinned daemon subprocess (the loopback rule: the
+    receiver needs its OWN GIL) on fresh ports, wait for its listening
+    line, and SIGTERM/kill it on exit — the harness every loopback
+    measurement shares.  Yields (http_port, grpc_port).
+    `extra_env_fn(http_port, grpc_port)` builds overrides that need the
+    allocated ports (e.g. a GUBER_STATIC_PEERS naming both daemons);
+    plain `extra_env` overrides apply last."""
+    import os
+    import signal
+    import subprocess
+
+    http_port, grpc_port = _free_port(), _free_port()
+    env = dict(os.environ)
+    env.update(
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        JAX_PLATFORMS="cpu",
+        JAX_COMPILATION_CACHE_DIR=os.path.join(os.getcwd(), ".jax_cache"),
+        GUBER_HTTP_ADDRESS=f"127.0.0.1:{http_port}",
+        GUBER_GRPC_ADDRESS=f"127.0.0.1:{grpc_port}",
+        GUBER_STATIC_PEERS=f"127.0.0.1:{grpc_port}|127.0.0.1:{http_port}",
+        GUBER_GLOBAL_SYNC_WAIT="3600s",
+        GUBER_MULTI_REGION_SYNC_WAIT="3600s",
+        GUBER_BATCH_TIMEOUT="30s",
+        GUBER_CACHE_SIZE="8192",
+    )
+    if extra_env_fn is not None:
+        env.update(extra_env_fn(http_port, grpc_port))
+    if extra_env:
+        env.update(extra_env)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gubernator_tpu.cmd.server"],
+        stdout=subprocess.PIPE, text=True, env=env, cwd=os.getcwd(),
+    )
+    try:
+        line = proc.stdout.readline()
+        if "listening" not in line:
+            raise RuntimeError(f"{what} failed to start: {line!r}")
+        yield http_port, grpc_port
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 def measure_peer_forward(mode: str = "columns", n_threads: int = 8,
                          iters: int = 4, batch: int = 1000) -> float:
     """Loopback two-daemon forward throughput: the owner daemon runs in
@@ -558,10 +617,6 @@ def measure_peer_forward(mode: str = "columns", n_threads: int = 8,
     path's software cost — the device kernel has its own rows, and
     tunnel weather must not leak into a loopback-RPC verdict.
     Returns checks/s (best of 3 epochs)."""
-    import os
-    import signal
-    import socket
-    import subprocess
     import threading
 
     import jax
@@ -571,13 +626,6 @@ def measure_peer_forward(mode: str = "columns", n_threads: int = 8,
     from gubernator_tpu.daemon import Daemon
     from gubernator_tpu.service import IngressColumns
     from gubernator_tpu.types import PeerInfo
-
-    def free_port():
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
-        return port
 
     behaviors = fast_test_behaviors()
     behaviors.peer_columns = mode == "columns"
@@ -598,88 +646,72 @@ def measure_peer_forward(mode: str = "columns", n_threads: int = 8,
         )
     ).start()
 
-    owner_http, owner_grpc = free_port(), free_port()
-    env = dict(os.environ)
-    env.update(
-        XLA_FLAGS="--xla_force_host_platform_device_count=2",
-        JAX_PLATFORMS="cpu",
-        JAX_COMPILATION_CACHE_DIR=os.path.join(os.getcwd(), ".jax_cache"),
-        GUBER_HTTP_ADDRESS=f"127.0.0.1:{owner_http}",
-        GUBER_GRPC_ADDRESS=f"127.0.0.1:{owner_grpc}",
-        GUBER_STATIC_PEERS=(
-            f"127.0.0.1:{owner_grpc}|127.0.0.1:{owner_http},"
-            f"{entry.peer_info.grpc_address}|{entry.peer_info.http_address}"
-        ),
-        GUBER_PEER_COLUMNS="1" if mode == "columns" else "0",
-        GUBER_GLOBAL_SYNC_WAIT="3600s",
-        GUBER_MULTI_REGION_SYNC_WAIT="3600s",
-        GUBER_BATCH_TIMEOUT="30s",
-        GUBER_CACHE_SIZE="8192",
-    )
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "gubernator_tpu.cmd.server"],
-        stdout=subprocess.PIPE, text=True, env=env, cwd=os.getcwd(),
-    )
     try:
-        line = proc.stdout.readline()
-        if "listening" not in line:
-            raise RuntimeError(f"owner daemon failed to start: {line!r}")
-        entry.set_peers([
-            entry.peer_info,
-            PeerInfo(
-                grpc_address=f"127.0.0.1:{owner_grpc}",
-                http_address=f"127.0.0.1:{owner_http}",
-            ),
-        ])
+        with _bench_daemon(
+            extra_env_fn=lambda h, g: {
+                "GUBER_STATIC_PEERS": (
+                    f"127.0.0.1:{g}|127.0.0.1:{h},"
+                    f"{entry.peer_info.grpc_address}|"
+                    f"{entry.peer_info.http_address}"
+                ),
+                "GUBER_PEER_COLUMNS": "1" if mode == "columns" else "0",
+            },
+            what="owner daemon",
+        ) as (owner_http, owner_grpc):
+            entry.set_peers([
+                entry.peer_info,
+                PeerInfo(
+                    grpc_address=f"127.0.0.1:{owner_grpc}",
+                    http_address=f"127.0.0.1:{owner_http}",
+                ),
+            ])
 
-        keys = []
-        i = 0
-        while len(keys) < batch:
-            k = f"fw{i}"
-            if not entry.service.get_peer(f"bench_{k}").info.is_owner:
-                keys.append(k)
-            i += 1
+            keys = []
+            i = 0
+            while len(keys) < batch:
+                k = f"fw{i}"
+                if not entry.service.get_peer(f"bench_{k}").info.is_owner:
+                    keys.append(k)
+                i += 1
 
-        def cols():
-            return IngressColumns(
-                names=["bench"] * batch,
-                unique_keys=list(keys),
-                algorithm=np.zeros(batch, np.int32),
-                behavior=np.zeros(batch, np.int32),
-                hits=np.ones(batch, np.int64),
-                limit=np.full(batch, 1_000_000, np.int64),
-                duration=np.full(batch, 3_600_000, np.int64),
-            )
+            def cols():
+                return IngressColumns(
+                    names=["bench"] * batch,
+                    unique_keys=list(keys),
+                    algorithm=np.zeros(batch, np.int32),
+                    behavior=np.zeros(batch, np.int32),
+                    hits=np.ones(batch, np.int64),
+                    limit=np.full(batch, 1_000_000, np.int64),
+                    duration=np.full(batch, 3_600_000, np.int64),
+                )
 
-        first = entry.service.get_rate_limits_columns(cols()).response_at(0)
-        if first.error or not first.metadata.get("owner"):
-            raise RuntimeError(f"forwarded warmup failed: {first}")
+            first = entry.service.get_rate_limits_columns(cols()).response_at(0)
+            if first.error or not first.metadata.get("owner"):
+                raise RuntimeError(f"forwarded warmup failed: {first}")
 
-        def worker():
-            for _ in range(iters):
-                entry.service.get_rate_limits_columns(cols())
+            def worker():
+                for _ in range(iters):
+                    entry.service.get_rate_limits_columns(cols())
 
-        def epoch():
-            ts = [threading.Thread(target=worker) for _ in range(n_threads)]
-            for t in ts:
-                t.start()
-            for t in ts:
-                t.join()
+            def epoch():
+                ts = [
+                    threading.Thread(target=worker)
+                    for _ in range(n_threads)
+                ]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
 
-        epoch()  # warm: pad-bucket compiles, window negotiation
-        best = 0.0
-        for _ in range(3):
-            t0 = time.perf_counter()
-            epoch()
-            dt = time.perf_counter() - t0
-            best = max(best, batch * iters * n_threads / dt)
-        return best
+            epoch()  # warm: pad-bucket compiles, window negotiation
+            best = 0.0
+            for _ in range(3):
+                t0 = time.perf_counter()
+                epoch()
+                dt = time.perf_counter() - t0
+                best = max(best, batch * iters * n_threads / dt)
+            return best
     finally:
-        proc.send_signal(signal.SIGTERM)
-        try:
-            proc.wait(timeout=15)
-        except subprocess.TimeoutExpired:
-            proc.kill()
         entry.close()
 
 
@@ -705,10 +737,6 @@ def measure_global_plane(mode: str = "columns", n_threads: int = 2,
     and the combined plane_items_per_sec (total items over the two
     legs' best-epoch wall time) that the same-run
     global_plane_vs_classic gate ratio uses."""
-    import os
-    import signal
-    import socket
-    import subprocess
     import threading
 
     from gubernator_tpu import wire
@@ -722,40 +750,16 @@ def measure_global_plane(mode: str = "columns", n_threads: int = 2,
         RateLimitRequest,
     )
 
-    def free_port():
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
-        return port
-
     columns = mode == "columns"
-    owner_http, owner_grpc = free_port(), free_port()
-    env = dict(os.environ)
-    env.update(
-        XLA_FLAGS="--xla_force_host_platform_device_count=2",
-        JAX_PLATFORMS="cpu",
-        JAX_COMPILATION_CACHE_DIR=os.path.join(os.getcwd(), ".jax_cache"),
-        GUBER_HTTP_ADDRESS=f"127.0.0.1:{owner_http}",
-        GUBER_GRPC_ADDRESS=f"127.0.0.1:{owner_grpc}",
-        GUBER_STATIC_PEERS=f"127.0.0.1:{owner_grpc}|127.0.0.1:{owner_http}",
-        GUBER_GLOBAL_COLUMNS="1" if columns else "0",
-        GUBER_PEER_COLUMNS="1" if columns else "0",
-        GUBER_GLOBAL_SYNC_WAIT="3600s",
-        GUBER_MULTI_REGION_SYNC_WAIT="3600s",
-        GUBER_BATCH_TIMEOUT="30s",
-        GUBER_CACHE_SIZE="8192",
-        GUBER_GLOBAL_CACHE_SIZE="4096",
-    )
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "gubernator_tpu.cmd.server"],
-        stdout=subprocess.PIPE, text=True, env=env, cwd=os.getcwd(),
-    )
-    client = None
-    try:
-        line = proc.stdout.readline()
-        if "listening" not in line:
-            raise RuntimeError(f"receiver daemon failed to start: {line!r}")
+    with contextlib.ExitStack() as stack:
+        owner_http, owner_grpc = stack.enter_context(_bench_daemon(
+            extra_env={
+                "GUBER_GLOBAL_COLUMNS": "1" if columns else "0",
+                "GUBER_PEER_COLUMNS": "1" if columns else "0",
+                "GUBER_GLOBAL_CACHE_SIZE": "4096",
+            },
+            what="receiver daemon",
+        ))
         behaviors = BehaviorConfig(
             batch_timeout_s=30.0,
             peer_columns=columns,
@@ -768,6 +772,8 @@ def measure_global_plane(mode: str = "columns", n_threads: int = 2,
             ),
             behaviors,
         )
+        # LIFO: the client drains before the daemon it talks to exits.
+        stack.callback(client.shutdown, timeout_s=2.0)
         now = int(time.time() * 1000)
         bcols = GlobalsColumns(
             keys=[f"gp_bench:{i}" for i in range(batch)],
@@ -845,14 +851,105 @@ def measure_global_plane(mode: str = "columns", n_threads: int = 2,
             "forwarded_hits_per_sec": hit_rate,
             "plane_items_per_sec": total / (bc_dt + hit_dt),
         }
-    finally:
-        if client is not None:
-            client.shutdown(timeout_s=2.0)
-        proc.send_signal(signal.SIGTERM)
+
+
+def measure_ingress_columns(mode: str = "columns", n_threads: int = 8,
+                            iters: int = 8, batch: int = 1000) -> float:
+    """Public-ingress throughput over the REAL wire against a daemon in
+    its OWN process (own GIL — the established loopback rule; the
+    daemon runs the native epoll edge, CPU-pinned devices).  `mode`:
+
+      * "columns" — ColumnsV1Client: client-side column accumulation,
+        GUBC kind-5 frames (pipelined), native gt_frame_parse decode on
+        the daemon, kind-6 array responses.  The front-door fast path.
+      * "json" — the classic V1Client per-request JSON encoding against
+        the SAME daemon build: per-request dict/dataclass work both
+        sides, json.loads/render on the daemon.  The pre-PR client
+        wire (keep-alive included, so the ratio measures the ENCODING,
+        not reconnect overhead).
+
+    Both modes measured back-to-back in the same bench run so host
+    weather cancels in the ingress_columns_vs_json gate ratio.
+    Returns checks/s (best of 3 epochs)."""
+    import threading
+
+    from gubernator_tpu.client import ColumnsV1Client, V1Client
+    from gubernator_tpu.types import GetRateLimitsRequest, RateLimitRequest
+
+    closers = []
+    with _bench_daemon(
+        extra_env={
+            "GUBER_NATIVE_HTTP": "1",
+            "GUBER_INGRESS_COLUMNS": "1",
+            "GUBER_CACHE_SIZE": "32768",
+        },
+        what="ingress daemon",
+    ) as (http_port, _grpc_port):
+        endpoint = f"127.0.0.1:{http_port}"
+        if mode == "columns":
+            client = ColumnsV1Client(endpoint, timeout_s=30.0)
+            closers.append(client)
+            per_thread = [
+                (
+                    ["bench"] * batch,
+                    [f"ic{t}:{i}" for i in range(batch)],
+                    (np.arange(batch) % 2).astype(np.int32),
+                    np.zeros(batch, np.int32),
+                    np.ones(batch, np.int64),
+                    np.full(batch, 1_000_000, np.int64),
+                    np.full(batch, 3_600_000, np.int64),
+                )
+                for t in range(n_threads)
+            ]
+
+            def one(t):
+                client.submit_columns(per_thread[t]).result(timeout=60)
+        else:
+            clients = [V1Client(endpoint, timeout_s=30.0)
+                       for _ in range(n_threads)]
+            closers.extend(clients)
+            per_thread = [
+                GetRateLimitsRequest(requests=[
+                    RateLimitRequest(
+                        name="bench", unique_key=f"ic{t}:{i}", hits=1,
+                        limit=1_000_000, duration=3_600_000,
+                        algorithm=i % 2,
+                    )
+                    for i in range(batch)
+                ])
+                for t in range(n_threads)
+            ]
+
+            def one(t):
+                clients[t].get_rate_limits(per_thread[t])
+
+        def worker(t):
+            for _ in range(iters):
+                one(t)
+
+        def epoch():
+            ts = [
+                threading.Thread(target=worker, args=(t,))
+                for t in range(n_threads)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+
         try:
-            proc.wait(timeout=15)
-        except subprocess.TimeoutExpired:
-            proc.kill()
+            epoch()  # warm: pad-bucket compiles, negotiation, keep-alives
+            best = 0.0
+            for _ in range(3):
+                t0 = time.perf_counter()
+                epoch()
+                dt = time.perf_counter() - t0
+                best = max(best, batch * iters * n_threads / dt)
+            return best
+        finally:
+            # Clients drain before the daemon context tears down.
+            for c in closers:
+                c.close()
 
 
 GATE_THRESHOLDS = "benchmarks/gate_thresholds.json"
@@ -982,6 +1079,20 @@ def gate() -> int:
             f"device_us_b{sb}": dev["small_batch_us"][sb][3]
             for sb in (256, 1024)
         }
+    if "ingress_columns_vs_json" not in rows:
+        try:
+            ic_cols = measure_ingress_columns("columns")
+            ic_json = measure_ingress_columns("json")
+            rows["ingress_columns_checks_per_sec"] = ic_cols
+            # Same-run ratio: both legs hammer identical daemon builds
+            # back-to-back, so host weather cancels.
+            rows["ingress_columns_vs_json"] = ic_cols / max(ic_json, 1.0)
+            print(
+                f"gate ingress rows: columnar {ic_cols:.0f} checks/s, "
+                f"json {ic_json:.0f} checks/s"
+            )
+        except Exception as e:  # noqa: BLE001 — daemon spawn can fail
+            print(f"gate ingress_columns_vs_json: SKIP (measure failed: {e})")
     if "global_plane_vs_classic" not in rows:
         try:
             gp_cols = measure_global_plane("columns")
@@ -1172,6 +1283,11 @@ def main():
     # ---- service-tier columnar ingress -------------------------------
     service_cps, svc_p50, svc_p99, svc_lat_n = measure_service_ingress()
 
+    # ---- public ingress: columnar front door vs classic JSON ---------
+    ingress_columns_cps = measure_ingress_columns("columns")
+    ingress_json_cps = measure_ingress_columns("json")
+    ingress_columns_ratio = ingress_columns_cps / max(ingress_json_cps, 1.0)
+
     # ---- peer hop: loopback two-daemon forward (CPU-pinned) ----------
     peer_forward_cps = measure_peer_forward("columns")
     peer_forward_classic_cps = measure_peer_forward("classic")
@@ -1196,6 +1312,8 @@ def main():
         "peer_forward_vs_classic": (
             peer_forward_cps / max(peer_forward_classic_cps, 1.0)
         ),
+        "ingress_columns_checks_per_sec": ingress_columns_cps,
+        "ingress_columns_vs_json": ingress_columns_ratio,
         "global_plane_vs_classic": global_plane_ratio,
         "dispatch_overlap_ratio": dispatch_overlap_ratio,
     })
@@ -1238,6 +1356,11 @@ def main():
                 "service_ingress_latency_ms_p99": round(svc_p99, 2),
                 "service_ingress_latency_n_samples": svc_lat_n,
                 "service_ingress_includes_tunnel_rtt": True,
+                "ingress_columns_checks_per_sec": round(
+                    ingress_columns_cps, 1
+                ),
+                "ingress_json_checks_per_sec": round(ingress_json_cps, 1),
+                "ingress_columns_vs_json": round(ingress_columns_ratio, 2),
                 "peer_forward_checks_per_sec": round(peer_forward_cps, 1),
                 "peer_forward_classic_checks_per_sec": round(
                     peer_forward_classic_cps, 1

@@ -14,12 +14,21 @@ def main(argv=None) -> int:
     parser.add_argument("endpoint", nargs="?", default="127.0.0.1:1050")
     parser.add_argument("--limits", type=int, default=2000)
     parser.add_argument("--concurrency", type=int, default=10)
+    parser.add_argument(
+        "--columns", action="store_true",
+        help="drive the columnar front door (ColumnsV1Client: checks "
+        "coalesce into GUBC frames; falls back to classic JSON against "
+        "an old daemon)",
+    )
     args = parser.parse_args(argv)
 
-    from ..client import V1Client, random_string
+    from ..client import ColumnsV1Client, V1Client, random_string
     from ..types import Algorithm, GetRateLimitsRequest, RateLimitRequest, Status, SECOND
 
-    client = V1Client(args.endpoint, timeout_s=0.5)
+    if args.columns:
+        client = ColumnsV1Client(args.endpoint, timeout_s=0.5)
+    else:
+        client = V1Client(args.endpoint, timeout_s=0.5)
     rng = random.Random()
     limits = [
         RateLimitRequest(
@@ -45,6 +54,8 @@ def main(argv=None) -> int:
 
     with ThreadPoolExecutor(max_workers=args.concurrency) as pool:
         list(pool.map(send, limits))
+    if args.columns:
+        client.close()
     print(f"done: {args.limits} requests, {over} over limit")
     return 0
 

@@ -33,6 +33,14 @@ MAX_BATCH_SIZE = 1000  # gubernator.go:36
 # MAX_BATCH_SIZE chunks.
 PEER_COLUMNS_MAX_LANES = 16_384
 
+# Lane cap for ONE public columnar ingress request (wire.py "public
+# columnar ingress").  The reference's 1000-item cap guards the classic
+# JSON/pb surface unchanged; a columnar client exists to accumulate
+# many callers' checks into one frame, so its cap matches the peer
+# hop's — the daemon-side budget arithmetic (ingress queue, device
+# ceiling) already accounts for batches this size arriving from peers.
+INGRESS_COLUMNS_MAX_LANES = PEER_COLUMNS_MAX_LANES
+
 
 @dataclass
 class BehaviorConfig:
@@ -63,6 +71,17 @@ class BehaviorConfig:
     # mixed-version interop tests run one daemon in this mode).
     # Env: GUBER_PEER_COLUMNS.
     peer_columns: bool = True
+    # Public columnar ingress (wire.py "public columnar ingress", the
+    # front door): the daemon sniffs GUBC kind-5 frames on
+    # POST /v1/GetRateLimits and serves V1/GetRateLimitsColumns over
+    # gRPC, decoding client batches straight into ingress columns (no
+    # per-request JSON/dict/dataclass work) and answering from the
+    # result arrays.  False withholds both surfaces — a columns client
+    # sees 400/UNIMPLEMENTED exactly like against a pre-columns build
+    # and falls back sticky to classic JSON (the mixed-version interop
+    # mode); classic clients are unaffected either way.
+    # Env: GUBER_INGRESS_COLUMNS.
+    ingress_columns: bool = True
 
     global_timeout_s: float = 0.5
     # None = AUTO: size the window from the measured device cost of one
@@ -421,6 +440,9 @@ def setup_daemon_config(
         merged, "GUBER_INGRESS_QUEUE_LANES", b.ingress_queue_lanes
     )
     b.peer_columns = _env_bool(merged, "GUBER_PEER_COLUMNS", b.peer_columns)
+    b.ingress_columns = _env_bool(
+        merged, "GUBER_INGRESS_COLUMNS", b.ingress_columns
+    )
     b.global_timeout_s = _env_float_ms(merged, "GUBER_GLOBAL_TIMEOUT", b.global_timeout_s)
     b.global_sync_wait_s = _env_float_ms(
         merged, "GUBER_GLOBAL_SYNC_WAIT", b.global_sync_wait_s

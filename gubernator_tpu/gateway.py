@@ -27,7 +27,11 @@ from . import native as _native
 from . import saturation
 from . import tracing
 from . import wire
-from .config import MAX_BATCH_SIZE, PEER_COLUMNS_MAX_LANES
+from .config import (
+    INGRESS_COLUMNS_MAX_LANES,
+    MAX_BATCH_SIZE,
+    PEER_COLUMNS_MAX_LANES,
+)
 from .service import ApiError, ColumnarResult, IngressColumns, V1Service
 from .types import Algorithm, RateLimitRequest, UpdatePeerGlobal, _parse_behavior
 
@@ -281,6 +285,30 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
             # attaches a trace exemplar from the still-active context.
             with tracing.ingress_span("http", path, tp):
                 with service.metrics.observe_rpc("/pb.gubernator.V1/GetRateLimits"):
+                    if service.serves_ingress_columns and wire.is_ingress_frame(raw):
+                        # Columnar front door: GUBC kind-5 frame in,
+                        # kind-6 frame out (no JSON either way).  With
+                        # the knob off this branch is never reached —
+                        # the frame falls into json.loads below and
+                        # 400s exactly like a pre-columns build, which
+                        # is the client's version probe.
+                        t_parse = time.perf_counter()
+                        cols = _decode_ingress_frame_or_400(raw)
+                        saturation.observe_phase(
+                            "ingress.parse", time.perf_counter() - t_parse
+                        )
+                        result = service.get_rate_limits_columns(
+                            cols, max_lanes=INGRESS_COLUMNS_MAX_LANES
+                        )
+                        t_enc = time.perf_counter()
+                        rendered = wire.encode_ingress_result_frame(result)
+                        saturation.observe_phase(
+                            "response.encode", time.perf_counter() - t_enc
+                        )
+                        service.metrics.ingress_columns_batches.labels(
+                            encoding="frame"
+                        ).inc()
+                        return 200, wire.COLUMNS_CONTENT_TYPE, rendered
                     t_parse = time.perf_counter()
                     cols = parse_body_native(raw) if raw else None
                     native = cols is not None
@@ -484,6 +512,15 @@ def _decode_frame_or_400(raw: bytes):
         raise ApiError("InvalidArgument", f"invalid columns frame: {e}") from e
 
 
+def _decode_ingress_frame_or_400(raw: bytes):
+    """Public-ingress twin of _decode_frame_or_400 (kind-5 frames,
+    untrusted-client validation inside the decode)."""
+    try:
+        return wire.decode_ingress_frame(raw)
+    except ValueError as e:
+        raise ApiError("InvalidArgument", f"invalid columns frame: {e}") from e
+
+
 def _error_triplet(e: BaseException):
     """Map a handler exception to (status, content_type, body) — the
     same arms as handle_request's except clauses, shared with the async
@@ -560,12 +597,24 @@ def handle_request_async(service: V1Service, method: str, path: str,
 
     try:
         if path == "/v1/GetRateLimits":
+            ingress_frame = (
+                service.serves_ingress_columns and wire.is_ingress_frame(raw)
+            )
             t_parse = time.perf_counter()
-            cols = parse_body_native(raw) if raw else None
-            native = cols is not None
-            if cols is None:
-                body = json.loads(raw) if raw else {}
-                cols = parse_columns(body.get("requests", []))
+            if ingress_frame:
+                # Columnar front door, async edge: the native worker
+                # hands ready column buffers (gt_frame_parse ran with
+                # the GIL released) to the submit path and returns to
+                # the ingress queue; the kind-6 response renders on the
+                # completion thread straight from the result arrays.
+                cols = _decode_ingress_frame_or_400(raw)
+                native = False
+            else:
+                cols = parse_body_native(raw) if raw else None
+                native = cols is not None
+                if cols is None:
+                    body = json.loads(raw) if raw else {}
+                    cols = parse_columns(body.get("requests", []))
             saturation.observe_phase(
                 "ingress.parse", time.perf_counter() - t_parse
             )
@@ -579,6 +628,16 @@ def handle_request_async(service: V1Service, method: str, path: str,
                         finish("1", _error_triplet(exc))
                         return
                     t_enc = time.perf_counter()
+                    if ingress_frame:
+                        rendered = wire.encode_ingress_result_frame(result)
+                        saturation.observe_phase(
+                            "response.encode", time.perf_counter() - t_enc
+                        )
+                        metrics.ingress_columns_batches.labels(
+                            encoding="frame"
+                        ).inc()
+                        finish("0", (200, wire.COLUMNS_CONTENT_TYPE, rendered))
+                        return
                     rendered = (
                         render_result_native(result) if native else None
                     )
@@ -591,7 +650,13 @@ def handle_request_async(service: V1Service, method: str, path: str,
                 except Exception as e:  # noqa: BLE001
                     finish("1", _error_triplet(e))
 
-            service.get_rate_limits_columns_async(cols, cb)
+            service.get_rate_limits_columns_async(
+                cols, cb,
+                max_lanes=(
+                    INGRESS_COLUMNS_MAX_LANES if ingress_frame
+                    else MAX_BATCH_SIZE
+                ),
+            )
         else:
             frame = service.serves_peer_columns and wire.is_columns_frame(raw)
             if frame:

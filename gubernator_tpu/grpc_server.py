@@ -20,7 +20,7 @@ import grpc
 
 from . import tracing
 from . import wire
-from .config import PEER_COLUMNS_MAX_LANES
+from .config import INGRESS_COLUMNS_MAX_LANES, PEER_COLUMNS_MAX_LANES
 from .proto import PEERS_V1_SERVICE, V1_SERVICE
 from .proto import gubernator_pb2 as pb
 from .proto import peers_columns_pb2 as pc_pb
@@ -198,24 +198,78 @@ def _v1_handler(service: V1Service) -> grpc.GenericRpcHandler:
         except ApiError as e:
             _abort_api_error(context, e)
 
+    def get_rate_limits_columns(
+        request: pc_pb.PeerColumnsReq, context
+    ) -> pc_pb.IngressColumnsResp:
+        """The public columnar ingress (the front door, wire.py "public
+        columnar ingress"): proto columns decode straight into
+        IngressColumns and the result arrays — owner annotation
+        included — serialize straight back, no per-lane dataclasses
+        either way."""
+        try:
+            # Untrusted-client validation, the HTTP frame edge's twin
+            # (wire._decode_req_frame validate=True) — the two
+            # transports must not diverge.  Ragged columns would crash
+            # the decode (or silently truncate); an out-of-range
+            # algorithm must not reach the kernel as a garbage branch
+            # selector.
+            n = len(request.names)
+            if any(
+                len(col) != n
+                for col in (
+                    request.unique_keys, request.algorithm,
+                    request.behavior, request.hits, request.limit,
+                    request.duration,
+                )
+            ):
+                raise ApiError(
+                    "InvalidArgument", "column length mismatch"
+                )
+            cols = wire.ingress_from_peer_columns_pb(request)
+            if len(cols) and bool(
+                ((cols.algorithm < 0) | (cols.algorithm > 1)).any()
+            ):
+                raise ApiError(
+                    "InvalidArgument", "algorithm out of range"
+                )
+            result = service.get_rate_limits_columns(
+                cols, max_lanes=INGRESS_COLUMNS_MAX_LANES,
+            )
+            resp = wire.result_to_ingress_columns_pb(result)
+            service.metrics.ingress_columns_batches.labels(
+                encoding="proto"
+            ).inc()
+            return resp
+        except ApiError as e:
+            _abort_api_error(context, e)
+
     def health_check(request: pb.HealthCheckReq, context) -> pb.HealthCheckResp:
         return wire.health_to_pb(service.health_check())
 
-    return grpc.method_handlers_generic_handler(
-        V1_SERVICE,
-        {
-            "GetRateLimits": grpc.unary_unary_rpc_method_handler(
-                get_rate_limits,
-                request_deserializer=pb.GetRateLimitsReq.FromString,
-                response_serializer=pb.GetRateLimitsResp.SerializeToString,
-            ),
-            "HealthCheck": grpc.unary_unary_rpc_method_handler(
-                health_check,
-                request_deserializer=pb.HealthCheckReq.FromString,
-                response_serializer=pb.HealthCheckResp.SerializeToString,
-            ),
-        },
-    )
+    methods = {
+        "GetRateLimits": grpc.unary_unary_rpc_method_handler(
+            get_rate_limits,
+            request_deserializer=pb.GetRateLimitsReq.FromString,
+            response_serializer=pb.GetRateLimitsResp.SerializeToString,
+        ),
+        "HealthCheck": grpc.unary_unary_rpc_method_handler(
+            health_check,
+            request_deserializer=pb.HealthCheckReq.FromString,
+            response_serializer=pb.HealthCheckResp.SerializeToString,
+        ),
+    }
+    if service.serves_ingress_columns:
+        # The shared advertisement rule (V1Service.serves_ingress_
+        # columns): GUBER_INGRESS_COLUMNS=0 — or a store without
+        # columnar support — withholds the method entirely, so clients
+        # see UNIMPLEMENTED, exactly what a pre-columns daemon answers
+        # (the mixed-version interop mode).
+        methods["GetRateLimitsColumns"] = grpc.unary_unary_rpc_method_handler(
+            get_rate_limits_columns,
+            request_deserializer=pc_pb.PeerColumnsReq.FromString,
+            response_serializer=pc_pb.IngressColumnsResp.SerializeToString,
+        )
+    return grpc.method_handlers_generic_handler(V1_SERVICE, methods)
 
 
 def _peers_v1_handler(service: V1Service) -> grpc.GenericRpcHandler:

@@ -134,6 +134,15 @@ class Metrics:
             ["encoding"],
             registry=self.registry,
         )
+        # -- public columnar ingress (wire.py, gateway/grpc edges) -----
+        self.ingress_columns_batches = Counter(
+            "gubernator_ingress_columns_batches",
+            "Public GetRateLimits batches served from the columnar "
+            "ingress path by wire encoding (frame = GUBC kind-5 on the "
+            "HTTP gateway, proto = V1/GetRateLimitsColumns over gRPC).",
+            ["encoding"],
+            registry=self.registry,
+        )
         # -- columnar GLOBAL replication plane (service.GlobalManager) -
         self.global_broadcast_batches = Counter(
             "gubernator_global_broadcast_batches",

@@ -190,6 +190,12 @@ def _build() -> Optional[ctypes.CDLL]:
         c.c_void_p, c.c_int64, c.c_char_p, c.c_void_p, c.c_char_p,
         c.c_int64,
     ]
+    lib.gt_frame_parse.restype = c.c_void_p
+    lib.gt_frame_parse.argtypes = [
+        c.c_char_p, c.c_int64, c.c_int32, c.c_void_p,
+    ]
+    lib.gt_frame_fill.argtypes = [c.c_void_p] + [c.c_void_p] * 3
+    lib.gt_frame_free.argtypes = [c.c_void_p]
     lib.gt_http_start.restype = c.c_void_p
     lib.gt_http_start.argtypes = [c.c_char_p, c.c_int]
     lib.gt_http_port.restype = c.c_int
@@ -351,6 +357,71 @@ def parse_json_batch(body: bytes) -> Optional[ParsedJson]:
         lib.gt_json_free(h)
     return ParsedJson(n, algo, behavior, hits, limit, duration, err,
                       PackedKeys(hk, hkoff), nspan, ukspan, body)
+
+
+class _GtFrameInfo(ctypes.Structure):
+    _fields_ = [(name, ctypes.c_int64) for name in (
+        "n", "name_off_pos", "name_blob_pos", "uk_off_pos", "uk_blob_pos",
+        "algo_pos", "beh_pos", "hits_pos", "limit_pos", "dur_pos",
+        "trace_pos", "trace_count", "hk_bytes",
+    )]
+
+
+_INGRESS_FRAME_KIND = 5  # wire._FRAME_KIND_INGRESS_REQ
+
+
+def parse_ingress_frame(raw: bytes):
+    """Parse a public GUBC ingress frame (kind 5) natively: one
+    GIL-released pass validates the frame, slices every column (numpy
+    views of `raw`, zero-copy numerics), builds the packed hash keys
+    and stamps per-lane validation codes — the wire.decode_ingress_frame
+    fast path.  None means "use the Python decode" (no native runtime,
+    or a malformed frame whose exact error wording the Python path
+    owns)."""
+    lib = _get_lib()
+    if lib is None:
+        return None
+    info = _GtFrameInfo()
+    h = lib.gt_frame_parse(raw, len(raw), _INGRESS_FRAME_KIND,
+                           ctypes.byref(info))
+    if not h:
+        return None
+    try:
+        n = int(info.n)
+        hk = np.empty(max(int(info.hk_bytes), 1), dtype=np.uint8)
+        hkoff = np.empty(n + 1, dtype=np.int64)
+        err = np.empty(max(n, 1), dtype=np.uint8)
+        lib.gt_frame_fill(h, hk.ctypes.data, hkoff.ctypes.data,
+                          err.ctypes.data)
+    finally:
+        lib.gt_frame_free(h)
+    from .. import wire  # deferred: wire imports this package lazily
+
+    no = np.frombuffer(raw, np.uint32, n + 1, int(info.name_off_pos))
+    uo = np.frombuffer(raw, np.uint32, n + 1, int(info.uk_off_pos))
+    nb = raw[int(info.name_blob_pos):int(info.name_blob_pos) + int(no[-1] if n else 0)]
+    ub = raw[int(info.uk_blob_pos):int(info.uk_blob_pos) + int(uo[-1] if n else 0)]
+    try:
+        # Untrusted-edge parity with wire._check_utf8_blobs: invalid
+        # UTF-8 must 400 here, not 500 later inside a slow-lane decode.
+        nb.decode("utf-8")
+        ub.decode("utf-8")
+    except UnicodeDecodeError:
+        return None  # the Python decode owns the exact error wording
+    trace_ctx = None
+    if info.trace_count > 0:
+        trace_ctx, _ = wire.unpack_trace_entries(raw, int(info.trace_pos))
+    return wire.FrameIngressColumns(
+        n, nb, no, ub, uo,
+        np.frombuffer(raw, np.int32, n, int(info.algo_pos)),
+        np.frombuffer(raw, np.int32, n, int(info.beh_pos)),
+        np.frombuffer(raw, np.int64, n, int(info.hits_pos)),
+        np.frombuffer(raw, np.int64, n, int(info.limit_pos)),
+        np.frombuffer(raw, np.int64, n, int(info.dur_pos)),
+        trace_ctx=trace_ctx,
+        err=err[:n],
+        packed=PackedKeys(hk[:int(info.hk_bytes)], hkoff),
+    )
 
 
 def render_json(status, limit, remaining, reset, overrides: dict) -> Optional[bytes]:

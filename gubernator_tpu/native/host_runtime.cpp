@@ -1601,6 +1601,179 @@ int64_t gt_json_render(const int32_t* status, const int64_t* limit,
 }  // extern "C"
 
 // ======================================================================
+// GUBC ingress-frame parser (gt_frame_*): the public columnar front
+// door's decode half in C++.
+//
+// A kind-5 ingress frame (wire.py "public columnar ingress") arrives
+// through the epoll edge below; before any Python-level work runs, one
+// native pass — entered via ctypes with the GIL released — validates
+// the whole frame (magic/version/kind, string-column offset
+// monotonicity, section lengths, algorithm range), computes the byte
+// position of every column so Python wraps them as zero-copy numpy
+// views, builds the packed hash keys (name + '_' + unique_key — the
+// planner's input) with one scatter, and stamps per-lane validation
+// codes (1 = empty unique_key, 2 = empty name; gubernator.go:142-152
+// order).  The GIL only ever sees ready column buffers.  Anything
+// malformed returns NULL and the numpy decode path reproduces the
+// exact error wording.
+//
+// The scatter runs on the WORKER thread (parallel across workers,
+// GIL-free), not the epoll thread: the epoll loop is the one shared
+// resource every connection serializes on, so per-frame O(bytes) work
+// there would re-create the convoy this edge exists to remove.
+// ======================================================================
+
+namespace {
+
+struct FrameBatch {
+  const char* body;  // caller-owned; must outlive the handle
+  int64_t n = 0;
+  int64_t name_off_pos = 0, name_blob_pos = 0, name_blob_len = 0;
+  int64_t uk_off_pos = 0, uk_blob_pos = 0, uk_blob_len = 0;
+};
+
+// Little-endian u32 at an arbitrary (possibly unaligned) offset.
+inline uint32_t frame_u32(const char* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+// Validate one string column at `pos`; fills off_pos/blob_pos/blob_len
+// and returns the position past the column, or -1 when malformed
+// (truncated, non-zero first offset, non-monotonic, length mismatch —
+// the same checks wire._read_str_blob makes).
+int64_t frame_str_col(const char* body, int64_t blen, int64_t pos, int64_t n,
+                      int64_t* off_pos, int64_t* blob_pos, int64_t* blob_len) {
+  if (pos + 4 > blen) return -1;
+  int64_t bl = (int64_t)frame_u32(body + pos);
+  pos += 4;
+  if (pos + 4 * (n + 1) > blen) return -1;
+  *off_pos = pos;
+  const char* off = body + pos;
+  pos += 4 * (n + 1);
+  if (pos + bl > blen) return -1;
+  if (n) {
+    if (frame_u32(off) != 0) return -1;
+    uint32_t prev = 0;
+    for (int64_t i = 1; i <= n; i++) {
+      uint32_t cur = frame_u32(off + 4 * i);
+      if (cur < prev) return -1;
+      prev = cur;
+    }
+    if ((int64_t)prev != bl) return -1;
+  }
+  *blob_pos = pos;
+  *blob_len = bl;
+  return pos + bl;
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef struct {
+  int64_t n;
+  int64_t name_off_pos, name_blob_pos;
+  int64_t uk_off_pos, uk_blob_pos;
+  int64_t algo_pos, beh_pos, hits_pos, limit_pos, dur_pos;
+  int64_t trace_pos;    // byte offset of the GTRC magic, -1 = absent
+  int64_t trace_count;  // trailer entry count (32 bytes each)
+  int64_t hk_bytes;     // packed hash-key buffer size for gt_frame_fill
+} GtFrameInfo;
+
+// Parse + validate a GUBC request frame of `kind`; fills *out and
+// returns a handle for gt_frame_fill/gt_frame_free, or NULL when the
+// frame is malformed (caller falls back to the Python decode for the
+// exact error).  `body` must stay valid until gt_frame_free.
+void* gt_frame_parse(const char* body, int64_t blen, int32_t kind,
+                     GtFrameInfo* out) {
+  if (blen < 10 || memcmp(body, "GUBC", 4) != 0) return nullptr;
+  if ((uint8_t)body[4] != 1 || (uint8_t)body[5] != (uint8_t)kind)
+    return nullptr;
+  int64_t n = (int64_t)frame_u32(body + 6);
+  // 2M lanes is far past every cap (PEER_COLUMNS_MAX_LANES = 16384);
+  // bounding n keeps the size arithmetic below trivially overflow-free.
+  if (n > (int64_t)2 * 1024 * 1024) return nullptr;
+  FrameBatch fb;
+  fb.body = body;
+  fb.n = n;
+  int64_t pos = 10;
+  pos = frame_str_col(body, blen, pos, n, &fb.name_off_pos,
+                      &fb.name_blob_pos, &fb.name_blob_len);
+  if (pos < 0) return nullptr;
+  pos = frame_str_col(body, blen, pos, n, &fb.uk_off_pos, &fb.uk_blob_pos,
+                      &fb.uk_blob_len);
+  if (pos < 0) return nullptr;
+  if (pos + n * (4 + 4 + 8 + 8 + 8) > blen) return nullptr;
+  out->algo_pos = pos;
+  pos += 4 * n;
+  out->beh_pos = pos;
+  pos += 4 * n;
+  out->hits_pos = pos;
+  pos += 8 * n;
+  out->limit_pos = pos;
+  pos += 8 * n;
+  out->dur_pos = pos;
+  pos += 8 * n;
+  // Algorithm range check (the public edge's one semantic column
+  // check): out-of-range values reject the frame before the kernel
+  // could see a garbage branch selector.
+  for (int64_t i = 0; i < n; i++) {
+    int32_t a;
+    memcpy(&a, body + out->algo_pos + 4 * i, 4);
+    if (a < 0 || a > 1) return nullptr;
+  }
+  out->trace_pos = -1;
+  out->trace_count = 0;
+  if (pos != blen) {
+    // Only legal continuation: the GTRC trace trailer (wire.py).
+    if (pos + 8 > blen || memcmp(body + pos, "GTRC", 4) != 0) return nullptr;
+    out->trace_pos = pos;
+    int64_t count = (int64_t)frame_u32(body + pos + 4);
+    if (pos + 8 + count * 32 != blen) return nullptr;
+    out->trace_count = count;
+  }
+  out->n = n;
+  out->name_off_pos = fb.name_off_pos;
+  out->name_blob_pos = fb.name_blob_pos;
+  out->uk_off_pos = fb.uk_off_pos;
+  out->uk_blob_pos = fb.uk_blob_pos;
+  out->hk_bytes = fb.name_blob_len + n + fb.uk_blob_len;
+  return new FrameBatch(fb);
+}
+
+// Build the packed hash keys (hk u8[hk_bytes] + hkoff i64[n+1]) and
+// per-lane validation codes (err u8[n]: 1 empty unique_key, 2 empty
+// name) from the frame the handle was parsed over.
+void gt_frame_fill(void* h, uint8_t* hk, int64_t* hkoff, uint8_t* err) {
+  auto* fb = (FrameBatch*)h;
+  const char* body = fb->body;
+  const char* noff = body + fb->name_off_pos;
+  const char* uoff = body + fb->uk_off_pos;
+  const char* nblob = body + fb->name_blob_pos;
+  const char* ublob = body + fb->uk_blob_pos;
+  int64_t w = 0;
+  for (int64_t i = 0; i < fb->n; i++) {
+    hkoff[i] = w;
+    uint32_t n0 = frame_u32(noff + 4 * i), n1 = frame_u32(noff + 4 * (i + 1));
+    uint32_t u0 = frame_u32(uoff + 4 * i), u1 = frame_u32(uoff + 4 * (i + 1));
+    size_t nlen = n1 - n0, ulen = u1 - u0;
+    memcpy(hk + w, nblob + n0, nlen);
+    w += nlen;
+    hk[w++] = '_';
+    memcpy(hk + w, ublob + u0, ulen);
+    w += ulen;
+    err[i] = ulen == 0 ? 1 : (nlen == 0 ? 2 : 0);
+  }
+  hkoff[fb->n] = w;
+}
+
+void gt_frame_free(void* h) { delete (FrameBatch*)h; }
+
+}  // extern "C"
+
+// ======================================================================
 // Native HTTP/1.1 edge (gt_http_*): the gateway's socket + framing
 // layer in C++.
 //

@@ -1101,6 +1101,24 @@ class V1Service:
         )
 
     @property
+    def serves_ingress_columns(self) -> bool:
+        """Whether this daemon ADVERTISES the public columnar ingress
+        encodings (the front door) — the single rule both transport
+        edges consult (gRPC V1/GetRateLimitsColumns registration, the
+        gateway's frame sniff on /v1/GetRateLimits), so client
+        negotiation can never diverge per transport.  False under the
+        GUBER_INGRESS_COLUMNS opt-out (the pre-columns interop mode:
+        frames fall into json.loads and answer 400, exactly what a
+        pre-PR build does) and for stores without columnar support —
+        those route every lane through the dataclass path capped at
+        MAX_BATCH_SIZE, which would hard-reject the
+        INGRESS_COLUMNS_MAX_LANES-sized batches the advertisement
+        invites."""
+        return getattr(self.conf.behaviors, "ingress_columns", True) and getattr(
+            self.store, "supports_columns", False
+        )
+
+    @property
     def serves_global_columns(self) -> bool:
         """Whether this daemon SPEAKS the columnar GLOBAL replication
         plane — the single rule both transport edges consult (gRPC
@@ -1157,17 +1175,24 @@ class V1Service:
     # ------------------------------------------------------------------
     # Columnar ingress (zero-dataclass hot path)
     # ------------------------------------------------------------------
-    def get_rate_limits_columns(self, cols: IngressColumns) -> ColumnarResult:
+    def get_rate_limits_columns(
+        self, cols: IngressColumns, max_lanes: int = MAX_BATCH_SIZE
+    ) -> ColumnarResult:
         """Column-form GetRateLimits: same routing/validation semantics
         as get_rate_limits (gubernator.go:116-227), but locally-owned
         plain lanes flow straight into the store's columnar kernel path
         with no per-request dataclasses.  GLOBAL / MULTI_REGION /
         remotely-owned lanes fall back to the dataclass path lane-wise.
-        """
-        if len(cols) > MAX_BATCH_SIZE:
+
+        `max_lanes` is the ingress-encoding cap: classic (per-request
+        JSON/pb) requests keep the reference's MAX_BATCH_SIZE; the
+        columnar frame/proto edges pass INGRESS_COLUMNS_MAX_LANES — a
+        columnar client's frame coalesces many callers' checks, exactly
+        like a forwarded peer batch."""
+        if len(cols) > max_lanes:
             raise ApiError(
                 "OutOfRange",
-                f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'",
+                f"Requests.RateLimits list too large; max size is '{max_lanes}'",
             )
         return self._route_columns(cols)
 
@@ -2173,7 +2198,8 @@ class V1Service:
             return self._drainer
 
     def get_rate_limits_columns_async(
-        self, cols: IngressColumns, callback: "Callable"
+        self, cols: IngressColumns, callback: "Callable",
+        max_lanes: int = MAX_BATCH_SIZE,
     ) -> None:
         """Async twin of get_rate_limits_columns: submits everything on
         the calling thread (validation, routing, dispatch/forward — no
@@ -2186,10 +2212,10 @@ class V1Service:
         convoy that cost the native edge its bulk throughput,
         benchmarks/RESULTS.md round-5 A/B)."""
         try:
-            if len(cols) > MAX_BATCH_SIZE:
+            if len(cols) > max_lanes:
                 raise ApiError(
                     "OutOfRange",
-                    f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'",
+                    f"Requests.RateLimits list too large; max size is '{max_lanes}'",
                 )
             n = len(cols)
             result = ColumnarResult.empty(n)

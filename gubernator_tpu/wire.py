@@ -202,6 +202,14 @@ FRAME_MAGIC = b"GUBC"
 FRAME_VERSION = 1
 _FRAME_KIND_REQ = 1
 _FRAME_KIND_RESP = 2
+# Public V1 ingress twins of kinds 1/2 (architecture.md "Columnar
+# pipeline: the front door"): the SAME column layout magic-sniffed on
+# POST /v1/GetRateLimits.  A distinct kind byte (not a path) carries
+# the public/peer distinction because the public response must carry
+# the owner annotation (forwarded lanes' metadata.owner) that the peer
+# hop never needs — kind 6 appends it as two columns.
+_FRAME_KIND_INGRESS_REQ = 5
+_FRAME_KIND_INGRESS_RESP = 6
 COLUMNS_CONTENT_TYPE = "application/x-gubernator-columns"
 
 
@@ -293,17 +301,19 @@ def _read_array(raw: bytes, pos: int, dtype, n: int):
 
 
 def encode_columns_frame(
-    cols: PeerColumns, trace: "Optional[Sequence[TraceEntry]]" = None
+    cols: PeerColumns, trace: "Optional[Sequence[TraceEntry]]" = None,
+    kind: int = _FRAME_KIND_REQ,
 ) -> bytes:
     """PeerColumns -> binary request frame (see architecture.md for the
     byte-level spec).  `trace` (sampled lanes' contexts) appends the
     optional trace trailer; None/empty keeps the frame byte-identical
-    to the pre-trace layout."""
+    to the pre-trace layout.  `kind` selects the peer hop (1, default)
+    or the public ingress twin (5) — same byte layout either way."""
     names, uks, algo, beh, hits, limit, duration = cols
     n = len(names)
     parts = [
         FRAME_MAGIC,
-        struct.pack("<BBI", FRAME_VERSION, _FRAME_KIND_REQ, n),
+        struct.pack("<BBI", FRAME_VERSION, kind, n),
         _pack_str_column(names),
         _pack_str_column(uks),
         np.ascontiguousarray(algo, dtype=np.int32).tobytes(),
@@ -381,10 +391,10 @@ class FrameIngressColumns:
 
     __slots__ = ("algorithm", "behavior", "hits", "limit", "duration",
                  "_n", "_nb", "_no", "_ub", "_uo", "_names", "_uks",
-                 "trace_ctx")
+                 "trace_ctx", "_err", "_packed")
 
     def __init__(self, n, nb, no, ub, uo, algo, beh, hits, limit, duration,
-                 trace_ctx=None):
+                 trace_ctx=None, err=None, packed=None):
         self._n = n
         self._nb, self._no = nb, no
         self._ub, self._uo = ub, uo
@@ -398,16 +408,27 @@ class FrameIngressColumns:
         # Wire trace-context column (lane ranges -> trace/span ids);
         # consumed by tracing.request_links on the owner's dispatch.
         self.trace_ctx = trace_ctx
+        # Public-ingress validation codes (1 = empty unique_key, 2 =
+        # empty name; the LazyIngressColumns convention).  None on the
+        # peer hop — forwarded lanes were validated at the sender's
+        # ingress, so the error column is all-zero by contract.
+        self._err = err
+        # Pre-built packed hash keys (the native gt_frame_parse hands
+        # them over ready); None = build with the numpy scatter.
+        self._packed = packed
 
     def __len__(self) -> int:
         return self._n
 
     @property
     def prevalidated(self):
-        return (
-            _packed_hash_keys(self._nb, self._no, self._ub, self._uo),
-            np.zeros(self._n, dtype=np.uint8),
-        )
+        packed = self._packed
+        if packed is None:
+            packed = _packed_hash_keys(self._nb, self._no, self._ub, self._uo)
+        err = self._err
+        if err is None:
+            err = np.zeros(self._n, dtype=np.uint8)
+        return packed, err
 
     def _name_at(self, i: int) -> str:
         return self._nb[self._no[i]:self._no[i + 1]].decode("utf-8")
@@ -439,20 +460,18 @@ class FrameIngressColumns:
         )
 
 
-def decode_columns_frame(raw: bytes):
-    """Binary request frame -> ingress columns (the receiver half of
-    the zero-dataclass peer hop).  With the native runtime present the
-    result is a lazy FrameIngressColumns (packed hash keys for the
-    planner, no per-lane strings); otherwise an eager
-    service.IngressColumns.  Raises ValueError on a malformed/foreign
-    frame."""
+def _decode_req_frame(raw: bytes, want_kind: int, validate: bool):
+    """Shared body of the two request-frame decoders.  `validate` is
+    the public-ingress mode: compute per-lane empty-name/unique_key
+    codes (untrusted client) and range-check the algorithm column; the
+    peer hop skips both (sender-side ingress already validated)."""
     from . import native
     from .service import IngressColumns
 
     if not is_columns_frame(raw):
         raise ValueError("not a columns frame")
     version, kind, n = struct.unpack_from("<BBI", raw, 4)
-    if version != FRAME_VERSION or kind != _FRAME_KIND_REQ:
+    if version != FRAME_VERSION or kind != want_kind:
         raise ValueError(
             f"unsupported columns frame (version={version}, kind={kind})"
         )
@@ -471,10 +490,27 @@ def decode_columns_frame(raw: bytes):
         trace_ctx, pos = unpack_trace_entries(raw, pos)
         if pos != len(raw):
             raise ValueError("columns frame length mismatch")
+    if validate and n and bool(np.any((algo < 0) | (algo > 1))):
+        # An out-of-range algorithm would reach the kernel as a
+        # garbage branch selector; reject the frame at the decode
+        # edge (the gateway maps it to a 400) — the client library
+        # only ever emits 0/1.
+        raise ValueError("ingress frame algorithm out of range")
+    if validate:
+        _check_utf8_blobs(nb, ub)
     if native.available():
+        err = None
+        if validate and n:
+            # Per-lane validation codes, consumed via `prevalidated`.
+            # Only worth computing on THIS branch: the eager
+            # IngressColumns below has no err channel — the service
+            # re-validates those lane-wise anyway.
+            err = np.zeros(n, dtype=np.uint8)
+            err[np.diff(no.astype(np.int64)) == 0] = 2  # empty name
+            err[np.diff(uo.astype(np.int64)) == 0] = 1  # empty unique_key
         return FrameIngressColumns(
             n, nb, no, ub, uo, algo, beh, hits, limit, duration,
-            trace_ctx=trace_ctx,
+            trace_ctx=trace_ctx, err=err,
         )
     return IngressColumns(
         names=[nb[no[i]:no[i + 1]].decode("utf-8") for i in range(n)],
@@ -485,43 +521,199 @@ def decode_columns_frame(raw: bytes):
     )
 
 
-def encode_result_frame(result) -> bytes:
-    """service.ColumnarResult -> binary response frame.  Plain lanes
-    ride the arrays; overrides (error/metadata lanes) ride as sparse
-    (lane, json) pairs — the only per-lane encode work."""
+def _check_utf8_blobs(nb: bytes, ub: bytes) -> None:
+    """Public-edge string validation: the lazy decode paths defer
+    per-lane .decode('utf-8') into the service's slow legs, where
+    invalid bytes from an untrusted client would surface as a 500 deep
+    in routing (failing every coalesced rider) instead of a 400 here —
+    and would make the native and fallback builds answer the same
+    frame differently.  One whole-blob decode per column; trusted peer
+    frames skip this (their strings were validated at the sender's
+    ingress)."""
+    try:
+        nb.decode("utf-8")
+        ub.decode("utf-8")
+    except UnicodeDecodeError:
+        raise ValueError(
+            "columns frame strings are not valid utf-8"
+        ) from None
+
+
+def decode_columns_frame(raw: bytes):
+    """Binary request frame -> ingress columns (the receiver half of
+    the zero-dataclass peer hop).  With the native runtime present the
+    result is a lazy FrameIngressColumns (packed hash keys for the
+    planner, no per-lane strings); otherwise an eager
+    service.IngressColumns.  Raises ValueError on a malformed/foreign
+    frame."""
+    return _decode_req_frame(raw, _FRAME_KIND_REQ, validate=False)
+
+
+# ---- public columnar ingress (the front door) ------------------------
+#
+# The PR 2 playbook applied to the CLIENT->daemon hop (architecture.md
+# "Columnar pipeline: the front door"): a GUBC frame (kind 5, same
+# column layout as the peer hop) magic-sniffed on the existing
+# POST /v1/GetRateLimits path, or proto columns served as
+# V1/GetRateLimitsColumns on the gRPC transport.  The response is a
+# kind-6 frame / IngressColumnsResp: the kind-2 layout plus the owner
+# annotation (owner_of i32[n] + owner address column) so forwarded
+# lanes keep their metadata.owner without a per-lane JSON override.
+# A daemon with GUBER_INGRESS_COLUMNS=0 never sniffs: the frame falls
+# into json.loads and answers 400 exactly like a pre-columns build —
+# that IS the client's version probe (sticky classic fallback).
+
+def is_ingress_frame(raw: bytes) -> bool:
+    return is_columns_frame(raw) and raw[5] == _FRAME_KIND_INGRESS_REQ
+
+
+def encode_ingress_frame(
+    cols: PeerColumns, trace: "Optional[Sequence[TraceEntry]]" = None
+) -> bytes:
+    """PeerColumns -> public ingress request frame (kind 5; byte layout
+    of the kind-1 peer frame, trace trailer rules included)."""
+    return encode_columns_frame(cols, trace=trace, kind=_FRAME_KIND_INGRESS_REQ)
+
+
+def decode_ingress_frame(raw: bytes):
+    """Public ingress frame -> ingress columns.  Unlike the peer hop
+    the sender is UNTRUSTED: empty-name/unique_key lanes get per-lane
+    validation codes (the service answers them per lane, JSON parity)
+    and an out-of-range algorithm rejects the frame.  Tries the native
+    single-pass parser first (gt_frame_parse: validation, column
+    slicing and the packed hash-key scatter all before Python-level
+    work); falls back to the numpy decode."""
+    from . import native
+
+    cols = native.parse_ingress_frame(raw)
+    if cols is not None:
+        return cols
+    return _decode_req_frame(raw, _FRAME_KIND_INGRESS_REQ, validate=True)
+
+
+def is_ingress_result_frame(raw: bytes) -> bool:
+    return is_columns_frame(raw) and raw[5] == _FRAME_KIND_INGRESS_RESP
+
+
+def encode_ingress_result_frame(result) -> bytes:
+    """service.ColumnarResult -> public ingress response frame (kind
+    6): the kind-2 arrays + `u32 n_owner_addrs [str column owner_addrs
+    | i32 owner_of[n]]` + the sparse override pairs.  Owner columns are
+    written only when the batch had forwarded lanes (n_owner_addrs=0
+    otherwise), so a purely-local batch costs 4 extra bytes."""
+    owner_addrs = result.owner_addrs if result.owner_of is not None else []
     parts = [
         FRAME_MAGIC,
-        struct.pack("<BBI", FRAME_VERSION, _FRAME_KIND_RESP, result.n),
-        np.ascontiguousarray(result.status, dtype=np.int32).tobytes(),
-        np.ascontiguousarray(result.limit, dtype=np.int64).tobytes(),
-        np.ascontiguousarray(result.remaining, dtype=np.int64).tobytes(),
-        np.ascontiguousarray(result.reset_time, dtype=np.int64).tobytes(),
-        struct.pack("<I", len(result.overrides)),
+        struct.pack("<BBI", FRAME_VERSION, _FRAME_KIND_INGRESS_RESP, result.n),
+        *_result_array_parts(result),
+        struct.pack("<I", len(owner_addrs)),
     ]
-    for lane, resp in result.overrides.items():
-        body = json.dumps(resp.to_json(), separators=(",", ":")).encode("utf-8")
-        parts.append(struct.pack("<II", int(lane), len(body)))
-        parts.append(body)
+    if owner_addrs:
+        parts.append(_pack_str_column(owner_addrs))
+        parts.append(
+            np.ascontiguousarray(result.owner_of, dtype=np.int32).tobytes()
+        )
+    _append_override_parts(parts, result.overrides)
     return b"".join(parts)
 
 
-def decode_result_frame(raw: bytes):
-    """Binary response frame -> service.ColumnarResult (client side:
-    the sender scatters these arrays into its own result arrays)."""
+def decode_ingress_result_frame(raw: bytes):
+    """Public ingress response frame -> service.ColumnarResult (client
+    side: response_at / the waiter scatter reads owner metadata off the
+    arrays, no per-lane dataclasses)."""
     from .service import ColumnarResult
 
     if not is_columns_frame(raw):
         raise ValueError("not a columns frame")
     version, kind, n = struct.unpack_from("<BBI", raw, 4)
-    if version != FRAME_VERSION or kind != _FRAME_KIND_RESP:
+    if version != FRAME_VERSION or kind != _FRAME_KIND_INGRESS_RESP:
         raise ValueError(
             f"unsupported columns frame (version={version}, kind={kind})"
         )
-    pos = 10
+    status, limit, remaining, reset_time, pos = _read_result_arrays(raw, 10, n)
+    owner_addrs: list = []
+    owner_of = None
+    try:
+        (n_addr,) = struct.unpack_from("<I", raw, pos)
+        pos += 4
+        if n_addr:
+            ao, ab, pos = _read_str_blob(raw, pos, n_addr)
+            owner_addrs = [
+                ab[ao[i]:ao[i + 1]].decode("utf-8") for i in range(n_addr)
+            ]
+            owner_of, pos = _read_array(raw, pos, np.int32, n)
+    except struct.error:
+        raise ValueError("columns frame truncated") from None
+    overrides, pos = _read_overrides(raw, pos)
+    if pos != len(raw):
+        raise ValueError("columns frame length mismatch")
+    return ColumnarResult(
+        n=n, status=status, limit=limit, remaining=remaining,
+        reset_time=reset_time, overrides=overrides,
+        owner_addrs=owner_addrs,
+        owner_of=None if owner_of is None else np.array(owner_of),
+    )
+
+
+def result_to_ingress_columns_pb(result) -> "pc_pb.IngressColumnsResp":
+    """ColumnarResult -> proto columns response for the public
+    V1/GetRateLimitsColumns RPC (kind-6 twin on the gRPC transport)."""
+    m = _fill_result_columns_pb(pc_pb.IngressColumnsResp(), result)
+    if result.owner_of is not None:
+        m.owner_of.extend(np.asarray(result.owner_of, dtype=np.int32).tolist())
+        m.owner_addrs.extend(result.owner_addrs)
+    return m
+
+
+def result_from_ingress_columns_pb(m) -> "object":
+    from .service import ColumnarResult
+
+    n = len(m.status)
+    owner_of = None
+    if len(m.owner_of):
+        owner_of = np.fromiter(m.owner_of, np.int32, count=len(m.owner_of))
+    return ColumnarResult(
+        n=n,
+        status=np.fromiter(m.status, np.int32, count=n),
+        limit=np.fromiter(m.limit, np.int64, count=n),
+        remaining=np.fromiter(m.remaining, np.int64, count=n),
+        reset_time=np.fromiter(m.reset_time, np.int64, count=n),
+        overrides={int(o.lane): resp_from_pb(o.resp) for o in m.overrides},
+        owner_addrs=list(m.owner_addrs),
+        owner_of=owner_of,
+    )
+
+
+def _result_array_parts(result) -> list:
+    """The four result arrays' wire bytes — the section kinds 2 and 6
+    share (one encoder: a layout change lands in both)."""
+    return [
+        np.ascontiguousarray(result.status, dtype=np.int32).tobytes(),
+        np.ascontiguousarray(result.limit, dtype=np.int64).tobytes(),
+        np.ascontiguousarray(result.remaining, dtype=np.int64).tobytes(),
+        np.ascontiguousarray(result.reset_time, dtype=np.int64).tobytes(),
+    ]
+
+
+def _append_override_parts(parts: list, overrides) -> None:
+    """Sparse (lane, json) override pairs — the trailer kinds 2 and 6
+    share; the only per-lane encode work on a result."""
+    parts.append(struct.pack("<I", len(overrides)))
+    for lane, resp in overrides.items():
+        body = json.dumps(resp.to_json(), separators=(",", ":")).encode("utf-8")
+        parts.append(struct.pack("<II", int(lane), len(body)))
+        parts.append(body)
+
+
+def _read_result_arrays(raw: bytes, pos: int, n: int):
     status, pos = _read_array(raw, pos, np.int32, n)
     limit, pos = _read_array(raw, pos, np.int64, n)
     remaining, pos = _read_array(raw, pos, np.int64, n)
     reset_time, pos = _read_array(raw, pos, np.int64, n)
+    return status, limit, remaining, reset_time, pos
+
+
+def _read_overrides(raw: bytes, pos: int):
     try:
         (n_ov,) = struct.unpack_from("<I", raw, pos)
         pos += 4
@@ -537,6 +729,36 @@ def decode_result_frame(raw: bytes):
             pos += blen
     except struct.error:
         raise ValueError("columns frame truncated") from None
+    return overrides, pos
+
+
+def encode_result_frame(result) -> bytes:
+    """service.ColumnarResult -> binary response frame.  Plain lanes
+    ride the arrays; overrides (error/metadata lanes) ride as sparse
+    (lane, json) pairs — the only per-lane encode work."""
+    parts = [
+        FRAME_MAGIC,
+        struct.pack("<BBI", FRAME_VERSION, _FRAME_KIND_RESP, result.n),
+        *_result_array_parts(result),
+    ]
+    _append_override_parts(parts, result.overrides)
+    return b"".join(parts)
+
+
+def decode_result_frame(raw: bytes):
+    """Binary response frame -> service.ColumnarResult (client side:
+    the sender scatters these arrays into its own result arrays)."""
+    from .service import ColumnarResult
+
+    if not is_columns_frame(raw):
+        raise ValueError("not a columns frame")
+    version, kind, n = struct.unpack_from("<BBI", raw, 4)
+    if version != FRAME_VERSION or kind != _FRAME_KIND_RESP:
+        raise ValueError(
+            f"unsupported columns frame (version={version}, kind={kind})"
+        )
+    status, limit, remaining, reset_time, pos = _read_result_arrays(raw, 10, n)
+    overrides, pos = _read_overrides(raw, pos)
     if pos != len(raw):
         raise ValueError("columns frame length mismatch")
     return ColumnarResult(
@@ -591,8 +813,9 @@ def ingress_from_peer_columns_pb(m: pc_pb.PeerColumnsReq):
     )
 
 
-def result_to_peer_columns_pb(result) -> pc_pb.PeerColumnsResp:
-    m = pc_pb.PeerColumnsResp()
+def _fill_result_columns_pb(m, result):
+    """Shared column fill for PeerColumnsResp / IngressColumnsResp
+    (same field numbers 1-5; the ingress twin adds owners on top)."""
     m.status.extend(np.asarray(result.status, dtype=np.int32).tolist())
     m.limit.extend(np.asarray(result.limit, dtype=np.int64).tolist())
     m.remaining.extend(np.asarray(result.remaining, dtype=np.int64).tolist())
@@ -602,6 +825,10 @@ def result_to_peer_columns_pb(result) -> pc_pb.PeerColumnsResp:
         ov.lane = int(lane)
         ov.resp.CopyFrom(resp_to_pb(resp))
     return m
+
+
+def result_to_peer_columns_pb(result) -> pc_pb.PeerColumnsResp:
+    return _fill_result_columns_pb(pc_pb.PeerColumnsResp(), result)
 
 
 def result_from_peer_columns_pb(m: pc_pb.PeerColumnsResp):
@@ -706,12 +933,14 @@ def peer_columns_to_classic_json(cols: PeerColumns) -> dict:
     }
 
 
-def result_from_classic_peer_json(body: dict):
-    """Classic {"rateLimits": [...]} JSON response -> ColumnarResult."""
+def _result_from_classic_items(items: list):
+    """Classic per-response JSON objects -> ColumnarResult: plain lanes
+    fill the arrays, error/metadata lanes become overrides.  Shared by
+    the peer ("rateLimits") and public-ingress ("responses") envelopes
+    so the two decoders cannot drift."""
     from .service import ColumnarResult
     from .types import Status, _parse_enum
 
-    items = body.get("rateLimits", [])
     n = len(items)
     result = ColumnarResult.empty(n)
     for i, d in enumerate(items):
@@ -725,6 +954,18 @@ def result_from_classic_peer_json(body: dict):
                 d.get("resetTime", d.get("reset_time", 0))
             )
     return result
+
+
+def result_from_classic_peer_json(body: dict):
+    """Classic {"rateLimits": [...]} JSON response -> ColumnarResult."""
+    return _result_from_classic_items(body.get("rateLimits", []))
+
+
+def result_from_classic_ingress_json(body: dict):
+    """Classic {"responses": [...]} JSON (the public /v1/GetRateLimits
+    shape) -> ColumnarResult — the columns client's downgraded-receive
+    twin of result_from_classic_peer_json."""
+    return _result_from_classic_items(body.get("responses", []))
 
 
 # ---- GLOBAL broadcast ------------------------------------------------

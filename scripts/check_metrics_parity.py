@@ -77,6 +77,8 @@ EXTENSIONS = frozenset(
         "gubernator_slo_requests",
         "gubernator_hotkey_lanes",
         "gubernator_hotkey_topk",
+        # PR 8: public columnar ingress (the front door)
+        "gubernator_ingress_columns_batches",
         # PR 7: elastic membership / live resharding (reshard.py)
         "gubernator_reshard_transfers",
         "gubernator_reshard_lanes",

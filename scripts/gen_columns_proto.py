@@ -77,6 +77,23 @@ def build() -> bytes:
     _field(resp, "overrides", 5, F.TYPE_MESSAGE,
            type_name=".pb.gubernator.PeerLaneOverride")
 
+    # Public columnar ingress response (V1/GetRateLimitsColumns): the
+    # PeerColumnsResp layout plus the owner annotation — forwarded
+    # lanes carry owner_of (index into owner_addrs, -1 = local) so the
+    # client rebuilds metadata.owner without per-lane overrides.  The
+    # REQUEST reuses PeerColumnsReq verbatim (same seven columns + the
+    # sparse trace column; one codec, one golden).
+    ir = fd.message_type.add()
+    ir.name = "IngressColumnsResp"
+    _field(ir, "status", 1, F.TYPE_INT32)
+    _field(ir, "limit", 2, F.TYPE_INT64)
+    _field(ir, "remaining", 3, F.TYPE_INT64)
+    _field(ir, "reset_time", 4, F.TYPE_INT64)
+    _field(ir, "overrides", 5, F.TYPE_MESSAGE,
+           type_name=".pb.gubernator.PeerLaneOverride")
+    _field(ir, "owner_of", 6, F.TYPE_INT32)
+    _field(ir, "owner_addrs", 7, F.TYPE_STRING)
+
     # Column form of UpdatePeerGlobalsReq (the GLOBAL broadcast): lane i
     # of every column is one key's authoritative status.  Served as
     # PeersV1/UpdatePeerGlobalsColumns; the response reuses

@@ -281,6 +281,130 @@ def test_peer_columns_resp_golden():
     )
 
 
+def test_ingress_frame_golden():
+    """The GUBC public ingress frame (kind 5) byte layout is a wire
+    contract: identical to the kind-1 peer frame except the kind byte —
+    header | name column | unique_key column | algo i32 | behavior i32
+    | hits i64 | limit i64 | duration i64, all little-endian.  With
+    GUBER_TRACE_SAMPLE=0 (no trailer) the bytes must stay exactly
+    this."""
+    import numpy as np
+
+    from gubernator_tpu import wire
+
+    cols = (
+        ["a", "bc"], ["x", "yz"],
+        np.array([1, 0], np.int32), np.array([0, 2], np.int32),
+        np.array([1, 2], np.int64), np.array([5, 6], np.int64),
+        np.array([1000, 2000], np.int64),
+    )
+    raw = wire.encode_ingress_frame(cols)
+    i32 = lambda v: int(v).to_bytes(4, "little")  # noqa: E731
+    i64 = lambda v: int(v).to_bytes(8, "little")  # noqa: E731
+    expected = (
+        b"GUBC" + bytes([1, 5]) + i32(2)          # magic, ver, kind, n
+        + i32(3) + i32(0) + i32(1) + i32(3) + b"abc"  # name column
+        + i32(3) + i32(0) + i32(1) + i32(3) + b"xyz"  # unique_key column
+        + i32(1) + i32(0)                         # algorithm
+        + i32(0) + i32(2)                         # behavior
+        + i64(1) + i64(2)                         # hits
+        + i64(5) + i64(6)                         # limit
+        + i64(1000) + i64(2000)                   # duration
+    )
+    assert raw == expected
+    assert wire.is_ingress_frame(raw)
+    assert wire.is_columns_frame(raw)  # still GUBC magic
+    assert not wire.is_transfer_frame(raw)
+    # Same columns on the peer hop differ ONLY in the kind byte.
+    peer = wire.encode_columns_frame(cols)
+    assert peer[:5] == raw[:5] and peer[6:] == raw[6:]
+    assert peer[5] == 1 and raw[5] == 5
+    back = wire.decode_ingress_frame(raw)
+    assert list(back.names) == ["a", "bc"]
+    assert list(back.unique_keys) == ["x", "yz"]
+    assert list(back.duration) == [1000, 2000]
+    # Trace trailer: appended GTRC block, byte-exact; absent = the
+    # sample-0 identity above (the PR 4 wire-parity contract).
+    entry = (0, 2, 0x0102030405060708090A0B0C0D0E0F10, 0x1112131415161718)
+    traced = wire.encode_ingress_frame(cols, trace=[entry])
+    assert traced == raw + (
+        b"GTRC" + i32(1) + i32(0) + i32(2)
+        + bytes(range(1, 17)) + bytes(range(0x11, 0x19))
+    )
+    assert wire.decode_ingress_frame(traced).trace_ctx == [entry]
+
+
+def test_ingress_result_frame_golden():
+    """The GUBC public ingress response frame (kind 6): the kind-2
+    arrays + `u32 n_owner_addrs [owner column | owner_of i32[n]]` +
+    sparse override pairs."""
+    import numpy as np
+
+    from gubernator_tpu import wire
+    from gubernator_tpu.service import ColumnarResult
+
+    r = ColumnarResult.empty(2)
+    r.status[:] = [0, 1]
+    r.limit[:] = [10, 20]
+    r.remaining[:] = [9, 0]
+    r.reset_time[:] = [1000, 2000]
+    r.set_owner(np.array([1]), "h:1")
+    raw = wire.encode_ingress_result_frame(r)
+    i32 = lambda v: int(v).to_bytes(4, "little", signed=True)  # noqa: E731
+    i64 = lambda v: int(v).to_bytes(8, "little")  # noqa: E731
+    expected = (
+        b"GUBC" + bytes([1, 6]) + i32(2)          # magic, ver, kind, n
+        + i32(0) + i32(1)                         # status
+        + i64(10) + i64(20)                       # limit
+        + i64(9) + i64(0)                         # remaining
+        + i64(1000) + i64(2000)                   # reset_time
+        + i32(1)                                  # n_owner_addrs
+        + i32(3) + i32(0) + i32(3) + b"h:1"       # owner addr column
+        + i32(-1) + i32(0)                        # owner_of
+        + i32(0)                                  # n_overrides
+    )
+    assert raw == expected
+    assert wire.is_ingress_result_frame(raw)
+    back = wire.decode_ingress_result_frame(raw)
+    assert back.owner_addrs == ["h:1"]
+    assert back.response_at(1).metadata == {"owner": "h:1"}
+    assert back.response_at(0).metadata == {}
+    # No forwarded lanes: the owner section is a single zero count.
+    r2 = ColumnarResult.empty(1)
+    raw2 = wire.encode_ingress_result_frame(r2)
+    assert raw2.endswith(i32(0) + i32(0))  # n_owner_addrs=0, n_overrides=0
+    assert wire.decode_ingress_result_frame(raw2).owner_of is None
+
+
+def test_ingress_columns_resp_pb_golden():
+    """peers_columns.proto IngressColumnsResp (the gRPC front door):
+    field numbers pinned so the protoc-less descriptor stays
+    wire-identical to the schema.  The request message is
+    PeerColumnsReq verbatim (pinned by test_peer_columns_req_golden)."""
+    m = pc_pb.IngressColumnsResp(
+        status=[1], limit=[10], remaining=[9], reset_time=[1000],
+        owner_of=[-1], owner_addrs=["h"],
+    )
+    ov = m.overrides.add()
+    ov.lane = 0
+    ov.resp.CopyFrom(pb.RateLimitResp(error="x"))
+    assert m.SerializeToString() == bytes(
+        [
+            0x0A, 0x01, 0x01,        # 1: status, packed
+            0x12, 0x01, 0x0A,        # 2: limit, packed
+            0x1A, 0x01, 0x09,        # 3: remaining, packed
+            0x22, 0x02, 0xE8, 0x07,  # 4: reset_time = 1000, packed
+            # 5: overrides[0] {resp: {error: "x"}}
+            0x2A, 0x05,
+            0x12, 0x03, 0x2A, 0x01, ord("x"),
+            # 6: owner_of = [-1], packed (10-byte varint)
+            0x32, 0x0A,
+            0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0x01,
+            0x3A, 0x01, ord("h"),    # 7: owner_addrs[0]
+        ]
+    )
+
+
 def test_health_check_resp_golden():
     m = pb.HealthCheckResp(status="healthy", peer_count=3)
     assert m.SerializeToString() == bytes(
