@@ -1,5 +1,5 @@
 # Reference Makefile:1-35 equivalents for the TPU build.
-.PHONY: test tier1 chaos bench bench-gate soak-smoke proto certs docker release clean
+.PHONY: test tier1 chaos bench bench-gate soak soak-smoke proto certs docker release clean
 
 # The whole suite on the virtual 8-device CPU mesh (conftest.py forces
 # it); -p no:cacheprovider keeps runs hermetic like -count=1.
@@ -48,6 +48,15 @@ bench-full:
 soak-smoke:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_soak_smoke.py -q \
 		-m slow -p no:cacheprovider
+
+# The full cluster soak (ROADMAP item 5's harness): 4 in-process
+# daemons under seeded Zipf + burst-replay traffic with FaultPlan
+# partitions and membership churn for minutes, trace-sampled, with the
+# CONSERVATION AUDIT (audit.py) as the pass/fail gate — exits nonzero
+# on any invariant violation (double-commit, lost hits, carry past the
+# documented GLOBAL slack, negative remaining).
+soak:
+	env JAX_PLATFORMS=cpu python scripts/soak.py --minutes 3
 
 proto:
 	bash scripts/proto.sh

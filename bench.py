@@ -458,16 +458,33 @@ def measure_service_ingress(n_threads: int = 32, svc_iters: int = 10,
     that the host cost is the measured ceiling (the reference benches
     100-way, benchmark_test.go:117).  Shared by main() and the --gate
     fallback so the ingress threshold is evaluable standalone.
-    Returns (checks_per_sec, p50_ms, p99_ms, n_samples) — the sample
-    count rides along so gate verdicts can discount thin tails."""
+    Returns (checks_per_sec, p50_ms, p99_ms, n_samples,
+    steady_recompiles) — the sample count rides along so gate verdicts
+    can discount thin tails, and steady_recompiles is the XLA-telemetry
+    count of backend compiles DURING the measured epoch (after the
+    warmup ladder + warm epoch marked the plane steady): shape churn in
+    steady state, gated at == 0 so a recompile silently taxing the
+    headline row fails `make bench-gate` instead of reading as
+    mysterious latency."""
     import threading
 
+    from gubernator_tpu import telemetry
     from gubernator_tpu.service import IngressColumns, ServiceConfig, V1Service
     from gubernator_tpu.types import PeerInfo
 
     svc = V1Service(ServiceConfig(cache_size=131_072))
     svc.set_peers([PeerInfo(grpc_address="127.0.0.1:1", is_owner=True)])
     svc_batch = 1000
+    # Pad-ladder warmup: coalesced flush sizes land in pow2 pad buckets
+    # that vary with thread timing; compile the whole reachable ladder
+    # up front (what a production daemon's GUBER_WARMUP_SHAPES does) so
+    # the measured epoch's steady_recompiles==0 gate judges shape
+    # CHURN, not warmup coverage luck.
+    telemetry.begin_warmup()
+    svc.store.warmup(
+        1_700_000_000_000,
+        warm_shapes=[1000, 2000, 4000, 8000, 16000, 32000, 64000],
+    )
 
     def svc_cols(tid, i):
         # RandomState is not thread-safe: derive ids deterministically.
@@ -508,16 +525,25 @@ def measure_service_ingress(n_threads: int = 32, svc_iters: int = 10,
     # device (a long-running daemon warms these at startup,
     # GUBER_WARMUP_SHAPES); measure steady state.
     svc_epoch()
+    telemetry.mark_steady()
+    compiles_before = telemetry.compile_count()
     svc_lat.clear()
     t0 = time.perf_counter()
     svc_epoch()
     svc_dt = time.perf_counter() - t0
+    # None, not 0, when compiles are unobservable (plane disabled or
+    # the jax.monitoring listener failed to register): a 0 from a blind
+    # counter would pass the ==0 gate vacuously — the caller must SKIP.
+    steady_recompiles = (
+        telemetry.compile_count() - compiles_before
+        if telemetry.listener_active() else None
+    )
     service_cps = svc_batch * svc_iters * n_threads / svc_dt
     svc_lat.sort()
     svc_p50 = percentile(svc_lat, 0.50) * 1000.0
     svc_p99 = percentile(svc_lat, 0.99) * 1000.0
     svc.close()
-    return service_cps, svc_p50, svc_p99, len(svc_lat)
+    return service_cps, svc_p50, svc_p99, len(svc_lat), steady_recompiles
 
 
 def measure_tracing_overhead(n_threads: int = 8, iters: int = 4):
@@ -534,15 +560,37 @@ def measure_tracing_overhead(n_threads: int = 8, iters: int = 4):
     prev_rate = tracing.sample_rate()
     tracing.force_disable(True)
     try:
-        off_cps, _, _, _ = measure_service_ingress(n_threads, iters)
+        off_cps, _, _, _, _ = measure_service_ingress(n_threads, iters)
     finally:
         tracing.force_disable(False)
     tracing.set_sample_rate(0.0)
     try:
-        s0_cps, _, _, _ = measure_service_ingress(n_threads, iters)
+        s0_cps, _, _, _, _ = measure_service_ingress(n_threads, iters)
     finally:
         tracing.set_sample_rate(prev_rate)
     return s0_cps / max(off_cps, 1.0), off_cps, s0_cps
+
+
+def measure_xla_telemetry_overhead(n_threads: int = 8, iters: int = 4):
+    """Same-run XLA-telemetry overhead (the PR 4 playbook applied to
+    telemetry.py): headline ingress checks/s with GUBER_XLA_TELEMETRY
+    on (the shipped default — the launch hook is one branch plus a
+    per-BATCH label scope) over the same path with the plane disabled,
+    back-to-back in THIS process so host weather cancels.  Gated at
+    floor 0.95.  Returns (ratio, off_cps, on_cps)."""
+    from gubernator_tpu import telemetry
+
+    prev = telemetry.enabled()
+    try:
+        telemetry.set_enabled(False)
+        off_cps, _, _, _, _ = measure_service_ingress(n_threads, iters)
+        telemetry.set_enabled(True)
+        on_cps, _, _, _, _ = measure_service_ingress(n_threads, iters)
+    finally:
+        # One restore covering BOTH legs: an off-leg failure must not
+        # leave the process force-enabled contrary to its environment.
+        telemetry.set_enabled(prev)
+    return on_cps / max(off_cps, 1.0), off_cps, on_cps
 
 
 def _free_port() -> int:
@@ -1057,12 +1105,19 @@ def gate() -> int:
             # Daemon-spawning rows measure separately-guarded: host
             # weather (a corrupt compile cache, OOM) must cost a SKIP,
             # not the whole verdict.
-            ingress_cps, p50, p99, n_lat = measure_service_ingress()
+            ingress_cps, p50, p99, n_lat, steady_rc = measure_service_ingress()
             rows["service_ingress_checks_per_sec"] = ingress_cps
             rows["service_ingress_latency_ms_p50"] = p50
             rows["service_ingress_latency_ms_p99"] = p99
             rows["service_ingress_latency_ms_p50_n_samples"] = n_lat
             rows["service_ingress_latency_ms_p99_n_samples"] = n_lat
+            if steady_rc is not None:
+                rows["steady_state_recompiles"] = steady_rc
+            else:  # absent row -> the gate prints its no-measurement SKIP
+                print(
+                    "gate steady_state_recompiles: SKIP "
+                    "(xla telemetry disabled or listener absent)"
+                )
         except Exception as e:  # noqa: BLE001
             print(f"gate service_ingress_checks_per_sec: SKIP (measure failed: {e})")
         try:
@@ -1120,6 +1175,16 @@ def gate() -> int:
         )
     except Exception as e:  # noqa: BLE001 — service spawn can fail
         print(f"gate tracing_overhead_ratio: SKIP (measure failed: {e})")
+    # Same rule for the XLA-telemetry overhead ratio (telemetry.py).
+    try:
+        ratio, off_cps, on_cps = measure_xla_telemetry_overhead()
+        rows["xla_telemetry_overhead_ratio"] = ratio
+        print(
+            f"gate xla telemetry rows: off {off_cps:.0f} checks/s, "
+            f"on {on_cps:.0f} checks/s"
+        )
+    except Exception as e:  # noqa: BLE001 — service spawn can fail
+        print(f"gate xla_telemetry_overhead_ratio: SKIP (measure failed: {e})")
     failed = []
     for name, spec in thresholds.items():
         if name.startswith("_"):
@@ -1280,13 +1345,36 @@ def main():
     _save_device_rows(dev, {"dispatch_overlap_ratio": dispatch_overlap_ratio})
     zipf = measure_device_zipf(jax, now)
 
+    # Per-leg XLA compile accounting (telemetry.py): compiles in THIS
+    # process attributed to each measurement leg — subprocess-daemon
+    # legs compile in their own processes and report 0 here.
+    from gubernator_tpu import telemetry as _telemetry
+
+    xla_compiles_per_leg = {}
+    # Baseline 0, not compile_count(): the headline/device legs above
+    # already ran, and their compiles (everything since process start)
+    # belong to the first row — a baseline captured HERE would always
+    # read that row as 0.
+    _leg_cc = [0]
+
+    def _leg(name):
+        cur = _telemetry.compile_count()
+        xla_compiles_per_leg[name] = cur - _leg_cc[0]
+        _leg_cc[0] = cur
+
+    _leg("headline_and_device")
+
     # ---- service-tier columnar ingress -------------------------------
-    service_cps, svc_p50, svc_p99, svc_lat_n = measure_service_ingress()
+    service_cps, svc_p50, svc_p99, svc_lat_n, steady_recompiles = (
+        measure_service_ingress()
+    )
+    _leg("service_ingress")
 
     # ---- public ingress: columnar front door vs classic JSON ---------
     ingress_columns_cps = measure_ingress_columns("columns")
     ingress_json_cps = measure_ingress_columns("json")
     ingress_columns_ratio = ingress_columns_cps / max(ingress_json_cps, 1.0)
+    _leg("ingress_columns")
 
     # ---- peer hop: loopback two-daemon forward (CPU-pinned) ----------
     peer_forward_cps = measure_peer_forward("columns")
@@ -1298,6 +1386,7 @@ def main():
     global_plane_ratio = global_plane["plane_items_per_sec"] / max(
         global_plane_classic["plane_items_per_sec"], 1.0
     )
+    _leg("peer_and_global_plane")
 
     # Re-save with the ingress + peer-forward rows so --gate covers
     # end-to-end service-path regressions, not just the device kernel
@@ -1316,6 +1405,11 @@ def main():
         "ingress_columns_vs_json": ingress_columns_ratio,
         "global_plane_vs_classic": global_plane_ratio,
         "dispatch_overlap_ratio": dispatch_overlap_ratio,
+        # None (unobservable: telemetry off / listener absent) is kept
+        # out of the saved rows so --gate SKIPs instead of passing a
+        # blind 0 through the ==0 ceiling.
+        **({"steady_state_recompiles": steady_recompiles}
+           if steady_recompiles is not None else {}),
     })
 
     # ---- secondary: request-object path ------------------------------
@@ -1356,6 +1450,12 @@ def main():
                 "service_ingress_latency_ms_p99": round(svc_p99, 2),
                 "service_ingress_latency_n_samples": svc_lat_n,
                 "service_ingress_includes_tunnel_rtt": True,
+                # XLA telemetry rows (telemetry.py): compiles during the
+                # measured ingress epoch (0 = no shape churn in steady
+                # state, the ceiling `make bench-gate` enforces) and the
+                # per-leg compile counts of this process.
+                "steady_state_recompiles": steady_recompiles,
+                "xla_compiles_per_leg": xla_compiles_per_leg,
                 "ingress_columns_checks_per_sec": round(
                     ingress_columns_cps, 1
                 ),

@@ -153,6 +153,34 @@ class BehaviorConfig:
     # Env: GUBER_SLO_OBJECTIVE.
     slo_objective: float = 0.99
 
+    # -- XLA / device telemetry (telemetry.py) -------------------------
+    # Compile tracking + recompile-storm detection + per-program launch
+    # timings + device memory sampling, exported as gubernator_xla_* /
+    # gubernator_device_* and GET /debug/device.  False disables the
+    # plane entirely: the launch-site hook degrades to one branch
+    # returning a shared no-op (the bench gate pins the overhead ratio
+    # >= 0.95 either way).  Env: GUBER_XLA_TELEMETRY.
+    xla_telemetry: bool = True
+    # Recompile-storm trip: >= xla_storm steady-state compiles within
+    # xla_storm_window_s seconds fires the flight-recorder auto-dump.
+    # Env: GUBER_XLA_STORM / GUBER_XLA_STORM_WINDOW (window is a Go
+    # duration; a bare number means ms).
+    xla_storm: int = 3
+    xla_storm_window_s: float = 60.0
+
+    # -- conservation audit (audit.py) ---------------------------------
+    # Always-on windowed reconciliation of the exactly-once ledgers
+    # (hits admitted vs dispatched vs applied vs forwarded, GLOBAL
+    # carry slack, reshard lane conservation), publishing
+    # gubernator_audit_violations_total{invariant} and auto-dumping the
+    # flight recorder on any violation.  False stops the checker
+    # thread; the ledger counters themselves are always recorded (one
+    # int add per batch).  Env: GUBER_AUDIT.
+    audit: bool = True
+    # Reconciliation cadence in seconds.  Env: GUBER_AUDIT_INTERVAL
+    # (a Go duration string; a bare number means ms).
+    audit_interval_s: float = 5.0
+
     # -- elastic membership / live resharding (reshard.py) -------------
     # On a ring delta, drain moved device-resident counters off the old
     # owner and ship them to the new owner as a columnar transfer
@@ -485,6 +513,21 @@ def setup_daemon_config(
     b.global_send_retries = _env_int(
         merged, "GUBER_GLOBAL_SEND_RETRIES", b.global_send_retries
     )
+    b.xla_telemetry = _env_bool(merged, "GUBER_XLA_TELEMETRY", b.xla_telemetry)
+    b.xla_storm = _env_int(merged, "GUBER_XLA_STORM", b.xla_storm)
+    if b.xla_storm < 1:
+        raise ValueError("GUBER_XLA_STORM must be >= 1")
+    b.xla_storm_window_s = _env_float_ms(
+        merged, "GUBER_XLA_STORM_WINDOW", b.xla_storm_window_s
+    )
+    if b.xla_storm_window_s <= 0:
+        raise ValueError("GUBER_XLA_STORM_WINDOW must be > 0")
+    b.audit = _env_bool(merged, "GUBER_AUDIT", b.audit)
+    b.audit_interval_s = _env_float_ms(
+        merged, "GUBER_AUDIT_INTERVAL", b.audit_interval_s
+    )
+    if b.audit_interval_s <= 0:
+        raise ValueError("GUBER_AUDIT_INTERVAL must be > 0")
     b.reshard = _env_bool(merged, "GUBER_RESHARD", b.reshard)
     b.reshard_handoff_s = _env_float_ms(
         merged, "GUBER_RESHARD_HANDOFF", b.reshard_handoff_s

@@ -51,9 +51,22 @@ class Daemon:
         # the module's import-time env default — unconditionally, so a
         # config that says 0 also DISABLES tracing a stale environment
         # variable turned on.
-        from . import tracing
+        from . import telemetry, tracing
 
         tracing.set_sample_rate(self.conf.behaviors.trace_sample)
+        # XLA telemetry is process-wide like tracing; the parsed
+        # GUBER_XLA_TELEMETRY wins over the module's import-time env
+        # default, in both directions.
+        telemetry.set_enabled(self.conf.behaviors.xla_telemetry)
+        telemetry.set_storm(
+            self.conf.behaviors.xla_storm,
+            self.conf.behaviors.xla_storm_window_s,
+        )
+        # Everything compiled from here to the end of startup warmup is
+        # warmup by definition; after mark_steady() below any further
+        # backend compile counts as a steady-state recompile (shape
+        # churn) and can trip the recompile-storm dump.
+        telemetry.begin_warmup()
         tls_conf = setup_tls(self.conf.tls)
         server_tls = tls_conf.server_ctx if tls_conf else None
         # Peer data plane credentials: gRPC channel creds unless the
@@ -85,6 +98,7 @@ class Daemon:
         self.service.store.warmup(
             self.clock.now_ms(), warm_shapes=self.conf.warmup_shapes
         )
+        telemetry.mark_steady()
         grpc_listen = self.conf.grpc_listen_address
         if not grpc_listen:
             host, _, _ = self.conf.listen_address.partition(":")

@@ -208,6 +208,15 @@ class Backoff:
 DROP = "drop"
 DELAY = "delay"
 ERROR = "error"
+# DUPLICATE: let the call proceed, then deliver it AGAIN — the network
+# or a misbehaving proxy re-delivering an RPC that already applied.
+# The peer hop's hit-carrying RPCs are increments, NOT idempotent, so a
+# duplicated delivery is a true double-commit on the wire: the seeded
+# fault the conservation audit (audit.py forward_conservation) must
+# catch.  PeerClient applies it by invoking the transport twice inside
+# one guarded call (breaker sees one call; the duplicate's own failure
+# is swallowed — a dropped duplicate is just a clean network again).
+DUPLICATE = "duplicate"
 
 # Known interception points (the `op` a rule matches against):
 #   GetPeerRateLimits / UpdatePeerGlobals  — PeerClient data-plane RPCs
@@ -330,6 +339,16 @@ class FaultPlan:
             FaultRule(peer=peer, op=op, kind=DELAY, delay_s=delay_s, rate=rate)
         )
 
+    def duplicate(self, peer: str = "*", op: str = "*", rate: float = 1.0,
+                  after: int = 0, count: Optional[int] = None) -> FaultRule:
+        """Deliver matching RPCs TWICE (byzantine-network chaos): the
+        seeded double-commit that must trip the conservation audit's
+        forward_conservation invariant on the sender."""
+        return self.add(
+            FaultRule(peer=peer, op=op, kind=DUPLICATE, rate=rate,
+                      after=after, count=count)
+        )
+
     def heal(self, peer: str = "*", op: str = "*") -> int:
         """Remove matching rules (the partition ends, the peer returns).
         Returns how many rules were removed.  Call counters are kept:
@@ -343,11 +362,15 @@ class FaultPlan:
             return before - len(self._rules)
 
     # -- interception ---------------------------------------------------
-    def intercept(self, peer: str, op: str) -> Optional[FaultAction]:
+    def intercept(self, peer: str, op: str,
+                  exclude: tuple = ()) -> Optional[FaultAction]:
         """Decide one call's fate.  Returns None (proceed) or a
         FaultAction.  The caller applies the action — sleeps for DELAY,
         raises for ERROR/DROP — so the plan itself never blocks while
-        holding its lock."""
+        holding its lock.  `exclude` skips rules of the named kinds
+        BEFORE they match (no fired_count / rate-draw consumption): a
+        caller that cannot honor a kind (gossip probes and DUPLICATE)
+        must not silently burn the rule's accounting."""
         with self._lock:
             key = (peer, op)
             n = self._calls.get(key, 0) + 1
@@ -360,6 +383,8 @@ class FaultPlan:
                     f"{self.seed}:{peer}:{op}" if self.seed is not None else None
                 )
             for rule in self._rules:
+                if rule.kind in exclude:
+                    continue
                 if not rule.matches(peer, op):
                     continue
                 if n <= rule.after:

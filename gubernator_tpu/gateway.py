@@ -11,6 +11,7 @@ TLS (including mTLS client auth) wraps the listener when configured
 
 from __future__ import annotations
 
+import itertools
 import json
 import socket
 import ssl
@@ -25,6 +26,7 @@ import numpy as np
 
 from . import native as _native
 from . import saturation
+from . import telemetry
 from . import tracing
 from . import wire
 from .config import (
@@ -244,6 +246,8 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
                     service.metrics.observe_cache(service.store)
                     service.metrics.observe_dispatch(service.store)
                     service.metrics.observe_saturation(service)
+                    service.metrics.observe_telemetry()
+                    service.metrics.observe_audit(service)
                     service.metrics.observe_peers(
                         service.get_peer_list()
                         + list(service.get_region_picker().peers())
@@ -271,6 +275,19 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
             if qpath == "/debug/hotkeys":
                 return 200, "application/json", _json_bytes(
                     service.hotkeys.snapshot()
+                )
+            if qpath == "/debug/device":
+                # XLA/device telemetry (telemetry.py): compile table,
+                # steady-state recompiles, per-program timings, device
+                # memory / live-buffer samples.
+                doc = telemetry.snapshot()
+                doc["devices"] = telemetry.device_snapshot()
+                return 200, "application/json", _json_bytes(doc)
+            if qpath == "/debug/audit":
+                # Conservation audit (audit.py): ledger deltas +
+                # invariant verdicts; the soak harness's pass/fail gate.
+                return 200, "application/json", _json_bytes(
+                    service.auditor.snapshot()
                 )
             return 404, "application/json", _json_bytes(
                 {"code": 5, "message": f"no handler for {path}"}
@@ -416,10 +433,16 @@ def _json_bytes(payload) -> bytes:
 
 
 def _debug_dump(path: str):
-    """GET /debug/traces[?trace_id=<32-hex>] and GET /debug/events:
-    dump the flight recorder (tracing.py).  The trace filter matches a
-    span's own trace id OR its links — the batch span-link rule, so a
-    lane's trace finds the coalesced window/stage spans it rode."""
+    """GET /debug/traces[?trace_id=<32-hex>][&since=<wall-ns>]
+    [&limit=<n>] and GET /debug/events: dump the flight recorder
+    (tracing.py).  The trace filter matches a span's own trace id OR
+    its links — the batch span-link rule, so a lane's trace finds the
+    coalesced window/stage spans it rode.  `since` filters on each
+    span's wall-clock end stamp (wall_ns) so a stitcher
+    (scripts/trace_collect.py) can poll incrementally instead of
+    re-reading the whole ring; `limit` keeps the OLDEST N after the
+    filter (pagination order — the poller's next `since` cursor picks
+    up exactly where this page ended)."""
     parts = urlsplit(path)
     if parts.path == "/debug/events":
         return 200, "application/json", _json_bytes(
@@ -427,15 +450,25 @@ def _debug_dump(path: str):
         )
     q = parse_qs(parts.query)
     trace_id = (q.get("trace_id") or [""])[0]
+
+    def _int_q(name: str) -> int:
+        try:
+            return max(int((q.get(name) or ["0"])[0]), 0)
+        except ValueError:
+            return 0
+
     return 200, "application/json", _json_bytes(
         {
             "sampleRate": tracing.sample_rate(),
-            "spans": tracing.spans_snapshot(trace_id),
+            "spans": tracing.spans_snapshot(
+                trace_id, since_ns=_int_q("since"), limit=_int_q("limit")
+            ),
         }
     )
 
 
-_profile_state = {"thread": None, "dirs": []}
+_profile_state = {"thread": None, "dirs": [], "run_id": "", "log_dir": ""}
+_profile_seq = itertools.count(1)
 _profile_lock = threading.Lock()
 # Retention cap on profile dumps this daemon created: a client looping
 # POST /debug/profile must not fill the temp filesystem of a long-lived
@@ -471,13 +504,25 @@ def _debug_profile(raw: bytes):
     with _profile_lock:
         t = _profile_state["thread"]
         if t is not None and t.is_alive():
+            # Concurrent-run guard: the second caller learns WHICH run
+            # holds the device (its id + artifact path) instead of just
+            # a refusal — two operators racing a profile can converge
+            # on the same artifact.
             return 409, "application/json", _json_bytes(
-                {"code": 10, "message": "a device profile is already running"}
+                {
+                    "code": 10,
+                    "message": "a device profile is already running",
+                    "runId": _profile_state["run_id"],
+                    "logDir": _profile_state["log_dir"],
+                }
             )
         import shutil
         import tempfile
 
         log_dir = tempfile.mkdtemp(prefix="gubernator-profile-")
+        run_id = f"profile-{next(_profile_seq)}"
+        _profile_state["run_id"] = run_id
+        _profile_state["log_dir"] = log_dir
         _profile_state["dirs"].append(log_dir)
         while len(_profile_state["dirs"]) > PROFILE_KEEP:
             shutil.rmtree(_profile_state["dirs"].pop(0), ignore_errors=True)
@@ -498,7 +543,7 @@ def _debug_profile(raw: bytes):
         _profile_state["thread"] = t
         t.start()
     return 202, "application/json", _json_bytes(
-        {"logDir": log_dir, "durationMs": duration_s * 1000.0}
+        {"runId": run_id, "logDir": log_dir, "durationMs": duration_s * 1000.0}
     )
 
 

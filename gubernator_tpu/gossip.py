@@ -339,7 +339,14 @@ class Gossip:
         timeout = timeout_s or self.probe_timeout_s
         fp = self.faults if self.faults is not None else faults_mod.active()
         if fp is not None:
-            act = fp.intercept(f"{addr[0]}:{addr[1]}", faults_mod.OP_GOSSIP_PROBE)
+            # DUPLICATE rules are aimed at hit-carrying data-plane RPCs
+            # (a duplicated ping is indistinguishable from a ping);
+            # excluded BEFORE matching so a probe can't burn the rule's
+            # fired_count/rate accounting.
+            act = fp.intercept(
+                f"{addr[0]}:{addr[1]}", faults_mod.OP_GOSSIP_PROBE,
+                exclude=(faults_mod.DUPLICATE,),
+            )
             if act is not None:
                 if act.kind != faults_mod.DELAY:
                     return False

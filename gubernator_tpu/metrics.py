@@ -23,7 +23,8 @@ from prometheus_client import (
     generate_latest,
 )
 
-from . import saturation, tracing
+from . import audit as audit_mod
+from . import saturation, telemetry, tracing
 
 try:  # OpenMetrics exposition carries trace exemplars; text 0.0.4 cannot
     from prometheus_client.openmetrics.exposition import (
@@ -344,6 +345,79 @@ class Metrics:
             "ring (bumped by every set_peers that changes membership).",
             registry=self.registry,
         )
+        # -- XLA / device telemetry plane (telemetry.py) ---------------
+        self.xla_compiles = Counter(
+            "gubernator_xla_compiles",
+            "XLA backend compiles since start, keyed by the program "
+            "identity the launching thread declared (solo/fused-K "
+            "dispatches, wide/narrow wires, mesh twins, the GLOBAL "
+            "sync collective; 'unlabeled' = a compile outside any "
+            "labeled launch site).",
+            ["program"],
+            registry=self.registry,
+        )
+        self.xla_compile_seconds = Counter(
+            "gubernator_xla_compile_seconds",
+            "Cumulative XLA backend compile wall seconds per program "
+            "identity.",
+            ["program"],
+            registry=self.registry,
+        )
+        self.xla_steady_recompiles = Counter(
+            "gubernator_xla_steady_recompiles",
+            "Backend compiles AFTER startup warmup completed — shape "
+            "churn by definition; a burst fires the recompile-storm "
+            "flight-recorder dump.",
+            ["program"],
+            registry=self.registry,
+        )
+        self.xla_program_runs = Gauge(
+            "gubernator_xla_program_runs",
+            "Per-program launch timings since the previous scrape "
+            "(stat = count/sum/max seconds; enqueue wall time).  "
+            "Cleared per scrape like the dispatch-stage gauges.",
+            ["program", "stat"],
+            registry=self.registry,
+        )
+        self.device_memory_bytes = Gauge(
+            "gubernator_device_memory_bytes",
+            "Per-device memory sampled at scrape time (stat = "
+            "bytes_in_use/peak_bytes_in_use/bytes_limit where the "
+            "backend reports memory_stats; live_bytes from the "
+            "live-array walk everywhere).",
+            ["device", "stat"],
+            registry=self.registry,
+        )
+        self.device_live_buffers = Gauge(
+            "gubernator_device_live_buffers",
+            "Live jax arrays resident per device at scrape time.",
+            ["device"],
+            registry=self.registry,
+        )
+        # -- conservation audit (audit.py) -----------------------------
+        self.audit_violations = Counter(
+            "gubernator_audit_violations_total",
+            "Conservation-audit invariant violations (device/forward/"
+            "global/reshard hit conservation, GLOBAL carry slack, "
+            "negative remaining).  Any increment is a double-commit or "
+            "lost-hits class bug; each also dumps the flight recorder.",
+            ["invariant"],
+            registry=self.registry,
+        )
+        self.audit_checks = Counter(
+            "gubernator_audit_checks_total",
+            "Conservation-audit reconciliation passes completed.",
+            registry=self.registry,
+        )
+        self.audit_ledger = Gauge(
+            "gubernator_audit_ledger",
+            "Conservation-ledger counters (baseline-relative deltas "
+            "the audit reconciles), exported for dashboards; the "
+            "invariant verdicts live in "
+            "gubernator_audit_violations_total.",
+            ["entry"],
+            registry=self.registry,
+        )
         # SloEngine (saturation.py), attached by the owning V1Service;
         # observe_latency judges GetRateLimits requests against it.
         self.slo = None
@@ -523,6 +597,59 @@ class Metrics:
         mgr = getattr(service, "reshard", None)
         if mgr is not None:
             self.reshard_handoff_seconds.set(mgr.last_handoff_seconds)
+
+    def observe_telemetry(self) -> None:
+        """Refresh the XLA/device telemetry families from the
+        process-global telemetry plane (collect-on-scrape, under the
+        scrape lock like every observer).  Per-program exec timings are
+        drained per scrape; compile counters bump to the cumulative
+        plane totals; device memory/live-buffer stats are sampled here
+        and nowhere else (the scrape is the only reader that pays the
+        live-array walk)."""
+        if not telemetry.enabled():
+            return
+        for label, row in telemetry.compile_snapshot().items():
+            self._bump(self.xla_compiles.labels(program=label), row["count"])
+            self._bump(
+                self.xla_compile_seconds.labels(program=label),
+                row["total_s"],
+            )
+            self._bump(
+                self.xla_steady_recompiles.labels(program=label),
+                row["steady_recompiles"],
+            )
+        self.xla_program_runs.clear()
+        for label, (count, total_s, max_s) in telemetry.take_exec_stats().items():
+            lab = self.xla_program_runs.labels
+            lab(program=label, stat="count").set(count)
+            lab(program=label, stat="sum").set(total_s)
+            lab(program=label, stat="max").set(max_s)
+        self.device_memory_bytes.clear()
+        self.device_live_buffers.clear()
+        for row in telemetry.device_snapshot():
+            dev = row["device"]
+            for stat in ("bytes_in_use", "peak_bytes_in_use",
+                         "bytes_limit", "live_bytes"):
+                if stat in row:
+                    self.device_memory_bytes.labels(
+                        device=dev, stat=stat
+                    ).set(row[stat])
+            self.device_live_buffers.labels(device=dev).set(
+                row.get("live_buffers", 0)
+            )
+
+    def observe_audit(self, service) -> None:
+        """Refresh the conservation-ledger gauge from the service's
+        auditor (collect-on-scrape; violation/check counters are
+        incremented LIVE by the auditor thread at detection time)."""
+        auditor = getattr(service, "auditor", None)
+        if auditor is None:
+            return
+        self.audit_ledger.clear()
+        for entry, value in auditor.deltas().items():
+            self.audit_ledger.labels(entry=entry).set(value)
+        for entry, value in audit_mod.gauges_snapshot().items():
+            self.audit_ledger.labels(entry=entry).set(value)
 
     def _bump(self, counter, absolute: float) -> None:
         current = counter._value.get()  # noqa: SLF001

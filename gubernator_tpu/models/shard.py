@@ -23,8 +23,10 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from .. import audit
 from .. import native
 from .. import saturation
+from .. import telemetry
 from .. import tracing
 from ..ops import buckets
 from ..types import (
@@ -505,7 +507,7 @@ class ColumnsHandle:
     event, so a drain that overtakes a not-yet-launched batch simply
     waits for its dispatcher thread to reach the launch gate."""
 
-    def __init__(self, store, commit_fn, limit_col):
+    def __init__(self, store, commit_fn, limit_col, hits_col=None):
         self._store = store
         self._fetch_fn: "Optional[Callable]" = None  # set by the launch
         self._commit_fn = commit_fn
@@ -515,6 +517,7 @@ class ColumnsHandle:
         self._launch_exc: "Optional[BaseException]" = None
         self._exc: "Optional[BaseException]" = None
         self._limit = limit_col
+        self._hits = hits_col  # conservation-ledger twin of the decode
         self._value = None
         self.ticket = -1  # plan-order reservation (set by the pipeline)
         self.done = False
@@ -567,6 +570,23 @@ class ColumnsHandle:
         dt = time.perf_counter() - t1
         self._store._observe_stage("commit", dt)
         tracing.stage_span("commit", dt, self._trace)
+        # Conservation ledger (audit.py), fed from the decode the commit
+        # just produced: hits GRANTED by the device (UNDER_LIMIT lanes)
+        # and the negative-remaining tripwire — two vectorized reductions
+        # per batch, the applied-side twin of the dispatch-side count in
+        # _submit_pipelined.
+        hits = self._hits
+        if hits is not None:
+            st = np.asarray(status)
+            n = min(len(hits), len(st))
+            audit.note(
+                "applied_hits",
+                int(np.asarray(hits[:n])[st[:n] == 0].sum()),  # 0 = UNDER_LIMIT
+            )
+            rem = np.asarray(remaining)
+            neg = int((rem < 0).sum())
+            if neg:
+                audit.note("negative_remaining", neg)
         self._value = {
             "status": status,
             "limit": self._limit,
@@ -739,9 +759,13 @@ class ColumnarPipeline:
         batches)."""
         bt = tracing.take_batch_trace()  # staged by the batcher (if sampled)
         t0 = time.perf_counter()
+        # Conservation ledger (audit.py): hits entering the device
+        # dispatch — the earlier-layer twin of the applied-hits count at
+        # commit decode (applied <= dispatched is the device invariant).
+        audit.note("dispatched_hits", int(cols.hits.sum()))
         with self._plan_lock:
             prep = self._prepare_columns(keys, cols, now_ms, force_wire)
-            handle = ColumnsHandle(self, prep.commit, cols.limit)
+            handle = ColumnsHandle(self, prep.commit, cols.limit, cols.hits)
             handle._trace = bt
             handle.ticket = self._next_ticket
             self._next_ticket += 1
@@ -870,6 +894,16 @@ class ColumnarPipeline:
         device topology."""
         raise NotImplementedError
 
+    def _program_label(self, group) -> str:
+        """XLA-telemetry program identity for one launch group: store
+        topology (mesh twin vs single shard), solo vs fused-K, and the
+        wire width — the axes along which distinct programs compile."""
+        kind = "mesh" if getattr(self, "tables", None) is not None else "shard"
+        staged = group[0][0]
+        shape = "solo" if len(group) == 1 else f"fused{len(group)}"
+        width = "wide" if staged.wide else "narrow"
+        return f"{kind}:dispatch:{shape}:{width}"
+
     def _launch_group(self, group) -> None:
         """Stage 3 (ticket order, under `_lock`): just the
         state-threading jit call.  A multi-batch group rides ONE fused
@@ -879,22 +913,27 @@ class ColumnarPipeline:
         # One program per group (fused or solo) — counted, not timed:
         # the zero-extra-dispatch telemetry contract asserts on this.
         self.device_dispatches += 1
-        if len(group) == 1:
-            staged, h = group[0]
-            self.state, packed = staged.solo(self.state)
-            h._launch_ok(partial(np.asarray, packed))
-            _prefetch_async(packed)
-            return
-        fn = self._fused_launch_fn(len(group), group[0][0].wide)
-        nr = np.asarray([s.n_rounds for s, _ in group], np.int32)
-        nowv = np.asarray([s.now_ms for s, _ in group], np.int64)
-        self.state, stacked = fn(
-            self.state, *[s.wire_dev for s, _ in group], nr, nowv
-        )
-        shared = _FusedFetch(stacked)
-        for i, (_, h) in enumerate(group):
-            h._launch_ok(partial(shared.get, i))
-        _prefetch_async(stacked)
+        # lazy=wide: warmup deliberately defers the wide int64 wire
+        # programs ("compile lazily" in mesh warmup), so their first
+        # post-steady compile is by design, not shape churn.
+        with telemetry.program(self._program_label(group),
+                               lazy=group[0][0].wide):
+            if len(group) == 1:
+                staged, h = group[0]
+                self.state, packed = staged.solo(self.state)
+                h._launch_ok(partial(np.asarray, packed))
+                _prefetch_async(packed)
+                return
+            fn = self._fused_launch_fn(len(group), group[0][0].wide)
+            nr = np.asarray([s.n_rounds for s, _ in group], np.int32)
+            nowv = np.asarray([s.now_ms for s, _ in group], np.int64)
+            self.state, stacked = fn(
+                self.state, *[s.wire_dev for s, _ in group], nr, nowv
+            )
+            shared = _FusedFetch(stacked)
+            for i, (_, h) in enumerate(group):
+                h._launch_ok(partial(shared.get, i))
+            _prefetch_async(stacked)
 
     # -- resolve / drain ordering --------------------------------------
     def _drain_until(self, handle: "ColumnsHandle") -> None:

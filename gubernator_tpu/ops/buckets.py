@@ -1219,6 +1219,14 @@ def fused_packed_jit(k: int, wide: bool, cold_cond: bool = True,
         donate = tuple(range(k + 1)) if donate_wires else (0,)
         fn = jax.jit(run, donate_argnums=donate)
         _FUSED_PACKED_JIT[key] = fn
+        # XLA telemetry (telemetry.py): one more distinct jitted
+        # callable in the program population — the compile itself is
+        # counted by the monitoring listener when it happens.
+        from .. import telemetry
+
+        telemetry.note_program_created(
+            f"fused_packed:k{k}:{'wide' if wide else 'narrow'}"
+        )
     return fn
 
 

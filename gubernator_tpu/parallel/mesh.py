@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import telemetry
 from ..models.shard import (
     ColumnarPipeline,
     RoundPlanner,
@@ -238,6 +239,9 @@ def _mesh_fused_packed_jit(k: int, wide: bool, donate_wires: bool = True):
         donate = tuple(range(k + 1)) if donate_wires else (0,)
         fn = jax.jit(run, donate_argnums=donate)
         _MESH_FUSED_JIT[key] = fn
+        telemetry.note_program_created(
+            f"mesh_fused:k{k}:{'wide' if wide else 'narrow'}"
+        )
     return fn
 
 
@@ -378,6 +382,24 @@ def _drained_locked(fn):
     wrapper.__name__ = fn.__name__
     wrapper.__doc__ = fn.__doc__
     return wrapper
+
+
+def _programmed(label, lazy=False):
+    """XLA-telemetry label scope as a decorator (telemetry.program):
+    applied INSIDE the lock decorators so the recorded wall time is the
+    program work, not drain-wait backpressure.  `lazy` marks programs
+    warmup deliberately defers (telemetry.program's lazy contract)."""
+
+    def deco(fn):
+        def wrapper(self, *args, **kwargs):
+            with telemetry.program(label, lazy=lazy):
+                return fn(self, *args, **kwargs)
+
+        wrapper.__name__ = fn.__name__
+        wrapper.__doc__ = fn.__doc__
+        return wrapper
+
+    return deco
 
 
 @dataclass
@@ -1071,6 +1093,7 @@ class MeshBucketStore(ColumnarPipeline):
         return out
 
     @_drained_locked
+    @_programmed("mesh:reshard_gather", lazy=True)
     def drain_keys(self, keys, now_ms: int, remove: bool = True):
         """Drain moved keys off the device: resolve their slots in the
         host tables and gather the full bucket rows with ONE mesh-wide
@@ -1170,6 +1193,7 @@ class MeshBucketStore(ColumnarPipeline):
             self.tables[shard_of_key(k, self.n_shards)].remove(k)
 
     @_drained_locked
+    @_programmed("mesh:reshard_commit", lazy=True)
     def commit_transfer(self, cols, now_ms: int) -> int:
         """Receive side of an ownership transfer: assign slots for the
         whole batch in the host tables, gather the CURRENT rows for
@@ -1278,6 +1302,7 @@ class MeshBucketStore(ColumnarPipeline):
         self.set_replica_batch(GlobalsColumns.from_updates([update]), now_ms)
 
     @_locked
+    @_programmed("mesh:replica_commit")
     def set_replica_batch(self, cols: "GlobalsColumns", now_ms: int) -> None:
         """Batched receive side of UpdatePeerGlobals: decode the WHOLE
         broadcast into arrays and commit it with ONE gather/scatter
@@ -1374,7 +1399,8 @@ class MeshBucketStore(ColumnarPipeline):
         import time as _time
 
         t0 = _time.perf_counter()
-        res = self._sync_globals_locked(now_ms)
+        with telemetry.program("mesh:global_sync"):
+            res = self._sync_globals_locked(now_ms)
         if res.did_work:
             # No-work passes (empty early return) cost ~0 and would pin
             # a min-of-N window estimator at its floor; only passes that

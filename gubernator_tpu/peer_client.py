@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import grpc
 import numpy as np
 
+from . import audit
 from . import faults as faults_mod
 from . import saturation
 from . import tracing
@@ -205,11 +206,16 @@ class PeerClient:
 
     # ------------------------------------------------------------------
     def get_peer_rate_limit(
-        self, req: RateLimitRequest, timeout_s: Optional[float] = None
+        self, req: RateLimitRequest, timeout_s: Optional[float] = None,
+        trace_ctx=None,
     ) -> RateLimitResponse:
         """One rate limit from the owning peer; batched unless the
         request asks NO_BATCHING (peer_client.go:141-154).  The batched
-        path rides the columnar coalescer as a 1-lane sub-batch."""
+        path rides the columnar coalescer as a 1-lane sub-batch.
+        `trace_ctx` carries the submitting request's span context when
+        the caller runs on a pool thread with no ambient one
+        (service._forward_one) — forward_columns falls back to
+        tracing.current() otherwise."""
         if has_behavior(req.behavior, Behavior.NO_BATCHING):
             resp = self.get_peer_rate_limits(
                 GetRateLimitsRequest(requests=[req]), timeout_s=timeout_s
@@ -224,7 +230,8 @@ class PeerClient:
                 np.array([int(req.hits)], np.int64),
                 np.array([int(req.limit)], np.int64),
                 np.array([int(req.duration)], np.int64),
-            )
+            ),
+            trace_ctx=trace_ctx,
         )
         timeout = timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
         rc, lo, _hi = fut.result(timeout=timeout + 1.0)
@@ -288,10 +295,13 @@ class PeerClient:
                 self._set_last_err(msg)
                 raise PeerError(msg)
 
+        hits = sum(int(r.hits) for r in req.requests)
+        audit.note("forward_admitted_hits", hits)
         if self.transport == "http":
             body = self._post(
                 "/v1/peer.GetPeerRateLimits", req.to_json(), timeout_s,
                 check=lambda b: _count_check(len(b.get("rateLimits", []))),
+                wire_hits=hits,
             )
             resp = GetRateLimitsResponse.from_json(
                 {"responses": body.get("rateLimits", [])}
@@ -303,6 +313,7 @@ class PeerClient:
                 timeout_s,
                 allow_closing=_draining,
                 check=lambda m: _count_check(len(m.rate_limits)),
+                wire_hits=hits,
             )
             resp = wire.peer_rate_limits_resp_from_pb(m)
         return resp
@@ -682,6 +693,11 @@ class PeerClient:
                 self._set_last_err(msg)
                 raise PeerError(msg)
 
+        # Conservation ledger (audit.py): hits ADMITTED to the forward
+        # wire, counted once per logical batch send; the per-delivery
+        # twin (forward_wire_hits) is counted inside the guarded call.
+        hits = int(cols[4].sum())
+        audit.note("forward_admitted_hits", hits)
         if self.transport == "http":
             if self._shutdown.is_set() and not _draining:
                 raise PeerError(ERR_CLOSING, not_ready=True)
@@ -689,6 +705,7 @@ class PeerClient:
                 "GetPeerRateLimits",
                 lambda: self._post_columns_inner(cols, timeout_s, trace),
                 _count_check,
+                wire_hits=hits,
             )
         else:
             if self._shutdown.is_set() and not _draining:
@@ -697,6 +714,7 @@ class PeerClient:
                 "GetPeerRateLimits",
                 lambda: self._grpc_columns_inner(cols, timeout_s, trace),
                 _count_check,
+                wire_hits=hits,
             )
         if self._metrics is not None:
             self._metrics.peer_columns_batches.labels(
@@ -785,20 +803,28 @@ class PeerClient:
                 circuit_open=True,
             )
 
-    def _fault_check(self, op: str) -> None:
+    def _fault_check(self, op: str) -> bool:
         """Consult the fault plan (instance-level, else the process-wide
         installed one).  An injected ERROR/DROP raises the same
         PeerError shape a real transport failure would — downstream
-        retry/breaker/health behavior is exercised for real."""
+        retry/breaker/health behavior is exercised for real.  Returns
+        True when a DUPLICATE rule fired: the guarded call delivers the
+        transport call twice (byzantine re-delivery chaos)."""
         fp = self.faults if self.faults is not None else faults_mod.active()
         if fp is None:
-            return
+            return False
         act = fp.intercept(self.info.grpc_address, op)
         if act is None:
-            return
+            return False
         if act.kind == faults_mod.DELAY:
             time.sleep(act.delay_s)
-            return
+            return False
+        if act.kind == faults_mod.DUPLICATE:
+            tracing.record_event(
+                "fault", op=op, peer=self.info.grpc_address,
+                kind_detail=act.kind,
+            )
+            return True
         msg = f"{op} to peer {self.info.grpc_address} failed: {act.message}"
         self._set_last_err(msg)
         tracing.record_event(
@@ -806,7 +832,29 @@ class PeerClient:
         )
         raise PeerError(msg, not_ready=act.not_ready)
 
-    def _guarded_call(self, op: str, fn, check=None):
+    def _attempt(self, fn, wire_hits: int):
+        """One transport delivery, conservation-accounted: the attempt
+        counts its hits into the audit ledger when it REACHED the peer —
+        a normal return, or a failure past the point of no return (a
+        timeout-ambiguous error: the RPC may have applied server-side).
+        Provably-unapplied failures (connection-level not_ready, the
+        breaker's own fast-fail) never left this host, so they don't
+        count — which is exactly why a legitimate retry/re-pick after
+        one keeps `forward_wire_hits <= forward_admitted_hits` intact
+        while a DUPLICATE delivery breaks it."""
+        try:
+            out = fn()
+        except BaseException as e:
+            if wire_hits and not (
+                isinstance(e, PeerError) and e.not_ready
+            ):
+                audit.note("forward_wire_hits", wire_hits)
+            raise
+        if wire_hits:
+            audit.note("forward_wire_hits", wire_hits)
+        return out
+
+    def _guarded_call(self, op: str, fn, check=None, wire_hits: int = 0):
         """The breaker protocol, shared by BOTH transports: gate ->
         injected-fault check -> fn() -> optional reply check -> record.
         Every non-raising _breaker_gate() pairs with exactly one
@@ -814,11 +862,22 @@ class PeerClient:
         faults.CircuitBreaker).  `check` runs INSIDE the guarded region
         so a structurally bad reply (wrong response count) counts as a
         breaker failure like any transport error, instead of resetting
-        the failure streak before the caller notices."""
+        the failure streak before the caller notices.  `wire_hits` is
+        the batch's hit total for the conservation ledger (audit.py):
+        counted once per delivery that reached the peer."""
         self._breaker_gate(op)
         try:
-            self._fault_check(op)
-            out = fn()
+            dup = self._fault_check(op)
+            out = fn() if not wire_hits else self._attempt(fn, wire_hits)
+            if dup:
+                # The injected re-delivery: the duplicate's OWN failure
+                # is swallowed (a dropped duplicate is a clean network
+                # again) and its result discarded — but its hits reached
+                # the peer, which the ledger must see.
+                try:
+                    self._attempt(fn, wire_hits)
+                except Exception:  # noqa: BLE001 — duplicate lost in flight
+                    pass
             if check is not None:
                 check(out)
         except BaseException:
@@ -828,11 +887,13 @@ class PeerClient:
         return out
 
     def _grpc_call(self, method: str, request, timeout_s: Optional[float],
-                   allow_closing: bool = False, check=None):
+                   allow_closing: bool = False, check=None,
+                   wire_hits: int = 0):
         if self._shutdown.is_set() and not allow_closing:
             raise PeerError(ERR_CLOSING, not_ready=True)
         return self._guarded_call(
-            method, lambda: self._grpc_inner(method, request, timeout_s), check
+            method, lambda: self._grpc_inner(method, request, timeout_s),
+            check, wire_hits=wire_hits,
         )
 
     def _grpc_inner(self, method: str, request, timeout_s: Optional[float]):
@@ -931,10 +992,11 @@ class PeerClient:
     # HTTP/JSON fallback transport (the peer's gateway surface)
     # ------------------------------------------------------------------
     def _post(self, path: str, payload: dict, timeout_s: Optional[float],
-              check=None) -> dict:
+              check=None, wire_hits: int = 0) -> dict:
         op = path.rpartition(".")[2]  # /v1/peer.GetPeerRateLimits -> op
         return self._guarded_call(
-            op, lambda: self._post_inner(path, payload, timeout_s), check
+            op, lambda: self._post_inner(path, payload, timeout_s), check,
+            wire_hits=wire_hits,
         )
 
     def _post_inner(self, path: str, payload: dict, timeout_s: Optional[float]) -> dict:

@@ -70,6 +70,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from . import audit
 from . import tracing
 from .utils import hashing
 
@@ -369,6 +370,10 @@ class ReshardManager:
         cols.ring_hash = ring_hash
         self.transfers_started += 1
         self._count("started")
+        # Conservation ledger (audit.py): acked lanes must never exceed
+        # drained lanes (reshard_out) — counted at the two distinct
+        # points of the gather -> send -> forget-on-ack protocol.
+        audit.note("reshard_drained_lanes", len(cols))
         peer = picker.get_by_peer_id(pid)
         if peer is None:
             self._abort(cols, len(cols), f"peer {pid} gone from ring")
@@ -382,6 +387,7 @@ class ReshardManager:
             self.transfers_committed += 1
             self.lanes_moved += len(cols)
             self._count("committed")
+            audit.note("reshard_acked_lanes", len(cols))
             if self.service.metrics is not None:
                 self.service.metrics.reshard_lanes.labels(
                     direction="out"
@@ -432,6 +438,8 @@ class ReshardManager:
     def note_received(self, committed: int, rejected: int) -> None:
         self.lanes_received += committed
         self.lanes_rejected += rejected
+        audit.note("reshard_committed_lanes", committed)
+        audit.note("reshard_rejected_lanes", rejected)
         m = self.service.metrics
         if m is not None:
             if committed:

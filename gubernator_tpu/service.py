@@ -23,7 +23,9 @@ from .utils.logging import category_logger
 
 import numpy as np
 
+from . import audit as audit_mod
 from . import saturation
+from . import telemetry
 from . import tracing
 from . import wire
 from .reshard import ReshardManager, TransferColumns
@@ -1076,6 +1078,17 @@ class V1Service:
         )
         self.metrics.slo = self.slo
         self.hotkeys = saturation.HotKeySketch()
+        # Always-on conservation audit (audit.py): the chaos-suite
+        # exactly-once oracles as a live windowed self-check.  The
+        # auditor arms its ledger baseline here — post-construction
+        # traffic (including startup warmup) reconciles cleanly because
+        # every invariant is a one-sided inequality.
+        self.auditor = audit_mod.Auditor(
+            metrics=self.metrics,
+            interval_s=getattr(conf.behaviors, "audit_interval_s", 5.0),
+            enabled=getattr(conf.behaviors, "audit", True),
+        )
+        self.auditor.start()
         self._started_monotonic = time.monotonic()
         self.global_mgr = GlobalManager(self)
         self.multi_region_mgr = MultiRegionManager(self)
@@ -1223,6 +1236,10 @@ class V1Service:
         _finalize_columns (sync) or _ColumnsJoin (async) to complete.
         Shared by both so the two entry points cannot diverge."""
         n = len(cols)
+        # Conservation ledger (audit.py): hits entering the public
+        # front door on the columnar path (sync + async edges both
+        # funnel here; the dataclass router counts in _route).
+        audit_mod.note("ingress_hits", int(cols.hits.sum()))
         beh = cols.behavior
         # GLOBAL lanes need the replica-cache/dataclass path; MULTI_REGION
         # lanes stay columnar when locally owned (their only extra duty is
@@ -1492,7 +1509,11 @@ class V1Service:
             remote_groups=remote_groups,
             slow_idx=slow_idx,
             slow_fn=(
-                (lambda: self._route(slow_reqs).responses) if slow_idx else None
+                # _counted: these lanes' hits were already noted by the
+                # funnel above — the dataclass router must not re-note
+                # the GLOBAL subset into the ingress ledger.
+                (lambda: self._route(slow_reqs, _counted=True).responses)
+                if slow_idx else None
             ),
             hash_keys=hash_keys,
             peeks=peeks,
@@ -1641,8 +1662,19 @@ class V1Service:
                 exc = e
             _merge_fast_result(result, hash_keys, fast_idx, out, sl, exc)
 
-    def _route(self, requests: Sequence[RateLimitRequest]) -> GetRateLimitsResponse:
+    def _route(self, requests: Sequence[RateLimitRequest],
+               _counted: bool = False) -> GetRateLimitsResponse:
         n = len(requests)
+        # Conservation ledger: the dataclass router is the other public
+        # front-door funnel (get_rate_limits, single-lane and
+        # non-columnar fallbacks of the columnar entries).  `_counted`
+        # marks lanes the columnar funnel already noted (its GLOBAL/
+        # slow subset routes through here) — noting them twice would
+        # overstate front-door hits by the GLOBAL fraction.
+        if not _counted:
+            audit_mod.note(
+                "ingress_hits", sum(int(r.hits) for r in requests)
+            )
         out: List[Optional[RateLimitResponse]] = [None] * n
         local: List[int] = []
         global_remote: List[int] = []
@@ -1752,7 +1784,9 @@ class V1Service:
 
         if forwards:
             futures = {
-                i: self._forward_pool.submit(self._forward_one, r, p)
+                i: self._forward_pool.submit(
+                    self._forward_one, r, p, tracing.current()
+                )
                 for i, r, p in forwards
             }
             for i, fut in futures.items():
@@ -1925,7 +1959,8 @@ class V1Service:
         self.metrics.degraded_evals.inc(len(resps))
         return resps
 
-    def _forward_one(self, r: RateLimitRequest, peer: PeerClient) -> RateLimitResponse:
+    def _forward_one(self, r: RateLimitRequest, peer: PeerClient,
+                     trace_ctx=None) -> RateLimitResponse:
         """Forward to the owner (the BATCHING leg, gubernator.go:195-210),
         retrying with a re-pick + jittered backoff when the peer is not
         ready (budget: behaviors.forward_retry_limit).  An owner whose
@@ -1934,13 +1969,17 @@ class V1Service:
         error path — this request already burned its budget observing
         real failures, and the caller sees the same not-connected error
         the reference returns (the NEXT request gets the fast degraded
-        path)."""
+        path).  `trace_ctx` is the SUBMITTING request's span context:
+        this runs on a forward-pool thread with no ambient context, so
+        the router captures it at submit time — without it a
+        single-lane forwarded request's trace would end at the ingress
+        span instead of crossing the wire."""
         key = r.hash_key()
         attempts = 0
         budget = self.conf.behaviors.forward_retry_limit
         while True:
             try:
-                resp = peer.get_peer_rate_limit(r)
+                resp = peer.get_peer_rate_limit(r, trace_ctx=trace_ctx)
                 resp.metadata = {"owner": peer.info.grpc_address}
                 return resp
             except Exception as e:  # noqa: BLE001
@@ -2107,6 +2146,9 @@ class V1Service:
                 "OutOfRange",
                 f"'PeerRequest.rate_limits' list too large; max size is '{MAX_BATCH_SIZE}'",
             )
+        audit_mod.note(
+            "peer_ingress_hits", sum(int(r.hits) for r in req.requests)
+        )
         now = self.clock.now_ms()
         resps = self.store.apply(list(req.requests), now)
         for r in req.requests:
@@ -2143,6 +2185,9 @@ class V1Service:
             )
             result.overrides = dict(enumerate(self.get_peer_rate_limits(req).responses))
             return result
+        # Conservation ledger: hits entering through the peer door (the
+        # dataclass fallback above counts inside get_peer_rate_limits).
+        audit_mod.note("peer_ingress_hits", int(cols.hits.sum()))
         plan = self._submit_peer_columns(cols, result)
         return self._finalize_columns(plan, result)
 
@@ -2285,6 +2330,8 @@ class V1Service:
 
         if has_behavior(r.behavior, Behavior.MULTI_REGION):
             self.multi_region_mgr.queue_hits(r)
+        # Conservation ledger: this lane bypasses both router funnels.
+        audit_mod.note("ingress_hits", int(r.hits))
         try:
             w = self._submit_single_local(
                 r, direct=has_behavior(r.behavior, Behavior.NO_BATCHING)
@@ -2354,6 +2401,7 @@ class V1Service:
                 )
                 _attach_done(fut, partial(_deliver_future, callback))
                 return
+            audit_mod.note("peer_ingress_hits", int(cols.hits.sum()))
             plan = self._submit_peer_columns(cols, result)
         except Exception as e:  # noqa: BLE001
             callback(None, e)
@@ -2411,6 +2459,9 @@ class V1Service:
             )
         if n == 0:
             return 0, 0
+        # Conservation ledger: transfer lanes received; committed +
+        # rejected below must never exceed this (reshard_in).
+        audit_mod.note("reshard_received_lanes", n)
         with self._peer_mutex:
             cur_hash = self.ring_hash
             picker = self.local_picker
@@ -2577,6 +2628,17 @@ class V1Service:
             "slo": self.slo.snapshot(),
             "hotkeys": self.hotkeys.snapshot()["topk"][:5],
             "ring": {**ring, "reshard": self.reshard.snapshot()},
+            "audit": {
+                "enabled": self.auditor.enabled,
+                "checks": self.auditor.checks,
+                "violations": dict(self.auditor.violations),
+                "violationTotal": sum(self.auditor.violations.values()),
+            },
+            "xla": {
+                "enabled": telemetry.enabled(),
+                "compiles": telemetry.compile_count(),
+                "steadyRecompiles": telemetry.steady_recompile_count(),
+            },
         }
         return status
 
@@ -2683,6 +2745,7 @@ class V1Service:
             drainer.stop()
         self.global_mgr.stop()
         self.multi_region_mgr.stop()
+        self.auditor.stop()
         # Drain the membership pool BEFORE tearing down peers/store: an
         # in-flight handoff or dropped-peer shutdown must finish (or
         # abort cleanly) rather than race the teardown below.
@@ -2822,6 +2885,15 @@ class GlobalManager:
             cost if cost is not None else (time.perf_counter() - t0)
         )
         did_work = bool(res.broadcast_cols or res.remote_hit_cols)
+        if res.remote_hit_cols is not None and len(res.remote_hit_cols):
+            # Conservation ledger (audit.py): GLOBAL hits AGGREGATED by
+            # this tick's collective — new lanes only, BEFORE the carry
+            # merge below (requeued lanes were counted the tick they
+            # first aggregated; counting them again would mask a
+            # double-send).
+            audit_mod.note(
+                "global_agg_hits", int(res.remote_hit_cols.hits.sum())
+            )
         # global.sync batch trace per WORK tick (PR 4 taxonomy): child
         # spans for the collective and the two fan-out legs, with the
         # per-peer peer.rpc client spans span-linked to the tick's ctx.
@@ -2966,6 +3038,9 @@ class GlobalManager:
             self._requeue_hits(cols, requeue)
         if dropped:
             svc.metrics.global_dropped_hits.inc(dropped)
+        # Carry size is the documented GLOBAL bounded-loss slack; the
+        # audit's global_slack invariant checks it against HIT_CARRY_MAX.
+        audit_mod.set_gauge(audit_mod.GLOBAL_CARRY_GAUGE, len(self._hit_carry))
         svc.metrics.async_durations.observe(time.perf_counter() - t0)
         tracing.batch_span(
             "global.hits", tick, t0_ns, time.monotonic_ns(),
@@ -3012,11 +3087,16 @@ class GlobalManager:
                         start_ns=t0_ns, end_ns=time.monotonic_ns(),
                         links=bt.links, **attrs,
                     )
+            chunk_hits = int(sub.hits[lo:hi].sum())
             if ok:
+                # Conservation ledger: GLOBAL hits DELIVERED owner-ward
+                # (sent + dropped must stay <= aggregated).
+                audit_mod.note("global_sent_hits", chunk_hits)
                 continue
             if is_circuit_open(err) or is_not_ready(err):
                 requeue.extend(range(lo, hi))
             else:
+                audit_mod.note("global_dropped_hits", chunk_hits)
                 dropped += hi - lo
         return requeue, dropped
 
@@ -3033,6 +3113,7 @@ class GlobalManager:
                 continue
             if len(carry) >= self.HIT_CARRY_MAX:
                 dropped += 1
+                audit_mod.note("global_dropped_hits", int(cols.hits[i]))
                 continue
             carry[hk] = [
                 cols.names[i], cols.unique_keys[i],

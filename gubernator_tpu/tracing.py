@@ -241,7 +241,8 @@ _events = _Ring(EVENT_RING_CAPACITY)
 # the recorder exists to preserve.
 _DUMP_KINDS = frozenset({"breaker-open", "shed", "fault",
                          "global-send-failed", "slo-fast-burn",
-                         "reshard-aborted"})
+                         "reshard-aborted", "recompile-storm",
+                         "audit-violation"})
 _DUMP_MIN_INTERVAL_S = 5.0
 _last_dump = [0.0]
 _dump_lock = threading.Lock()
@@ -256,7 +257,12 @@ def record_span(
     links: Sequence[SpanContext] = (),
     **attrs,
 ) -> None:
-    """Append one COMPLETED span to the flight recorder."""
+    """Append one COMPLETED span to the flight recorder.  `wall_ns`
+    stamps the span's END on the wall clock (time.time_ns) — spans'
+    start_ns are MONOTONIC and therefore incomparable across daemons;
+    the wall stamp is what lets scripts/trace_collect.py order one
+    trace's spans from several processes and measure hop latencies
+    (NTP-grade skew applies, which is fine for hop-scale deltas)."""
     _spans.record(
         {
             "name": name,
@@ -265,6 +271,7 @@ def record_span(
             "parent_id": format(parent_id, "016x") if parent_id else "",
             "start_ns": start_ns,
             "dur_ns": max(end_ns - start_ns, 0),
+            "wall_ns": time.time_ns(),
             "thread": threading.current_thread().name,
             "links": [
                 {"trace_id": l.trace_hex, "span_id": l.span_hex}
@@ -308,22 +315,44 @@ def _auto_dump(trigger: str) -> None:
         logger.exception("flight-recorder dump failed")
 
 
-def spans_snapshot(trace_id_hex: str = "") -> List[dict]:
+def spans_snapshot(trace_id_hex: str = "", since_ns: int = 0,
+                   limit: int = 0) -> List[dict]:
     """Recorded spans, optionally filtered to one trace: a span matches
     when its own trace_id is the target OR it links the target (the
     batch span-link rule — a coalesced dispatch's stage spans belong to
-    every lane's trace)."""
+    every lane's trace).  `since_ns` keeps only spans whose wall-clock
+    end stamp is strictly newer (the incremental-poll cursor
+    scripts/trace_collect.py advances per daemon); `limit` keeps the
+    OLDEST N after filtering — the pagination order: a poller whose
+    cursor tracks the max wall_ns it received gets the NEXT window on
+    its next poll instead of skipping everything between its cursor
+    and a newest-N slice."""
     spans = _spans.snapshot()
-    if not trace_id_hex:
-        return spans
-    want = trace_id_hex.lower().lstrip("0x")
-    want = want.zfill(32)
-    return [
-        s
-        for s in spans
-        if s["trace_id"] == want
-        or any(l["trace_id"] == want for l in s["links"])
-    ]
+    if trace_id_hex:
+        want = trace_id_hex.lower().lstrip("0x")
+        want = want.zfill(32)
+        spans = [
+            s
+            for s in spans
+            if s["trace_id"] == want
+            or any(l["trace_id"] == want for l in s["links"])
+        ]
+    if since_ns:
+        spans = [s for s in spans if s.get("wall_ns", 0) > since_ns]
+    if limit and len(spans) > limit:
+        # Ring order is record order, which tracks wall order closely
+        # but not exactly (wall_ns is stamped inside record_span);
+        # sort by wall stamp so the oldest-N window and the caller's
+        # max-wall cursor agree.  A page never ends MID-TIE: concurrent
+        # record_span calls can stamp identical wall_ns, and cutting
+        # between two equal stamps would let the poller's strict
+        # `since >` cursor skip the tied remainder forever — so the
+        # page extends through every span sharing the boundary stamp
+        # (limit is a soft cap, exceeded only by the tie count).
+        spans = sorted(spans, key=lambda s: s.get("wall_ns", 0))
+        cut = spans[limit - 1].get("wall_ns", 0)
+        spans = [s for s in spans if s.get("wall_ns", 0) <= cut]
+    return spans
 
 
 def events_snapshot() -> List[dict]:

@@ -84,6 +84,17 @@ EXTENSIONS = frozenset(
         "gubernator_reshard_lanes",
         "gubernator_reshard_handoff_seconds",
         "gubernator_ring_generation",
+        # PR 9: XLA/device telemetry (telemetry.py)
+        "gubernator_xla_compiles",
+        "gubernator_xla_compile_seconds",
+        "gubernator_xla_steady_recompiles",
+        "gubernator_xla_program_runs",
+        "gubernator_device_memory_bytes",
+        "gubernator_device_live_buffers",
+        # PR 9: conservation audit (audit.py)
+        "gubernator_audit_violations",
+        "gubernator_audit_checks",
+        "gubernator_audit_ledger",
     }
 )
 

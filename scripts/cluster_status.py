@@ -26,7 +26,7 @@ import urllib.request
 
 COLUMNS = ("daemon", "health", "peers", "brk-open", "ring", "handoff",
            "occupancy", "evict", "queue", "shed", "burn-5m", "burn-1h",
-           "hot-key")
+           "audit", "recompiles", "hot-key")
 
 
 def fetch_status(addr: str, timeout_s: float = 5.0) -> dict:
@@ -64,6 +64,16 @@ def summarize(addr: str, doc: dict) -> dict:
         "shed": ingress.get("shedLanes", 0),
         "burn-5m": slo.get("burn_rate_5m", "-") if slo.get("enabled") else "-",
         "burn-1h": slo.get("burn_rate_1h", "-") if slo.get("enabled") else "-",
+        # Conservation-audit verdicts + XLA steady-state recompiles
+        # (PR 9): either nonzero is a page-worthy cell.
+        "audit": (
+            doc.get("audit", {}).get("violationTotal", 0)
+            if doc.get("audit", {}).get("enabled", False) else "-"
+        ),
+        "recompiles": (
+            doc.get("xla", {}).get("steadyRecompiles", 0)
+            if doc.get("xla", {}).get("enabled", False) else "-"
+        ),
         "hot-key": hot[0]["key"] if hot else "-",
     }
 
@@ -93,6 +103,11 @@ def poll_once(addrs: list, as_json: bool) -> int:
         docs[addr] = doc
         row = summarize(addr, doc)
         if row["health"] != "healthy" or row["brk-open"]:
+            rc = 1
+        # Conservation violations gate the exit code like health does:
+        # a deploy script must not read a double-committing cluster as
+        # green.
+        if isinstance(row["audit"], int) and row["audit"]:
             rc = 1
         rows.append(row)
     if as_json:

@@ -1,0 +1,289 @@
+#!/usr/bin/env python
+"""Multi-daemon soak harness (`make soak`): the ROADMAP item-5 proving
+ground, gated by the conservation audit.
+
+Stands up an in-process cluster of N daemons (default 4) on loopback
+ports — real gateways, real peer wire, real device dispatch — and
+drives it for minutes with:
+
+* **Zipf traffic** — key popularity drawn from a seeded Zipf
+  distribution (the viral-key shape), mixed token/leaky algorithms,
+  a slice of GLOBAL-behavior lanes, through rotating entry daemons so
+  every request shape crosses the peer hop.
+* **Burst replay** — periodic bursts replaying one hot key at
+  many-lane batches (the retry-storm shape).
+* **FaultPlan partitions** — a seeded fault plan periodically
+  partitions one daemon's data plane (ERROR rules) and heals it, so
+  breakers trip, degraded evaluation engages, and the GLOBAL plane
+  requeues — all paths the conservation ledger must reconcile through.
+* **Membership churn** — periodically drops one daemon from everyone's
+  peer list and re-adds it, driving ring deltas, the double-dispatch
+  window, and reshard transfers.
+
+Trace-sampled (GUBER_TRACE_SAMPLE default 0.02) so
+scripts/trace_collect.py can stitch cross-daemon traces from the run.
+
+PASS/FAIL gate, checked every poll and at exit (exit code 1 on any):
+
+* any `gubernator_audit_violations_total` increment on any daemon
+  (the audit IS the soak's oracle: no double-commits, no lost hits,
+  carry within the documented slack, no negative remaining);
+* a daemon that stops answering /debug/status outside a deliberate
+  partition window;
+* zero traffic progress.
+
+`--smoke` runs the 60-second 2-daemon variant (the `make soak-smoke`
+pytest twin asserts the same invariants in-suite).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _fetch(addr: str, path: str, timeout_s: float = 10.0) -> dict:
+    with urllib.request.urlopen(
+        f"http://{addr}{path}", timeout=timeout_s
+    ) as r:
+        return json.loads(r.read())
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--minutes", type=float, default=3.0)
+    ap.add_argument("--daemons", type=int, default=4)
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--keys", type=int, default=2000)
+    ap.add_argument("--zipf-a", type=float, default=1.2,
+                    help="Zipf exponent (>1; larger = hotter head)")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--trace-sample", type=float, default=0.02)
+    ap.add_argument("--poll-every", type=float, default=3.0)
+    ap.add_argument("--fault-every", type=float, default=20.0,
+                    help="seconds between partition injections (0=off)")
+    ap.add_argument("--fault-for", type=float, default=4.0,
+                    help="partition duration seconds")
+    ap.add_argument("--churn-every", type=float, default=45.0,
+                    help="seconds between membership churn events (0=off)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="60s, 2 daemons, no churn (CI-speed)")
+    args = ap.parse_args()
+    if args.smoke:
+        args.minutes = 1.0
+        args.daemons = 2
+        args.churn_every = 0.0
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+
+    import numpy as np
+
+    from gubernator_tpu import faults
+    from gubernator_tpu.client import V1Client
+    from gubernator_tpu.cluster import Cluster, fast_test_behaviors
+    from gubernator_tpu.types import (
+        Algorithm,
+        Behavior,
+        GetRateLimitsRequest,
+        RateLimitRequest,
+    )
+
+    rng = np.random.RandomState(args.seed)
+    beh = fast_test_behaviors()
+    beh.batch_timeout_s = 30.0
+    beh.trace_sample = args.trace_sample
+    beh.latency_target_ms = 30_000.0
+    beh.audit = True
+    beh.audit_interval_s = 2.0
+    # Churn opens the double-dispatch window for real (the test default
+    # turns it off because every fixture startup is a membership change).
+    beh.reshard_handoff_s = 1.0 if args.churn_every else 0.0
+
+    plan = faults.FaultPlan(seed=args.seed)
+    faults.install(plan)
+
+    deadline = time.time() + args.minutes * 60.0
+    print(
+        f"soak: {args.daemons} daemons, {args.minutes:.1f} min, "
+        f"zipf a={args.zipf_a} over {args.keys} keys, seed {args.seed}, "
+        f"trace sample {args.trace_sample}"
+    )
+    cl = Cluster().start_with([""] * args.daemons, behaviors=beh)
+    addrs = [d.gateway.address for d in cl.daemons]
+    print(f"soak: gateways {addrs}")
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    stats = {"requests": 0, "lanes": 0, "errors": []}
+    # Zipf ranks -> key ids (bounded; np.random.zipf is unbounded)
+    zipf_pool = (rng.zipf(args.zipf_a, size=200_000) - 1) % args.keys
+
+    def worker(wid: int) -> None:
+        wrng = np.random.RandomState(args.seed * 1000 + wid)
+        client = V1Client(addrs[wid % len(addrs)], timeout_s=60.0)
+        i = 0
+        while not stop.is_set():
+            burst = (i % 40) == 39
+            lanes = 200 if burst else int(wrng.choice([1, 8, 50]))
+            ids = (
+                np.full(lanes, zipf_pool[wrng.randint(len(zipf_pool))])
+                if burst  # burst replay: one hot key, many lanes
+                else zipf_pool[wrng.randint(0, len(zipf_pool), size=lanes)]
+            )
+            reqs = [
+                RateLimitRequest(
+                    name="soak",
+                    unique_key=f"k{int(k)}",
+                    hits=1,
+                    limit=1_000_000_000,
+                    duration=300_000,
+                    algorithm=(
+                        Algorithm.TOKEN_BUCKET if (j + wid) % 2 == 0
+                        else Algorithm.LEAKY_BUCKET
+                    ),
+                    behavior=(
+                        int(Behavior.GLOBAL) if int(k) % 17 == 0 else 0
+                    ),
+                )
+                for j, k in enumerate(ids)
+            ]
+            try:
+                resp = client.get_rate_limits(
+                    GetRateLimitsRequest(requests=reqs)
+                )
+                errs = [r.error for r in resp.responses if r.error]
+                with lock:
+                    stats["requests"] += 1
+                    stats["lanes"] += lanes
+                    stats["errors"].extend(errs[:2])
+            except Exception as e:  # noqa: BLE001 — partitions make some fail
+                with lock:
+                    stats["errors"].append(f"{type(e).__name__}: {e}")
+            i += 1
+
+    threads = [
+        threading.Thread(target=worker, args=(w,), daemon=True)
+        for w in range(args.workers)
+    ]
+    for t in threads:
+        t.start()
+
+    failures: list = []
+    partition_until = 0.0
+    partitioned_rule = None
+    next_fault = time.time() + args.fault_every if args.fault_every else None
+    next_churn = time.time() + args.churn_every if args.churn_every else None
+    churned_idx = None
+    baseline_err = 0
+    try:
+        while time.time() < deadline and not failures:
+            time.sleep(args.poll_every)
+            now = time.time()
+            # -- fault scheduling --------------------------------------
+            if partitioned_rule is not None and now >= partition_until:
+                plan.heal(partitioned_rule.peer)
+                print(f"soak: healed partition of {partitioned_rule.peer}")
+                partitioned_rule = None
+            if (next_fault is not None and now >= next_fault
+                    and partitioned_rule is None):
+                victim = cl.daemons[
+                    int(rng.randint(len(cl.daemons)))
+                ].peer_info.grpc_address
+                partitioned_rule = plan.partition(victim)
+                partition_until = now + args.fault_for
+                next_fault = now + args.fault_every
+                print(f"soak: partitioned {victim} for {args.fault_for}s")
+            if next_churn is not None and now >= next_churn:
+                next_churn = now + args.churn_every
+                if churned_idx is None:
+                    churned_idx = int(rng.randint(1, len(cl.daemons)))
+                    peers = [
+                        p for j, p in enumerate(cl.peers) if j != churned_idx
+                    ]
+                    print(
+                        f"soak: churn OUT {cl.peers[churned_idx].grpc_address}"
+                    )
+                else:
+                    peers = list(cl.peers)
+                    print(
+                        f"soak: churn IN {cl.peers[churned_idx].grpc_address}"
+                    )
+                    churned_idx = None
+                for d in cl.daemons:
+                    d.set_peers(peers)
+            # -- invariant polling -------------------------------------
+            for i, addr in enumerate(addrs):
+                try:
+                    aud = _fetch(addr, "/debug/audit")
+                except OSError as e:
+                    if partitioned_rule is None:
+                        failures.append(f"{addr}: unreachable: {e}")
+                    continue
+                if aud["violationTotal"]:
+                    failures.append(
+                        f"{addr}: AUDIT VIOLATIONS {aud['violations']} "
+                        f"ledger={aud['ledger']}"
+                    )
+            with lock:
+                nerr = len(stats["errors"])
+                reqs = stats["requests"]
+            print(
+                f"soak: t-{max(deadline - now, 0):.0f}s requests={reqs} "
+                f"errors={nerr - baseline_err}"
+            )
+            baseline_err = nerr
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        # Final reconciliation with traffic quiesced: run one audit
+        # check on every daemon (in-flight lag has drained, so the
+        # inequalities are at their tightest).
+        for d in cl.daemons:
+            try:
+                d.service.auditor.check_now()
+                snap = d.service.auditor.snapshot()
+                if snap["violationTotal"]:
+                    failures.append(
+                        f"{d.gateway.address}: final audit violations "
+                        f"{snap['violations']}"
+                    )
+            except Exception as e:  # noqa: BLE001
+                failures.append(f"final audit check failed: {e}")
+        sample = {}
+        try:
+            sample = _fetch(addrs[0], "/debug/audit")
+        except OSError:
+            pass
+        faults.uninstall()
+        cl.stop()
+
+    with lock:
+        reqs, lanes = stats["requests"], stats["lanes"]
+    print(
+        f"soak: done — {reqs} requests / {lanes} lanes; "
+        f"ledger sample: { {k: v for k, v in sample.get('ledger', {}).items() if v} }"
+    )
+    if reqs == 0:
+        failures.append("soak made zero progress")
+    if failures:
+        print("soak: FAIL")
+        for f in failures[:10]:
+            print(f"  - {f}")
+        return 1
+    print("soak: PASS (zero conservation violations)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,309 @@
+#!/usr/bin/env python
+"""Cross-daemon trace stitcher: reassemble ONE trace from many daemons.
+
+PR 4 made the peer wire carry a single trace id across daemons (the
+sparse trace-context column / GTRC trailer) — but nothing CONSUMED it:
+each daemon's flight recorder shows only its own spans.  This script is
+the consumer: it polls every daemon's `GET /debug/traces`
+(incrementally, via the `since`/`limit` parameters), groups spans that
+share a trace id — matching a span's OWN id or its span-links, the
+batch link rule — and stitches them into one tree per trace with the
+cross-daemon hops annotated.
+
+Stitching rules (tracing.py's span taxonomy):
+
+* Same-daemon edges come from `parent_id` (a span's parent lives in
+  the same process).
+* Cross-daemon and batch fan-in edges come from LINKS: a span that
+  links (trace, span_id) attaches under that span — a coalesced
+  window/dispatch span attaches under every lane it carried; a
+  receiving daemon's batch spans attach under the sender's span whose
+  context rode the wire.
+* `start_ns` is MONOTONIC and per-process: ordering and hop latency
+  across daemons use the wall-clock end stamp (`wall_ns`) each span
+  records, start = wall_ns - dur_ns (NTP-grade skew applies; fine at
+  hop scale).
+
+Usage:
+    python scripts/trace_collect.py ADDR [ADDR...] [--trace-id HEX]
+        [--watch SECONDS] [--json] [--limit N]
+
+ADDR is a daemon gateway host:port.  Without --trace-id, every trace
+seen across the fleet is stitched; with it, only that trace.  --watch
+polls incrementally.  Exit code: 0 when at least one trace stitched
+(or --allow-empty), 1 otherwise — so a soak can gate on "sampling and
+stitching actually work".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+
+def fetch_spans(addr: str, trace_id: str = "", since_ns: int = 0,
+                limit: int = 0, timeout_s: float = 10.0) -> List[dict]:
+    """One daemon's recorded spans, tagged with the daemon address."""
+    params = []
+    if trace_id:
+        params.append(f"trace_id={trace_id}")
+    if since_ns:
+        params.append(f"since={since_ns}")
+    if limit:
+        params.append(f"limit={limit}")
+    qs = ("?" + "&".join(params)) if params else ""
+    url = f"http://{addr}/debug/traces{qs}"
+    with urllib.request.urlopen(url, timeout=timeout_s) as r:
+        doc = json.loads(r.read())
+    spans = doc.get("spans", [])
+    for s in spans:
+        s["daemon"] = addr
+    return spans
+
+
+class Collector:
+    """Incremental fleet poller: per-daemon `since` cursors advance on
+    each poll, so a watch loop re-reads only new spans.
+
+    The cursor trails the newest received stamp by CURSOR_LAG_NS:
+    wall_ns is stamped inside record_span BEFORE the ring insert, so a
+    preempted writer can land a span with an OLDER stamp than one a
+    poll already returned — a cursor at the exact max would then skip
+    it forever.  Re-fetched spans inside the lag window are dropped by
+    the `_seen` dedup, so the lag costs bandwidth, not correctness."""
+
+    CURSOR_LAG_NS = 200_000_000  # 200ms >> any GIL preemption gap
+
+    def __init__(self, addrs: List[str], trace_id: str = "",
+                 limit: int = 0):
+        self.addrs = list(addrs)
+        self.trace_id = trace_id
+        self.limit = limit
+        self.cursors: Dict[str, int] = {a: 0 for a in self.addrs}
+        self.spans: List[dict] = []
+        self._seen = set()
+
+    def poll(self) -> int:
+        """One pass over the fleet; returns how many NEW spans landed.
+        A dead daemon is skipped (the soak kills daemons on purpose)."""
+        new = 0
+        for addr in self.addrs:
+            try:
+                spans = fetch_spans(
+                    addr, self.trace_id, since_ns=self.cursors.get(addr, 0),
+                    limit=self.limit,
+                )
+            except OSError:
+                continue
+            page_new = 0
+            for s in spans:
+                key = (s["daemon"], s["trace_id"], s["span_id"],
+                       s.get("wall_ns", 0))
+                if key in self._seen:
+                    continue
+                self._seen.add(key)
+                self.spans.append(s)
+                page_new += 1
+            new += page_new
+            if not spans:
+                continue
+            cur = self.cursors.get(addr, 0)
+            page_max = max(s.get("wall_ns", 0) for s in spans)
+            if page_new:
+                self.cursors[addr] = max(cur, page_max - self.CURSOR_LAG_NS)
+            elif self.limit and len(spans) >= self.limit:
+                # A FULL page with nothing new: everything up to
+                # page_max is already consumed, and a lagged cursor
+                # could sit at-or-before the page start forever (all
+                # stamps inside one lag window) — step past the page,
+                # trading the (already-consumed) lag protection for
+                # livelock-freedom.
+                self.cursors[addr] = max(cur, page_max)
+        return new
+
+
+def stitch(spans: List[dict]) -> Dict[str, dict]:
+    """Group spans into per-trace trees.
+
+    Returns {trace_id: {"roots": [node...], "daemons": [...],
+    "hops": [...]}} where a node is {"span": dict, "children":
+    [node...], "via": "parent"|"link"}.  A span belongs to every trace
+    it names (own id) or links; within one trace, it parents under its
+    parent_id span when that span is present, else under the span a
+    link targets, else it is a root."""
+    by_trace: Dict[str, List[dict]] = {}
+    for s in spans:
+        ids = {s["trace_id"]}
+        ids.update(l["trace_id"] for l in s.get("links", ()))
+        for tid in ids:
+            by_trace.setdefault(tid, []).append(s)
+    out: Dict[str, dict] = {}
+    for tid, group in by_trace.items():
+        # Wall start for ordering (start_ns is per-process monotonic).
+        for s in group:
+            s["_wall_start"] = s.get("wall_ns", 0) - s.get("dur_ns", 0)
+        group.sort(key=lambda s: s["_wall_start"])
+        nodes = {}
+        for s in group:
+            # One span can appear in several traces; node identity is
+            # per (trace, daemon, span) so trees never share children.
+            nodes[(s["daemon"], s["span_id"])] = {
+                "span": s, "children": [], "via": None,
+            }
+        own = {
+            s["span_id"]: (s["daemon"], s["span_id"])
+            for s in group if s["trace_id"] == tid
+        }
+        roots = []
+        for s in group:
+            node = nodes[(s["daemon"], s["span_id"])]
+            parent_key = None
+            via = None
+            pid = s.get("parent_id", "")
+            # parent_id is a same-process edge: resolve it against this
+            # daemon's spans (the parent may carry a different trace id
+            # — a batch span parented under its window span — which is
+            # exactly how a lane's tree reaches the coalesced spans).
+            same = (s["daemon"], pid)
+            if pid and same in nodes and pid != s["span_id"]:
+                parent_key, via = same, "parent"
+            elif pid and pid in own and own[pid] != (s["daemon"], s["span_id"]):
+                parent_key, via = own[pid], "parent"
+            else:
+                for l in s.get("links", ()):
+                    if l["trace_id"] == tid and l["span_id"] in own:
+                        cand = own[l["span_id"]]
+                        if cand != (s["daemon"], s["span_id"]):
+                            parent_key, via = cand, "link"
+                            break
+            if parent_key is not None:
+                node["via"] = via
+                nodes[parent_key]["children"].append(node)
+            else:
+                roots.append(node)
+        daemons = sorted({s["daemon"] for s in group})
+        out[tid] = {
+            "roots": roots,
+            "daemons": daemons,
+            "spanCount": len(group),
+            "hops": _hops(group),
+        }
+    return out
+
+
+def _hops(group: List[dict]) -> List[dict]:
+    """Cross-daemon hop latencies: for each client-side `peer.rpc`
+    span, the delta from its wall start to each remote daemon's
+    earliest same-trace span that started INSIDE the RPC's window.
+    Per-daemon, not winner-takes-all: a fan-out batch can drive several
+    owners concurrently, and pairing every RPC with the globally
+    earliest remote span would attribute one daemon's timing to an RPC
+    aimed at another.  The RPC's declared target rides along as `peer`
+    (a gRPC data-plane address — the polled daemons are gateway
+    addresses, so it annotates rather than joins)."""
+    hops = []
+    for s in group:
+        if s["name"] != "peer.rpc":
+            continue
+        t0 = s["_wall_start"]
+        t1 = s.get("wall_ns", t0)
+        by_daemon: Dict[str, dict] = {}
+        for r in group:
+            if r["daemon"] == s["daemon"]:
+                continue
+            if not t0 <= r["_wall_start"] <= t1:
+                continue  # remote work outside this RPC's lifetime
+            cur = by_daemon.get(r["daemon"])
+            if cur is None or r["_wall_start"] < cur["_wall_start"]:
+                by_daemon[r["daemon"]] = r
+        for daemon, first in sorted(by_daemon.items()):
+            hops.append({
+                "from": s["daemon"],
+                "to": daemon,
+                "peer": s.get("attrs", {}).get("peer", ""),
+                "latency_ms": round((first["_wall_start"] - t0) / 1e6, 3),
+                "firstRemoteSpan": first["name"],
+            })
+    return hops
+
+
+def render_tree(tid: str, tree: dict, out=sys.stdout) -> None:
+    out.write(
+        f"trace {tid}  spans={tree['spanCount']}  "
+        f"daemons={','.join(tree['daemons'])}\n"
+    )
+    for hop in tree["hops"]:
+        out.write(
+            f"  hop {hop['from']} -> {hop['to']} "
+            f"({hop['firstRemoteSpan']}) +{hop['latency_ms']}ms\n"
+        )
+
+    def walk(node, depth):
+        s = node["span"]
+        marker = {"link": "~", "parent": "+"}.get(node["via"], "*")
+        out.write(
+            f"  {'  ' * depth}{marker} {s['name']} "
+            f"[{s['daemon']}] {s.get('dur_ns', 0) / 1e6:.3f}ms"
+            f"{' thread=' + s['thread'] if s.get('thread') else ''}\n"
+        )
+        for c in sorted(node["children"],
+                        key=lambda n: n["span"]["_wall_start"]):
+            walk(c, depth + 1)
+
+    for r in tree["roots"]:
+        walk(r, 0)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("addrs", nargs="+", help="daemon gateway host:port")
+    ap.add_argument("--trace-id", default="", help="stitch one trace only")
+    ap.add_argument("--watch", type=float, default=0.0,
+                    help="poll every N seconds (0 = once)")
+    ap.add_argument("--limit", type=int, default=0,
+                    help="per-poll span cap per daemon")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--allow-empty", action="store_true",
+                    help="exit 0 even when no trace stitched")
+    args = ap.parse_args()
+
+    coll = Collector(args.addrs, trace_id=args.trace_id, limit=args.limit)
+    try:
+        while True:
+            coll.poll()
+            if args.watch <= 0:
+                break
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        pass
+    trees = stitch(coll.spans)
+    if args.as_json:
+        def strip(node):
+            s = {k: v for k, v in node["span"].items()
+                 if not k.startswith("_")}
+            return {"span": s, "via": node["via"],
+                    "children": [strip(c) for c in node["children"]]}
+
+        print(json.dumps({
+            tid: {
+                "daemons": t["daemons"],
+                "spanCount": t["spanCount"],
+                "hops": t["hops"],
+                "roots": [strip(r) for r in t["roots"]],
+            }
+            for tid, t in trees.items()
+        }, indent=2))
+    else:
+        if not trees:
+            print("no spans collected (is GUBER_TRACE_SAMPLE > 0?)")
+        for tid, tree in sorted(trees.items()):
+            render_tree(tid, tree)
+    return 0 if trees or args.allow_empty else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,321 @@
+"""Conservation audit (gubernator_tpu/audit.py).
+
+Unit tests of the ledger/invariant math (baseline arming, one-sided
+inequalities, violation growth semantics, the GLOBAL-carry slack
+bound), no-false-positive runs under eviction pressure / GLOBAL carry
+accumulation / a mid-window reshard handoff, and the seeded
+double-commit: a FaultPlan DUPLICATE rule on the forward wire must
+trip forward_conservation (violation counter + flight-recorder
+auto-dump event) while a clean run stays silent.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from gubernator_tpu import audit, faults, tracing
+from gubernator_tpu.cluster import Cluster, fast_test_behaviors
+from gubernator_tpu.types import (
+    Algorithm,
+    Behavior,
+    GetRateLimitsRequest,
+    RateLimitRequest,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_rings():
+    tracing.reset()
+    yield
+    tracing.reset()
+    faults.uninstall()
+
+
+# ---------------------------------------------------------------------
+# Ledger / invariant math
+# ---------------------------------------------------------------------
+def test_ledger_notes_and_baseline_arming():
+    a = audit.Auditor(enabled=False)
+    audit.note("dispatched_hits", 10)
+    audit.note("applied_hits", 7)
+    d = a.deltas()
+    assert d["dispatched_hits"] == 10 and d["applied_hits"] == 7
+    a.arm()  # baseline re-captured: deltas zero again
+    assert a.deltas()["dispatched_hits"] == 0
+    assert a.check_now() == []
+
+
+def test_applied_exceeding_dispatched_violates():
+    a = audit.Auditor(enabled=False)
+    a.check_now()  # seed pass (see Auditor.arm)
+    audit.note("dispatched_hits", 5)
+    audit.note("applied_hits", 9)  # 4 hits granted from nowhere
+    found = a.check_now()
+    names = [v["invariant"] for v in found]
+    assert "device_conservation" in names
+    v = next(v for v in found if v["invariant"] == "device_conservation")
+    assert v["excess"] == 4
+    # Persisting-unchanged violation is not re-counted...
+    assert a.check_now() == []
+    assert a.violations["device_conservation"] == 1
+    # ...but GROWTH is.
+    audit.note("applied_hits", 2)
+    assert [v["invariant"] for v in a.check_now()] == ["device_conservation"]
+    assert a.violations["device_conservation"] == 2
+
+
+def test_lag_direction_never_violates():
+    """Every invariant tolerates the later layer lagging (in-flight
+    work): earlier-side excess is NOT a violation."""
+    a = audit.Auditor(enabled=False)
+    a.check_now()  # seed pass (see Auditor.arm)
+    audit.note("dispatched_hits", 100)   # dispatched, not yet applied
+    audit.note("forward_admitted_hits", 50)  # admitted, not yet sent
+    audit.note("global_agg_hits", 30)    # aggregated, not yet forwarded
+    audit.note("reshard_drained_lanes", 9)   # drained, not yet acked
+    audit.note("reshard_received_lanes", 4)  # received, commit pending
+    assert a.check_now() == []
+    assert a.violations == {}
+
+
+def test_wire_hits_exceeding_admitted_violates():
+    a = audit.Auditor(enabled=False)
+    a.check_now()  # seed pass (see Auditor.arm)
+    audit.note("forward_admitted_hits", 8)
+    audit.note("forward_wire_hits", 16)  # the duplicate-delivery shape
+    assert [v["invariant"] for v in a.check_now()] == [
+        "forward_conservation"
+    ]
+
+
+def test_negative_remaining_violates():
+    a = audit.Auditor(enabled=False)
+    a.check_now()  # seed pass (see Auditor.arm)
+    audit.note("negative_remaining", 1)
+    assert [v["invariant"] for v in a.check_now()] == ["negative_remaining"]
+
+
+def test_global_carry_slack_bound():
+    from gubernator_tpu.service import GlobalManager
+
+    a = audit.Auditor(enabled=False)
+    a.check_now()  # seed pass (see Auditor.arm)
+    audit.set_gauge(audit.GLOBAL_CARRY_GAUGE, GlobalManager.HIT_CARRY_MAX)
+    assert a.check_now() == []  # at the cap = within the documented slack
+    audit.set_gauge(audit.GLOBAL_CARRY_GAUGE, GlobalManager.HIT_CARRY_MAX + 3)
+    found = a.check_now()
+    assert [v["invariant"] for v in found] == ["global_slack"]
+    assert found[0]["excess"] == 3
+    audit.set_gauge(audit.GLOBAL_CARRY_GAUGE, 0)
+
+
+def test_metrics_counters_and_dump_event():
+    from gubernator_tpu.metrics import Metrics
+
+    m = Metrics()
+    a = audit.Auditor(metrics=m, enabled=False)
+    a.check_now()  # seed pass (see Auditor.arm)
+    audit.note("forward_wire_hits", 2)
+    a.check_now()
+    rendered = m.render().decode()
+    assert (
+        'gubernator_audit_violations_total{invariant="forward_conservation"}'
+        in rendered
+    )
+    kinds = [e["kind"] for e in tracing.events_snapshot()]
+    assert "audit-violation" in kinds  # the flight-recorder dump path
+
+
+def test_snapshot_shape():
+    a = audit.Auditor(enabled=False, interval_s=1.0)
+    snap = a.snapshot()
+    assert snap["intervalS"] == 1.0
+    assert set(audit.INVARIANTS) <= set(snap["invariants"])
+    assert "ledger" in snap and "violations" in snap
+
+
+# ---------------------------------------------------------------------
+# No-false-positive runs (the audit must be SILENT on clean traffic)
+# ---------------------------------------------------------------------
+def _drive(daemon, n_keys: int, hits: int = 1, behavior: int = 0,
+           batches: int = 4, tag: str = "a"):
+    svc = daemon.service
+    for b in range(batches):
+        reqs = [
+            RateLimitRequest(
+                name="audit", unique_key=f"{tag}{b}:{i}", hits=hits,
+                limit=50, duration=60_000,
+                algorithm=(
+                    Algorithm.TOKEN_BUCKET if i % 2 == 0
+                    else Algorithm.LEAKY_BUCKET
+                ),
+                behavior=behavior,
+            )
+            for i in range(n_keys)
+        ]
+        svc.get_rate_limits(GetRateLimitsRequest(requests=reqs))
+
+
+def _assert_clean(*daemons):
+    for d in daemons:
+        # Two passes: the first may be the auditor's silent seed pass
+        # (Auditor.arm) when its background thread hasn't ticked yet —
+        # the second is guaranteed to be a counting reconciliation.
+        d.service.auditor.check_now()
+        found = d.service.auditor.check_now()
+        assert found == [], found
+        assert d.service.auditor.violations == {}
+
+
+@pytest.mark.slow
+def test_clean_under_eviction_pressure():
+    """A tiny table under many distinct keys churns evictions; evicted
+    state must not unbalance the hit ledgers."""
+    cl = Cluster().start_with([""], behaviors=fast_test_behaviors(),
+                              cache_size=256)
+    try:
+        _drive(cl.daemons[0], n_keys=200, batches=6, tag="ev")
+        occ = cl.daemons[0].service.store.occupancy_stats()
+        assert sum(r["evictions"] for r in occ) > 0, "no eviction pressure"
+        _assert_clean(cl.daemons[0])
+    finally:
+        cl.stop()
+
+
+@pytest.mark.chaos
+def test_clean_global_carry_accumulation():
+    """GLOBAL hits for a partitioned owner requeue into the carry tick
+    after tick — accumulation within the documented slack must stay
+    silent (sent+dropped <= aggregated, carry <= cap)."""
+    cl = Cluster().start(2)
+    plan = faults.FaultPlan(seed=3)
+    try:
+        # Find keys whose GLOBAL owner is daemon 1, driven via daemon 0.
+        svc0 = cl.daemons[0].service
+        victim = cl.daemons[1].service.advertise_address
+        plan.partition(victim)
+        faults.install(plan)
+        _drive(cl.daemons[0], n_keys=40, behavior=int(Behavior.GLOBAL),
+               batches=3, tag="gc")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            d = svc0.auditor.deltas()
+            if d["global_agg_hits"] > 0:
+                break
+            time.sleep(0.1)
+        _assert_clean(*cl.daemons)
+        plan.heal()
+        time.sleep(0.5)
+        _assert_clean(*cl.daemons)
+    finally:
+        faults.uninstall()
+        cl.stop()
+
+
+@pytest.mark.slow
+def test_clean_mid_window_reshard_handoff():
+    """Membership churn with the double-dispatch window open: drains,
+    transfers, peeks and merges must all reconcile (acked <= drained,
+    committed+rejected <= received) with zero violations."""
+    beh = fast_test_behaviors()
+    beh.reshard_handoff_s = 1.0
+    cl = Cluster().start_with(["", ""], behaviors=beh)
+    try:
+        _drive(cl.daemons[0], n_keys=60, batches=2, tag="rh")
+        # Drop daemon 1 from every ring: its resident keys move to d0.
+        solo = [cl.peers[0]]
+        for d in cl.daemons:
+            d.set_peers(solo)
+        assert cl.daemons[1].service.reshard.wait_idle(20.0)
+        # Traffic during the double-dispatch window (peeks are hits=0).
+        _drive(cl.daemons[0], n_keys=60, batches=2, tag="rh")
+        deltas = cl.daemons[1].service.auditor.deltas()
+        assert deltas["reshard_drained_lanes"] >= deltas["reshard_acked_lanes"]
+        _assert_clean(*cl.daemons)
+    finally:
+        cl.stop()
+
+
+# ---------------------------------------------------------------------
+# The seeded double-commit
+# ---------------------------------------------------------------------
+@pytest.mark.chaos
+def test_duplicate_delivery_caught_by_audit():
+    """FaultPlan DUPLICATE on the forward wire: the transport delivers
+    each matching RPC twice (the network/proxy re-delivering an applied
+    RPC — a true double-commit: the owner applies the hits twice).  The
+    sender's ledger counts the wire hits twice against hits admitted
+    once, and the audit must catch it: forward_conservation violation,
+    metric increment, audit-violation flight-recorder event.  The same
+    traffic without the rule stays silent (asserted by every other test
+    in this file)."""
+    cl = Cluster().start(2)
+    plan = faults.FaultPlan(seed=11)
+    plan.duplicate(op="GetPeerRateLimits")
+    try:
+        svc0 = cl.daemons[0].service
+        auditor = svc0.auditor
+        auditor.arm()  # isolate this test's traffic
+        auditor.check_now()  # seed pass (see Auditor.arm)
+        faults.install(plan)
+        # Keys owned by daemon 1, entered at daemon 0: every lane
+        # crosses the forward wire (and gets delivered twice).
+        me = svc0.advertise_address
+        # Hash-derived probe keys: FNV-1 clusters structured key
+        # families onto one owner (the documented hash_ring property),
+        # and an unlucky port draw can leave a whole indexed range
+        # locally owned — md5-hex keys disperse, so ~half are remote.
+        import hashlib
+
+        cand = [hashlib.md5(str(i).encode()).hexdigest() for i in range(64)]
+        reqs = [
+            RateLimitRequest(
+                name="dup", unique_key=uk, hits=3, limit=1000,
+                duration=60_000,
+            )
+            for uk in cand
+            if svc0.get_peer(
+                RateLimitRequest(name="dup", unique_key=uk).hash_key()
+            ).info.grpc_address != me
+        ]
+        assert reqs, "no remotely-owned keys in the probe range"
+        svc0.get_rate_limits(GetRateLimitsRequest(requests=reqs))
+        d = auditor.deltas()
+        assert d["forward_wire_hits"] > d["forward_admitted_hits"], d
+        found = auditor.check_now()
+        assert "forward_conservation" in [v["invariant"] for v in found]
+        assert auditor.violations["forward_conservation"] >= 1
+        kinds = [e["kind"] for e in tracing.events_snapshot()]
+        assert "audit-violation" in kinds
+        # The violation also surfaces on the status/audit surfaces.
+        snap = auditor.snapshot()
+        assert snap["violationTotal"] >= 1
+    finally:
+        faults.uninstall()
+        cl.stop()
+
+
+@pytest.mark.chaos
+def test_error_retry_is_not_a_false_positive():
+    """A connection-shaped failure + re-pick/retry is the LEGITIMATE
+    twin of the duplicate: the failed attempt provably never applied,
+    so it must not count wire hits — same traffic shape, zero
+    violations."""
+    cl = Cluster().start(2)
+    plan = faults.FaultPlan(seed=5)
+    try:
+        svc0 = cl.daemons[0].service
+        svc0.auditor.arm()
+        victim = cl.daemons[1].service.advertise_address
+        # Fail the FIRST forward attempt connection-shaped; the retry
+        # (or degraded-local fallback) proceeds.
+        plan.error_nth(victim, 1, op="GetPeerRateLimits", count=1)
+        faults.install(plan)
+        _drive(cl.daemons[0], n_keys=32, hits=2, batches=2, tag="rt")
+        _assert_clean(*cl.daemons)
+    finally:
+        faults.uninstall()
+        cl.stop()

@@ -10,7 +10,10 @@ checks steady-state invariants on every poll:
     never go backwards),
   * queue depth bounded by the configured cap,
   * the SLO engine live (enabled, burn rates present) and the latency
-    attribution phases populated.
+    attribution phases populated,
+  * the CONSERVATION AUDIT silent: zero invariant violations on every
+    poll and on a final quiesced reconciliation pass (audit.py — a
+    clean soak is the audit's no-false-positive contract).
 
 Marked `slow` (excluded from tier-1); `make soak-smoke` runs it alone.
 """
@@ -129,6 +132,12 @@ def test_soak_smoke_status_invariants():
                 last_evictions[addr] = occ["evictions"]
                 assert doc["slo"]["enabled"] is True
                 assert "burn_rate_5m" in doc["slo"]
+                aud = doc["audit"]
+                assert aud["enabled"] is True
+                if aud["violationTotal"]:
+                    violations.append(
+                        f"{addr}: audit violations {aud['violations']}"
+                    )
             if violations:
                 break
     finally:
@@ -143,6 +152,14 @@ def test_soak_smoke_status_invariants():
 
     assert not alive, f"threads deadlocked: {alive}"
     assert not violations, violations[:5]
+    # Final quiesced reconciliation: with traffic drained the ledger
+    # inequalities are at their tightest — still zero violations.  The
+    # thread-liveness assert runs FIRST: check_now() below also bumps
+    # `checks`, which would mask a checker thread that never started.
+    for d in cl.daemons:
+        assert d.service.auditor.checks > 0, "auditor thread never ran"
+        assert d.service.auditor.check_now() == []
+        assert d.service.auditor.violations == {}
     assert polls >= 4, "soak made too few status polls"
     assert stats["requests"] > 50, "soak made no progress"
     assert not stats["errors"], stats["errors"][:5]

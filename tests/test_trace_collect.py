@@ -1,0 +1,292 @@
+"""scripts/trace_collect.py — cross-daemon trace stitching.
+
+The acceptance case: ONE trace id, reassembled into a single tree
+spanning two LIVE daemons (ingress daemon + owner daemon), with the
+peer hop visible — the ingress daemon's `peer.rpc` client span and the
+owner daemon's batch/dispatch spans all stitched under the ingress
+root via parent/link edges.  Plus unit tests of the stitcher's edge
+rules and the incremental `since` cursor.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+import trace_collect  # noqa: E402
+
+from gubernator_tpu import tracing  # noqa: E402
+from gubernator_tpu.client import V1Client  # noqa: E402
+from gubernator_tpu.cluster import Cluster, fast_test_behaviors  # noqa: E402
+from gubernator_tpu.types import (  # noqa: E402
+    GetRateLimitsRequest,
+    RateLimitRequest,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_rings():
+    tracing.reset()
+    prev = tracing.sample_rate()
+    yield
+    tracing.set_sample_rate(prev)
+    tracing.reset()
+
+
+# ---------------------------------------------------------------------
+# Stitcher unit rules
+# ---------------------------------------------------------------------
+def _span(name, trace, span, daemon, parent="", links=(), wall=0, dur=0):
+    return {
+        "name": name, "trace_id": trace, "span_id": span,
+        "parent_id": parent, "daemon": daemon, "wall_ns": wall,
+        "dur_ns": dur, "start_ns": 0, "thread": "t", "attrs": {},
+        "links": [
+            {"trace_id": t, "span_id": s} for t, s in links
+        ],
+    }
+
+
+def test_stitch_parent_and_link_edges():
+    t = "a" * 32
+    spans = [
+        _span("ingress.http", t, "r" * 16, "d0", wall=100, dur=90),
+        _span("peer.rpc", t, "p" * 16, "d0", parent="r" * 16,
+              wall=90, dur=40),
+        # Owner daemon's window span carries its OWN trace but LINKS the
+        # ingress span — the cross-daemon edge.
+        _span("batch.window", "b" * 32, "w" * 16, "d1",
+              links=[(t, "r" * 16)], wall=95, dur=20),
+        _span("dispatch.launch", "b" * 32, "l" * 16, "d1",
+              parent="w" * 16, links=[(t, "r" * 16)], wall=94, dur=5),
+    ]
+    trees = trace_collect.stitch(spans)
+    tree = trees[t]
+    assert tree["daemons"] == ["d0", "d1"]
+    assert tree["spanCount"] == 4
+    assert len(tree["roots"]) == 1
+    root = tree["roots"][0]
+    assert root["span"]["name"] == "ingress.http"
+    kids = {c["span"]["name"]: c for c in root["children"]}
+    assert kids["peer.rpc"]["via"] == "parent"
+    assert kids["batch.window"]["via"] == "link"
+    # The owner-side dispatch span nests under its own-daemon parent.
+    sub = {c["span"]["name"] for c in kids["batch.window"]["children"]}
+    assert "dispatch.launch" in sub
+
+
+def test_stitch_reports_cross_daemon_hop():
+    t = "c" * 32
+    spans = [
+        # rpc wall window: start 500_000 .. end 2_000_000
+        _span("peer.rpc", t, "1" * 16, "d0", wall=2_000_000, dur=1_500_000,
+              links=[]),
+        # remote span starts INSIDE the rpc window (start 1_600_000)
+        _span("batch.window", t, "2" * 16, "d1", wall=2_000_000,
+              dur=400_000),
+        # a remote span OUTSIDE the window must not become a hop
+        _span("batch.window", t, "3" * 16, "d2", wall=9_000_000,
+              dur=100_000),
+    ]
+    spans[0]["attrs"] = {"peer": "d1:81"}
+    trees = trace_collect.stitch(spans)
+    hops = trees[t]["hops"]
+    assert len(hops) == 1, hops
+    assert hops[0]["from"] == "d0" and hops[0]["to"] == "d1"
+    assert hops[0]["peer"] == "d1:81"
+    assert hops[0]["latency_ms"] >= 0
+
+
+def test_limit_page_never_ends_mid_tie():
+    """Concurrent record_span calls can stamp identical wall_ns; a page
+    must extend through the boundary tie, or a poller's strict
+    `since >` cursor would skip the tied remainder forever."""
+    tracing.reset()
+    for i, w in enumerate([1, 2, 2, 2, 3]):
+        tracing._spans.record({
+            "name": f"s{i}", "trace_id": "t" * 32, "span_id": str(i),
+            "parent_id": "", "start_ns": 0, "dur_ns": 0, "wall_ns": w,
+            "links": [], "attrs": {}, "thread": "t",
+        })
+    page = tracing.spans_snapshot(limit=2)
+    assert [s["wall_ns"] for s in page] == [1, 2, 2, 2]  # soft cap: tie kept
+    nxt = tracing.spans_snapshot(
+        since_ns=max(s["wall_ns"] for s in page), limit=2
+    )
+    assert [s["wall_ns"] for s in nxt] == [3]  # nothing lost between pages
+
+
+# ---------------------------------------------------------------------
+# The live 2-daemon acceptance case
+# ---------------------------------------------------------------------
+import contextlib  # noqa: E402
+import signal  # noqa: E402
+import socket  # noqa: E402
+import subprocess  # noqa: E402
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@contextlib.contextmanager
+def _daemon_pair():
+    """TWO daemon SUBPROCESSES peered to each other, trace sample 1.0.
+    Separate processes are the point: each daemon has its OWN flight
+    recorder, so a stitched tree spanning both addresses proves the
+    trace genuinely crossed the wire (in-process cluster daemons share
+    one module-global ring, which would vacuously 'span' daemons)."""
+    import shutil
+    import tempfile
+
+    ports = [(_free_port(), _free_port()) for _ in range(2)]
+    static = ",".join(
+        f"127.0.0.1:{g}|127.0.0.1:{h}" for h, g in ports
+    )
+    procs = []
+    # FRESH compile-cache dir per DAEMON: the shared .jax_cache gets
+    # corrupted by concurrent writers (bench daemons, other suites,
+    # each other) and a corrupt cache aborts daemon warmup with no
+    # Python traceback.
+    cache_root = tempfile.mkdtemp(prefix="trace-collect-jax-cache-")
+    try:
+        for http_port, grpc_port in ports:
+            env = dict(os.environ)
+            env.update(
+                XLA_FLAGS="--xla_force_host_platform_device_count=2",
+                JAX_PLATFORMS="cpu",
+                JAX_COMPILATION_CACHE_DIR=os.path.join(
+                    cache_root, str(http_port)
+                ),
+                GUBER_HTTP_ADDRESS=f"127.0.0.1:{http_port}",
+                GUBER_GRPC_ADDRESS=f"127.0.0.1:{grpc_port}",
+                GUBER_STATIC_PEERS=static,
+                GUBER_TRACE_SAMPLE="1.0",
+                GUBER_GLOBAL_SYNC_WAIT="3600s",
+                GUBER_MULTI_REGION_SYNC_WAIT="3600s",
+                GUBER_BATCH_TIMEOUT="30s",
+                GUBER_CACHE_SIZE="4096",
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "gubernator_tpu.cmd.server"],
+                stdout=subprocess.PIPE, text=True, env=env,
+                cwd=os.path.join(os.path.dirname(__file__), ".."),
+            ))
+        for p in procs:
+            line = p.stdout.readline()
+            assert "listening" in line, f"daemon failed to start: {line!r}"
+        yield [f"127.0.0.1:{h}" for h, _ in ports]
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        shutil.rmtree(cache_root, ignore_errors=True)
+
+
+@pytest.mark.slow
+def test_one_trace_spans_two_live_daemons():
+    with _daemon_pair() as addrs:
+        # Hash-derived keys: FNV-1 clusters structured key families
+        # onto one owner (the documented hash_ring property); md5-hex
+        # keys disperse, so among a handful at least one lane crosses
+        # the forward hop whatever the port draw.
+        import hashlib
+
+        client = V1Client(addrs[0], timeout_s=60.0)
+        resp = client.get_rate_limits(GetRateLimitsRequest(requests=[
+            RateLimitRequest(
+                name="trace",
+                unique_key=hashlib.md5(str(i).encode()).hexdigest(),
+                hits=1, limit=100, duration=60_000,
+            )
+            for i in range(16)
+        ]))
+        assert not any(r.error for r in resp.responses)
+        coll = trace_collect.Collector(addrs)
+        assert coll.poll() > 0
+        trees = trace_collect.stitch(coll.spans)
+        # The acceptance case: ONE trace id whose stitched tree spans
+        # BOTH live daemons, rooted at the entry daemon's ingress span.
+        multi = {
+            tid: t for tid, t in trees.items()
+            if sorted(t["daemons"]) == sorted(addrs) and any(
+                r["span"]["name"] == "ingress.http"
+                and r["span"]["daemon"] == addrs[0]
+                for r in t["roots"]
+            )
+        }
+        assert multi, (
+            f"no trace spans both daemons: "
+            f"{[(tid, t['daemons']) for tid, t in trees.items()]}"
+        )
+        tid, tree = next(iter(multi.items()))
+
+        def flatten(node, acc):
+            acc.append(node)
+            for c in node["children"]:
+                flatten(c, acc)
+            return acc
+
+        root = next(
+            r for r in tree["roots"]
+            if r["span"]["name"] == "ingress.http"
+            and r["span"]["daemon"] == addrs[0]
+        )
+        nodes = flatten(root, [])
+        names_by_daemon = {}
+        for n in nodes:
+            names_by_daemon.setdefault(
+                n["span"]["daemon"], set()
+            ).add(n["span"]["name"])
+        # The peer hop is visible: the entry daemon's client-side
+        # peer.rpc span AND the owner daemon's spans, all stitched
+        # under the ONE ingress root via parent/link edges.
+        assert "peer.rpc" in names_by_daemon[addrs[0]], names_by_daemon
+        assert addrs[1] in names_by_daemon, (
+            f"owner daemon's spans not stitched under the ingress root: "
+            f"{names_by_daemon}"
+        )
+        assert names_by_daemon[addrs[1]] & {
+            "batch.window", "dispatch.launch", "dispatch.commit",
+            "ingress.http", "ingress.grpc",
+        }, names_by_daemon
+        # The hop report names the two daemons with a plausible delta.
+        assert any(
+            h["from"] == addrs[0] and h["to"] == addrs[1]
+            for h in tree["hops"]
+        ), tree["hops"]
+
+
+@pytest.mark.slow
+def test_since_cursor_filters_old_spans():
+    beh = fast_test_behaviors()
+    beh.trace_sample = 1.0
+    cl = Cluster().start_with([""], behaviors=beh)
+    try:
+        addr = cl.daemons[0].gateway.address
+        client = V1Client(addr, timeout_s=30.0)
+        client.get_rate_limits(GetRateLimitsRequest(requests=[
+            RateLimitRequest(name="sc", unique_key="k", hits=1,
+                             limit=10, duration=60_000),
+        ]))
+        first = trace_collect.fetch_spans(addr)
+        assert first
+        newest = max(s["wall_ns"] for s in first)
+        # since=newest: everything recorded so far is filtered out.
+        assert trace_collect.fetch_spans(addr, since_ns=newest) == []
+        # limit: newest-N slice.
+        assert len(trace_collect.fetch_spans(addr, limit=2)) <= 2
+    finally:
+        cl.stop()

@@ -349,6 +349,39 @@ def test_debug_routing_and_profile_gate():
         tracing.set_sample_rate(prev)
 
 
+def test_profile_concurrent_run_guard():
+    """POST /debug/profile: the response names the run (runId) and its
+    artifact path (logDir); a SECOND request while one runs answers 409
+    carrying the in-flight run's id + path, so racing operators
+    converge on the same artifact instead of just being refused."""
+    import json as _json
+
+    from gubernator_tpu import gateway
+
+    prev = tracing.sample_rate()
+    tracing.set_sample_rate(1.0)
+    try:
+        status, _, body = gateway.handle_request(
+            None, "POST", "/debug/profile", b'{"durationMs": 1500}'
+        )
+        assert status == 202, body
+        doc = _json.loads(body)
+        assert doc["runId"] and doc["logDir"]
+        status2, _, body2 = gateway.handle_request(
+            None, "POST", "/debug/profile", b'{"durationMs": 10}'
+        )
+        assert status2 == 409, body2
+        doc2 = _json.loads(body2)
+        assert doc2["runId"] == doc["runId"]
+        assert doc2["logDir"] == doc["logDir"]
+        # Let the in-flight run drain so later tests see an idle slot.
+        t = gateway._profile_state["thread"]
+        if t is not None:
+            t.join(timeout=60)
+    finally:
+        tracing.set_sample_rate(prev)
+
+
 def test_trace_sample_env_validation():
     from gubernator_tpu.config import setup_daemon_config
 
