@@ -18,7 +18,10 @@ tier1:
 # backoff / degraded-eval behavior under seeded fault plans, the
 # resharding scenarios (owner death mid-transfer, DROP/DELAY on
 # transfer frames, exactly-once oracle — tests/test_reshard_chaos.py),
-# including the slow soaks tier-1 skips.
+# and the durability kill/restart recovery suite (SIGKILL a daemon
+# mid-traffic and mid-snapshot-write, restart, assert monotone-bounded
+# recovery — tests/test_snapshot_chaos.py), including the slow soaks
+# tier-1 skips.
 chaos:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
 		-p no:cacheprovider

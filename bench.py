@@ -651,6 +651,95 @@ def _bench_daemon(extra_env=None, extra_env_fn=None, what="bench daemon"):
             proc.kill()
 
 
+def measure_snapshot(lanes: int = 131_072, batch: int = 16_384,
+                     timeout_s: float = 120.0):
+    """Durability-plane dump + restore wall time at the 131k-lane
+    batch size, measured against REAL daemons in their own processes
+    (the PR 8 loopback harness):
+
+      1. spawn daemon A with GUBER_SNAPSHOT on a short interval,
+         populate `lanes` distinct buckets through the columnar front
+         door, and read the daemon's own dump timing
+         (`/debug/status` snapshot.lastSaveSeconds — the in-process
+         gather+encode+fsync wall time, wire excluded) once a
+         completed snapshot covers every lane;
+      2. SIGTERM A (final snapshot), spawn daemon B on the same file,
+         and read snapshot.lastRestoreSeconds — the boot-time
+         read+verify+ONE-merge-commit wall time.
+
+    Returns {"dump_s", "restore_s", "lanes", "bytes"}.  The restore
+    row gates (snapshot_restore_ms ceiling): boot recovery is on the
+    deploy critical path, and an accidentally per-item restore would
+    show up here as a ~100x blowup."""
+    import json as _json
+    import os
+    import tempfile
+    import urllib.request
+
+    from gubernator_tpu.client import ColumnsV1Client
+
+    def _status(port):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/status", timeout=10
+        ) as f:
+            return _json.loads(f.read())["snapshot"]
+
+    tmp = tempfile.mkdtemp(prefix="gub_bench_snap_")
+    path = os.path.join(tmp, "bench.snap")
+    env = {
+        "GUBER_SNAPSHOT": path,
+        "GUBER_SNAPSHOT_INTERVAL": "1s",
+        "GUBER_NATIVE_HTTP": "1",
+        "GUBER_INGRESS_COLUMNS": "1",
+        # Two CPU devices: lanes/2 per shard, pow2-padded.
+        "GUBER_CACHE_SIZE": str(lanes * 2),
+        "GUBER_WARMUP_SHAPES": "1,1000",
+    }
+    with _bench_daemon(extra_env=env, what="snapshot daemon A") as (hp, _gp):
+        client = ColumnsV1Client(f"127.0.0.1:{hp}", timeout_s=60.0)
+        try:
+            for lo in range(0, lanes, batch):
+                n = min(batch, lanes - lo)
+                client.submit_columns((
+                    ["bench"] * n,
+                    [f"snap:{lo + i}" for i in range(n)],
+                    np.zeros(n, np.int32),
+                    np.zeros(n, np.int32),
+                    np.ones(n, np.int64),
+                    np.full(n, 1_000_000, np.int64),
+                    np.full(n, 3_600_000, np.int64),
+                )).result(timeout=60)
+        finally:
+            client.close()
+        # Wait for a save that STARTED after ingestion finished, so
+        # its gather covers every lane (savedLanes is cumulative
+        # across saves and cannot prove that by itself).
+        base = _status(hp)["savesOk"]
+        deadline = time.monotonic() + timeout_s
+        dump_s = None
+        while time.monotonic() < deadline:
+            s = _status(hp)
+            if s["savesOk"] > base + 1:
+                dump_s = s["lastSaveSeconds"]
+                break
+            time.sleep(0.25)
+        if dump_s is None:
+            raise RuntimeError("daemon A never completed a full snapshot")
+    size = os.path.getsize(path)
+    with _bench_daemon(extra_env=env, what="snapshot daemon B") as (hp, _gp):
+        s = _status(hp)
+        if s["restore"] != "ok" or s["restoredLanes"] < lanes:
+            raise RuntimeError(
+                f"daemon B restore {s['restore']!r}, "
+                f"{s['restoredLanes']}/{lanes} lanes"
+            )
+        restore_s = s["lastRestoreSeconds"]
+    return {
+        "dump_s": dump_s, "restore_s": restore_s,
+        "lanes": lanes, "bytes": size,
+    }
+
+
 def measure_peer_forward(mode: str = "columns", n_threads: int = 8,
                          iters: int = 4, batch: int = 1000) -> float:
     """Loopback two-daemon forward throughput: the owner daemon runs in
@@ -1008,8 +1097,13 @@ def _save_device_rows(dev, extra=None) -> None:
     """Persist main()'s device rows so a follow-up `--gate` (the `make
     bench` sequence) can evaluate thresholds without re-paying the
     whole differential measurement on the tunnel."""
+    import jax
+
     rows = {
         "time": time.time(),
+        # The gate keys tunnel-calibrated device ceilings on this:
+        # rows measured on a CPU box must SKIP them, not FAIL.
+        "backend": jax.default_backend(),
         "device_batch_us": dev["device_batch_us"],
         "device_us_b1024": dev["small_batch_us"][1024][0],
         "device_us_b256": dev["small_batch_us"][256][0],
@@ -1077,11 +1171,13 @@ def gate() -> int:
         thresholds = json.load(f)
     rows = None
     noise = {}
+    row_backend = None
     try:
         with open(LAST_DEVICE_ROWS) as f:
             saved = json.load(f)
         if time.time() - saved["time"] < 3600:
             noise = saved.get("noise", {})
+            row_backend = saved.get("backend")
             rows = {k: saved[k] for k in thresholds if k in saved}
             # Sample counts ride along for thin-tail discounting.
             rows.update({
@@ -1092,6 +1188,7 @@ def gate() -> int:
         pass
     if rows is None:
         jax = _jax_setup()
+        row_backend = jax.default_backend()
         dev = measure_device(jax, 1_700_000_000_000, samples=6)
         disp = measure_dispatch_pipeline(jax, 1_700_000_000_000)
         rows = {
@@ -1164,6 +1261,18 @@ def gate() -> int:
             )
         except Exception as e:  # noqa: BLE001 — two-daemon spawn can fail
             print(f"gate global_plane_vs_classic: SKIP (measure failed: {e})")
+    if "snapshot_restore_ms" not in rows:
+        try:
+            snap_row = measure_snapshot()
+            rows["snapshot_restore_ms"] = snap_row["restore_s"] * 1e3
+            rows["snapshot_dump_ms"] = snap_row["dump_s"] * 1e3
+            print(
+                f"gate snapshot rows: dump {snap_row['dump_s'] * 1e3:.0f}ms, "
+                f"restore {snap_row['restore_s'] * 1e3:.0f}ms at "
+                f"{snap_row['lanes']} lanes ({snap_row['bytes']} bytes)"
+            )
+        except Exception as e:  # noqa: BLE001 — two-daemon spawn can fail
+            print(f"gate snapshot_restore_ms: SKIP (measure failed: {e})")
     # Tracing overhead is a SAME-RUN ratio by definition (both halves
     # back-to-back in this process), so it never reuses saved rows.
     try:
@@ -1193,6 +1302,23 @@ def gate() -> int:
         if value is None:
             print(f"gate {name}: SKIP (no fresh measurement)")
             continue
+        # Backend-keyed ceilings: the device-microsecond rows are
+        # calibrated against the TPU tunnel's measured best; a
+        # tunnel-less CPU box measures the same path 10-100x slower
+        # through no regression of its own (the PR 9 verify note), so
+        # those rows SKIP with the reason named instead of failing the
+        # whole gate.
+        only = spec.get("only_backend")
+        if only:
+            if row_backend is None:
+                row_backend = _jax_setup().default_backend()
+            if row_backend != only:
+                print(
+                    f"gate {name}: SKIP (backend '{row_backend}' != "
+                    f"'{only}': ceiling calibrated on the {only} tunnel; "
+                    f"expected on CPU boxes)"
+                )
+                continue
         # Thin-tail discount: a percentile judged from too few samples
         # is noise shaped like a verdict — rows record n_samples, and
         # specs with min_samples SKIP below it.

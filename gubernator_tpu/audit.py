@@ -33,6 +33,9 @@ hits, which is what makes the reconciliation meaningful):
   reshard_received_lanes  transfer lanes received from old owners
   reshard_committed_lanes merge-committed here
   reshard_rejected_lanes  received but not owned under the current ring
+  snapshot_saved_lanes    lanes gathered into a completed snapshot dump
+  snapshot_loaded_lanes   lanes decoded from a snapshot file at boot
+  snapshot_committed_lanes lanes merge-committed by the boot restore
   negative_remaining      decoded lanes with remaining < 0 (device
                           arithmetic corruption; must stay 0)
 
@@ -49,6 +52,9 @@ break) trips it:
                          (the documented bounded-loss slack, PR 5)
   reshard_out            reshard_acked_lanes     <= reshard_drained_lanes
   reshard_in             committed + rejected    <= reshard_received_lanes
+  snapshot_restore       snapshot_committed      <= snapshot_loaded
+                         (a restore can only drop lanes — expired in
+                         transit, duplicate keys — never mint them)
   negative_remaining     negative_remaining      == 0
 
 A FaultPlan DUPLICATE rule (faults.py) — the injectable model of a
@@ -92,6 +98,9 @@ COUNTERS = (
     "reshard_received_lanes",
     "reshard_committed_lanes",
     "reshard_rejected_lanes",
+    "snapshot_saved_lanes",
+    "snapshot_loaded_lanes",
+    "snapshot_committed_lanes",
     "negative_remaining",
 )
 
@@ -150,6 +159,9 @@ INVARIANTS = {
     "reshard_in": (
         ("reshard_committed_lanes", "reshard_rejected_lanes"),
         ("reshard_received_lanes",), 0,
+    ),
+    "snapshot_restore": (
+        ("snapshot_committed_lanes",), ("snapshot_loaded_lanes",), 0,
     ),
     "negative_remaining": (("negative_remaining",), (), 0),
 }

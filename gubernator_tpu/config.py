@@ -199,6 +199,14 @@ class BehaviorConfig:
     # the window (transfers still run).  Env: GUBER_RESHARD_HANDOFF.
     reshard_handoff_s: float = 2.0
 
+    # -- durability plane (snapshot.py) --------------------------------
+    # Background snapshot cadence in seconds (only active when a
+    # snapshot path is configured via GUBER_SNAPSHOT / DaemonConfig
+    # .snapshot_path).  0 = shutdown-only snapshots: the file is still
+    # written on close()/SIGTERM, just never on a timer.  Env:
+    # GUBER_SNAPSHOT_INTERVAL (a Go duration string; bare number = ms).
+    snapshot_interval_s: float = 60.0
+
 
 @dataclass
 class DaemonConfig:
@@ -235,6 +243,14 @@ class DaemonConfig:
     # /metrics shows ingress-queue 503s).  None = NativeGatewayServer
     # default (4).  Env: GUBER_NATIVE_WORKERS.
     native_workers: "int | None" = None
+    # Durability plane (snapshot.py): path of the crash-safe columnar
+    # device-state snapshot file.  "" (and the explicit opt-outs "0"/
+    # "false"/"off" in the env var) = disabled — every restart is a
+    # full reset, exactly the pre-durability daemon.  Written with
+    # temp+fsync+rename on close()/SIGTERM and every
+    # behaviors.snapshot_interval_s; restored at boot with ONE monotone
+    # merge-commit.  Env: GUBER_SNAPSHOT.
+    snapshot_path: str = ""
     data_center: str = ""
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
     # Static peer list (the zero-dependency discovery mode; etcd/
@@ -534,6 +550,17 @@ def setup_daemon_config(
     )
     if b.reshard_handoff_s < 0:
         raise ValueError("GUBER_RESHARD_HANDOFF must be >= 0")
+    v = merged.get("GUBER_SNAPSHOT", "").strip()
+    # GUBER_SNAPSHOT=0 (the chaos suite's pre-durability mode) and its
+    # boolean-flavored siblings read as "disabled", not as a filename.
+    conf.snapshot_path = (
+        "" if v.lower() in ("", "0", "false", "off", "no") else v
+    )
+    b.snapshot_interval_s = _env_float_ms(
+        merged, "GUBER_SNAPSHOT_INTERVAL", b.snapshot_interval_s
+    )
+    if b.snapshot_interval_s < 0:
+        raise ValueError("GUBER_SNAPSHOT_INTERVAL must be >= 0")
     v = merged.get("GUBER_TRACE_SAMPLE", "")
     if v:
         try:

@@ -84,6 +84,7 @@ class Daemon:
             data_center=self.conf.data_center,
             persist_store=self.conf.store,
             loader=self.conf.loader,
+            snapshot_path=getattr(self.conf, "snapshot_path", ""),
             clock=self.clock,
             metrics=metrics,
             devices=self.conf.devices,

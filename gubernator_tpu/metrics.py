@@ -394,6 +394,41 @@ class Metrics:
             ["device"],
             registry=self.registry,
         )
+        # -- durability plane (snapshot.py) ----------------------------
+        self.snapshot_writes = Counter(
+            "gubernator_snapshot_writes",
+            "Crash-safe snapshot dumps by result: ok (gathered, "
+            "encoded, fsync'd, atomically renamed) or error (counted "
+            "and logged; the serving path and shutdown never fail on a "
+            "failed dump).",
+            ["result"],
+            registry=self.registry,
+        )
+        self.snapshot_restores = Counter(
+            "gubernator_snapshot_restores",
+            "Boot-time snapshot restores by result: ok (merge-"
+            "committed), absent (no file — cold start), rejected "
+            "(corrupt/truncated/wrong-version/checksum — LOUD cold "
+            "start with a snapshot-rejected flight-recorder dump).",
+            ["result"],
+            registry=self.registry,
+        )
+        self.snapshot_lanes = Counter(
+            "gubernator_snapshot_lanes",
+            "Bucket lanes crossing the durability plane by direction: "
+            "saved (gathered into a completed dump) or restored "
+            "(merge-committed at boot).",
+            ["direction"],
+            registry=self.registry,
+        )
+        self.snapshot_age_seconds = Gauge(
+            "gubernator_snapshot_age_seconds",
+            "Seconds since the last successful snapshot dump (set per "
+            "scrape; -1 = no successful dump yet / plane disabled).  "
+            "The staleness-slack contract bounds over-admission after "
+            "a crash by the hits admitted inside this window.",
+            registry=self.registry,
+        )
         # -- conservation audit (audit.py) -----------------------------
         self.audit_violations = Counter(
             "gubernator_audit_violations_total",
@@ -597,6 +632,14 @@ class Metrics:
         mgr = getattr(service, "reshard", None)
         if mgr is not None:
             self.reshard_handoff_seconds.set(mgr.last_handoff_seconds)
+        # Durability plane: snapshot staleness (the slack-contract
+        # numerator; counters are incremented live by SnapshotManager).
+        snaps = getattr(service, "snapshots", None)
+        if snaps is not None:
+            self.snapshot_age_seconds.set(
+                time.time() - snaps.last_save_unix
+                if snaps.last_save_unix else -1.0
+            )
 
     def observe_telemetry(self) -> None:
         """Refresh the XLA/device telemetry families from the

@@ -1428,37 +1428,55 @@ class ShardStore(ColumnarPipeline):
         plan lock held).  remove=False leaves the table untouched (the
         handoff's gather-then-forget-on-ack protocol); expired rows are
         never shipped."""
-        from ..reshard import TransferColumns
-
         self._drain_then_lock()
         try:
-            found = [
-                (k, s) for k in keys
-                if (s := self.table.get_slot(k)) is not None
-            ]
-            if not found:
-                return TransferColumns.empty()
-            slots = np.asarray([s for _, s in found], np.int32)
-            rows = jax.tree.map(
-                np.asarray, buckets.read_rows(self.state, slots)
-            )
-            self.device_dispatches += 1
-            if remove:
-                for k, _ in found:
-                    self.table.remove(k)
-            live = np.nonzero(np.asarray(rows.expire_at) >= now_ms)[0]
-            return TransferColumns(
-                keys=[found[int(i)][0] for i in live],
-                algorithm=np.asarray(rows.algo)[live].astype(np.int32),
-                status=np.asarray(rows.status)[live].astype(np.int32),
-                limit=np.asarray(rows.limit)[live].astype(np.int64),
-                remaining=np.asarray(rows.remaining)[live].astype(np.int64),
-                duration=np.asarray(rows.duration)[live].astype(np.int64),
-                stamp=np.asarray(rows.stamp)[live].astype(np.int64),
-                expire_at=np.asarray(rows.expire_at)[live].astype(np.int64),
-            )
+            return self._gather_transfer_locked(keys, now_ms, remove)
         finally:
             self._unlock_drained()
+
+    def snapshot_columns(self, now_ms: int):
+        """Durability dump (snapshot.py): every resident key's full
+        bucket row in ONE gather program — drain_keys' all-keys variant
+        (gather-only, nothing removed).  Warmup keys are synthetic
+        compile fodder and stay out of the file."""
+        self._drain_then_lock()
+        try:
+            keys = [
+                k for k in self.table.keys()
+                if not k.startswith("__warmup__")
+            ]
+            return self._gather_transfer_locked(keys, now_ms, remove=False)
+        finally:
+            self._unlock_drained()
+
+    def _gather_transfer_locked(self, keys, now_ms: int, remove: bool):
+        from ..reshard import TransferColumns
+
+        found = [
+            (k, s) for k in keys
+            if (s := self.table.get_slot(k)) is not None
+        ]
+        if not found:
+            return TransferColumns.empty()
+        slots = np.asarray([s for _, s in found], np.int32)
+        rows = jax.tree.map(
+            np.asarray, buckets.read_rows(self.state, slots)
+        )
+        self.device_dispatches += 1
+        if remove:
+            for k, _ in found:
+                self.table.remove(k)
+        live = np.nonzero(np.asarray(rows.expire_at) >= now_ms)[0]
+        return TransferColumns(
+            keys=[found[int(i)][0] for i in live],
+            algorithm=np.asarray(rows.algo)[live].astype(np.int32),
+            status=np.asarray(rows.status)[live].astype(np.int32),
+            limit=np.asarray(rows.limit)[live].astype(np.int64),
+            remaining=np.asarray(rows.remaining)[live].astype(np.int64),
+            duration=np.asarray(rows.duration)[live].astype(np.int64),
+            stamp=np.asarray(rows.stamp)[live].astype(np.int64),
+            expire_at=np.asarray(rows.expire_at)[live].astype(np.int64),
+        )
 
     def forget_keys(self, keys) -> None:
         """Drop keys from the table after a transfer ACK (no device

@@ -1110,13 +1110,37 @@ class MeshBucketStore(ColumnarPipeline):
         already holds replica state and the new owner's first sync
         takes over aggregation) are skipped.  Returns a
         reshard.TransferColumns."""
+        return self._gather_transfer_locked(keys, now_ms, remove,
+                                            skip_global=True)
+
+    @_drained_locked
+    @_programmed("mesh:snapshot_gather", lazy=True)
+    def snapshot_columns(self, now_ms: int):
+        """Durability dump (snapshot.py): every FRONT-resident key's
+        full bucket row in ONE mesh-wide gather program — drain_keys'
+        all-keys variant.  Unlike a reshard drain it KEEPS the tables
+        (gather-only) and INCLUDES owner-side GLOBAL buckets (they
+        restore as ordinary rows; the gslot table and replica columns
+        rebuild from traffic + broadcasts).  Back-tier rows are the
+        cold long tail by construction and are not snapshotted — the
+        same documented bound as the reshard plane.  Warmup keys stay
+        out of the file."""
+        keys = [
+            k for t in self.tables for k in t.keys()
+            if not k.startswith("__warmup__")
+        ]
+        return self._gather_transfer_locked(keys, now_ms, remove=False,
+                                            skip_global=False)
+
+    def _gather_transfer_locked(self, keys, now_ms: int, remove: bool,
+                                skip_global: bool):
         from ..reshard import TransferColumns
 
         per_slot: List[List[int]] = [[] for _ in range(self.n_shards)]
         per_keys: List[List[str]] = [[] for _ in range(self.n_shards)]
         gkeys = self.gtable._key_to_gslot  # noqa: SLF001
         for k in keys:
-            if k in gkeys:
+            if skip_global and k in gkeys:
                 continue
             s = shard_of_key(k, self.n_shards)
             slot = self.tables[s].get_slot(k)

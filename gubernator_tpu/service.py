@@ -25,6 +25,7 @@ import numpy as np
 
 from . import audit as audit_mod
 from . import saturation
+from . import snapshot as snapshot_mod
 from . import telemetry
 from . import tracing
 from . import wire
@@ -160,6 +161,12 @@ class ServiceConfig:
     data_center: str = ""
     persist_store: object = None  # Store SPI
     loader: object = None  # Loader SPI
+    # Durability plane (snapshot.py): path of the crash-safe columnar
+    # device-state snapshot file ("" = disabled — the pre-durability
+    # daemon, every restart a full reset).  Written on close()/SIGTERM
+    # and every behaviors.snapshot_interval_s; restored at boot with
+    # ONE monotone merge-commit.  Env: GUBER_SNAPSHOT.
+    snapshot_path: str = ""
     clock: Clock = field(default_factory=lambda: DEFAULT_CLOCK)
     metrics: Optional[Metrics] = None
     devices: Optional[list] = None
@@ -1058,8 +1065,34 @@ class V1Service:
         self._closed = False
 
         if conf.loader is not None:
-            for item in conf.loader.load():
-                self.store.load_item(item)
+            # Loader SPI over the columnar path (store.go:49-58 call
+            # pattern, one device commit instead of one row scatter per
+            # item): the whole load() stream merges in a single
+            # gather+scatter program via the reshard monotone merge.
+            # Stores without the columnar commit keep the legacy
+            # one-placement-per-item path.
+            items = list(conf.loader.load())
+            if items and hasattr(self.store, "commit_transfer"):
+                self.store.commit_transfer(
+                    snapshot_mod.items_to_columns(items),
+                    self.clock.now_ms(),
+                )
+            else:
+                for item in items:
+                    self.store.load_item(item)
+        # Durability plane (snapshot.py): restore the last crash-safe
+        # device-state snapshot (one H2D merge-commit; corrupt files
+        # reject loudly to a cold start), then run the background save
+        # cadence.  Restore happens BEFORE the batchers/gateway serve
+        # traffic; the monotone merge makes even a late restore safe
+        # (it can never un-spend hits already admitted).
+        self.snapshots = snapshot_mod.SnapshotManager(
+            self,
+            path=getattr(conf, "snapshot_path", "") or "",
+            interval_s=getattr(conf.behaviors, "snapshot_interval_s", 0.0),
+        )
+        self.snapshots.restore()
+        self.snapshots.start()
 
         self.local_batcher = LocalBatcher(
             self.store, conf.behaviors, self.clock, metrics=self.metrics
@@ -2639,6 +2672,7 @@ class V1Service:
                 "compiles": telemetry.compile_count(),
                 "steadyRecompiles": telemetry.steady_recompile_count(),
             },
+            "snapshot": self.snapshots.snapshot(),
         }
         return status
 
@@ -2715,6 +2749,21 @@ class V1Service:
                         + getattr(self.conf.behaviors, "reshard_handoff_s", 2.0)
                     )
                     handoff = True
+                elif (
+                    self.serves_reshard
+                    and self.snapshots.restored_ring_hash
+                    and self.snapshots.restored_ring_hash != self.ring_hash
+                ):
+                    # BOOTSTRAP call, but the restored snapshot was
+                    # saved under a DIFFERENT membership (snapshot.py
+                    # ring fencing): the restore kept every key, so
+                    # drain the ones this daemon no longer owns and ship
+                    # them through the ordinary transfer path.  No
+                    # double-dispatch window — there is no previous
+                    # picker; the handoff itself is the ordinary
+                    # drain -> transfer pass against the new ring.
+                    self.snapshots.restored_ring_hash = None
+                    handoff = True
             gen, rh = self.ring_generation, self.ring_hash
 
         # Handoff FIRST, then dropped-peer shutdowns: both ride the
@@ -2752,6 +2801,12 @@ class V1Service:
         self.reshard.close(timeout_s=5.0)
         self._forward_pool.shutdown(wait=False)
         self._slow_pool.shutdown(wait=False)
+        # Durability plane: stop the interval cadence, then take the
+        # final shutdown snapshot while the store is still alive — the
+        # SIGTERM/deploy path of the zero-downtime-restart contract
+        # (cmd/server.py routes SIGTERM through Daemon.close to here).
+        self.snapshots.stop()
+        self.snapshots.save_now("close")
         if self.conf.loader is not None:
             self.conf.loader.save(self.store.snapshot_items())
         for peer in self.get_peer_list() + list(self.region_picker.peers()):

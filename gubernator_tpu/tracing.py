@@ -242,7 +242,7 @@ _events = _Ring(EVENT_RING_CAPACITY)
 _DUMP_KINDS = frozenset({"breaker-open", "shed", "fault",
                          "global-send-failed", "slo-fast-burn",
                          "reshard-aborted", "recompile-storm",
-                         "audit-violation"})
+                         "audit-violation", "snapshot-rejected"})
 _DUMP_MIN_INTERVAL_S = 5.0
 _last_dump = [0.0]
 _dump_lock = threading.Lock()

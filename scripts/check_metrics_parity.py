@@ -95,6 +95,11 @@ EXTENSIONS = frozenset(
         "gubernator_audit_violations",
         "gubernator_audit_checks",
         "gubernator_audit_ledger",
+        # PR 10: durability plane (snapshot.py)
+        "gubernator_snapshot_writes",
+        "gubernator_snapshot_restores",
+        "gubernator_snapshot_lanes",
+        "gubernator_snapshot_age_seconds",
     }
 )
 

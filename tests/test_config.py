@@ -75,6 +75,30 @@ def test_discovery_type_validation():
         setup_daemon_config(env={"GUBER_PEER_DISCOVERY_TYPE": "zookeeper"})
 
 
+def test_snapshot_knobs():
+    conf = setup_daemon_config(env={
+        "GUBER_SNAPSHOT": "/var/lib/gub.snap",
+        "GUBER_SNAPSHOT_INTERVAL": "30s",
+    })
+    assert conf.snapshot_path == "/var/lib/gub.snap"
+    assert conf.behaviors.snapshot_interval_s == pytest.approx(30.0)
+    # Boolean-flavored opt-outs read as DISABLED, never as a filename.
+    for v in ("0", "false", "off", "no", ""):
+        assert setup_daemon_config(
+            env={"GUBER_SNAPSHOT": v}
+        ).snapshot_path == ""
+    # Defaults: disabled path, 1m cadence; 0 = shutdown-only is legal,
+    # negative is loud.
+    conf = setup_daemon_config(env={})
+    assert conf.snapshot_path == ""
+    assert conf.behaviors.snapshot_interval_s == pytest.approx(60.0)
+    assert setup_daemon_config(
+        env={"GUBER_SNAPSHOT_INTERVAL": "0"}
+    ).behaviors.snapshot_interval_s == 0.0
+    with pytest.raises(ValueError, match="GUBER_SNAPSHOT_INTERVAL"):
+        setup_daemon_config(env={"GUBER_SNAPSHOT_INTERVAL": "-5s"})
+
+
 def test_parse_duration_go_strings():
     """Full Go time.ParseDuration unit set, incl. compound values."""
     cases = {

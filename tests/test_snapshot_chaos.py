@@ -1,0 +1,249 @@
+"""Durability chaos: kill-tested recovery (the ISSUE 10 acceptance
+scenarios).
+
+A REAL daemon subprocess is SIGKILLed — mid-traffic and, separately,
+mid-snapshot-write — then restarted on the same address with the same
+snapshot file, and the recovered state is asserted MONOTONE-BOUNDED:
+
+  * spend recovered from the snapshot is at least everything admitted
+    before the last completed snapshot (no un-spend beyond the
+    documented staleness slack) and at most everything ever admitted
+    (no minted hits),
+  * expired buckets do not resurrect,
+  * a kill -9 at ANY instant of the temp+fsync+rename sequence leaves
+    the previous snapshot intact and loadable,
+  * GUBER_SNAPSHOT=0 reproduces the pre-durability full reset, and a
+    graceful SIGTERM restart restores the spend EXACTLY
+    (zero-downtime deploy),
+  * the restarted daemon's conservation audit stays silent.
+
+`make chaos` runs these (chaos marker); the daemon-subprocess ones are
+additionally slow-marked so tier-1 stays fast.
+"""
+
+import json
+import os
+import random
+import re
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from gubernator_tpu import snapshot as snap
+from gubernator_tpu.client import V1Client
+from gubernator_tpu.types import (
+    GetRateLimitsRequest,
+    RateLimitRequest,
+    Status,
+)
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIMIT = 1000
+DURATION_MS = 600_000
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(addr: str, snapshot_path: str, interval_ms: int = 100,
+           snapshot_on: bool = True) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO, ".jax_cache")
+    env["GUBER_HTTP_ADDRESS"] = addr
+    env["GUBER_SNAPSHOT"] = snapshot_path if snapshot_on else "0"
+    env["GUBER_SNAPSHOT_INTERVAL"] = str(interval_ms)
+    # Keep startup lean: small cache, one warm shape.
+    env["GUBER_CACHE_SIZE"] = "4096"
+    env["GUBER_WARMUP_SHAPES"] = "1,250"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gubernator_tpu.cmd.server"],
+        stdout=subprocess.PIPE, text=True, env=env, cwd=REPO,
+    )
+    deadline = time.monotonic() + 240
+    for line in proc.stdout:
+        if re.search(r"listening on http://", line):
+            return proc
+        if time.monotonic() > deadline:
+            break
+    proc.kill()
+    raise RuntimeError("daemon never printed its listening line")
+
+
+def _stop(proc: subprocess.Popen, sig=signal.SIGTERM) -> None:
+    if proc.poll() is None:
+        proc.send_signal(sig)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def _req(key, hits, limit=LIMIT, duration=DURATION_MS):
+    return RateLimitRequest(
+        name="chaos", unique_key=key, hits=hits, limit=limit,
+        duration=duration,
+    )
+
+
+def _hit(client, key, hits, **kw) -> int:
+    r = client.get_rate_limits(
+        GetRateLimitsRequest(requests=[_req(key, hits, **kw)])
+    ).responses[0]
+    assert r.error == "" and r.status == Status.UNDER_LIMIT
+    return r.remaining
+
+
+def _debug(addr: str, doc: str) -> dict:
+    with urllib.request.urlopen(f"http://{addr}/debug/{doc}", timeout=10) as f:
+        return json.loads(f.read())
+
+
+@pytest.mark.slow
+def test_kill9_mid_traffic_recovers_monotone_bounded(tmp_path):
+    """SIGKILL under live traffic: the restarted daemon serves from the
+    last completed snapshot, bounded by the staleness slack — and the
+    audit ledger stays clean."""
+    addr = f"127.0.0.1:{_free_port()}"
+    path = str(tmp_path / "chaos.snap")
+    proc = _spawn(addr, path, interval_ms=100)
+    try:
+        client = V1Client(addr, timeout_s=60.0)
+        # Phase A: admitted spend that MUST survive (a snapshot interval
+        # completes after it).
+        for _ in range(5):
+            r_a = _hit(client, "k_mono", hits=10)
+        assert r_a == LIMIT - 50
+        # A short-lived bucket that must NOT resurrect after the crash.
+        _hit(client, "k_expire", hits=5, duration=1_500)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if os.path.exists(path):
+                cols, _ = snap.read_snapshot(path)
+                spent = {
+                    k: LIMIT - int(cols.remaining[i])
+                    for i, k in enumerate(cols.keys)
+                }
+                if any("k_mono" in k for k in cols.keys) and max(
+                    (v for k, v in spent.items() if "k_mono" in k), default=0
+                ) >= 50:
+                    break
+            time.sleep(0.05)
+        # Phase B: the staleness slack — admitted after the snapshot we
+        # just observed, may or may not make a later snapshot.
+        r_b = _hit(client, "k_mono", hits=30)
+        assert r_b == LIMIT - 80
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+
+        proc = _spawn(addr, path, interval_ms=100)
+        client = V1Client(addr, timeout_s=60.0)
+        status = _debug(addr, "status")
+        assert status["snapshot"]["restore"] == "ok"
+        assert status["snapshot"]["restoredLanes"] >= 1
+        r_post = _hit(client, "k_mono", hits=0)
+        spent = LIMIT - r_post
+        # Monotone-bounded: everything snapshotted (>= phase A) came
+        # back; nothing was minted (<= everything admitted).
+        assert 50 <= spent <= 80, (
+            f"recovered spend {spent} outside the [50, 80] slack band"
+        )
+        # Expired bucket did not resurrect: a fresh read starts full.
+        assert _hit(client, "k_expire", hits=0, duration=1_500) == LIMIT
+        # Conservation audit silent on the recovered daemon.
+        assert _debug(addr, "audit")["violationTotal"] == 0
+    finally:
+        _stop(proc)
+
+
+@pytest.mark.slow
+def test_sigterm_restart_is_zero_downtime_and_knob_off_resets(tmp_path):
+    """Graceful restart restores the spend EXACTLY; the same sequence
+    under GUBER_SNAPSHOT=0 reproduces the pre-durability full reset
+    (the legacy failure class, proven still present behind the knob)."""
+    addr = f"127.0.0.1:{_free_port()}"
+    path = str(tmp_path / "deploy.snap")
+    # -- knob ON: deploy-style SIGTERM restart, exact restore ----------
+    proc = _spawn(addr, path, interval_ms=0)  # shutdown-only snapshots
+    try:
+        client = V1Client(addr, timeout_s=60.0)
+        assert _hit(client, "k_deploy", hits=77) == LIMIT - 77
+        _stop(proc, signal.SIGTERM)
+        cols, _ = snap.read_snapshot(path)  # the close() snapshot
+        assert len(cols) >= 1
+        proc = _spawn(addr, path, interval_ms=0)
+        client = V1Client(addr, timeout_s=60.0)
+        assert _hit(client, "k_deploy", hits=0) == LIMIT - 77
+        # -- knob OFF: same restart, state gone (full reset) -----------
+        _stop(proc, signal.SIGTERM)
+        proc = _spawn(addr, path, snapshot_on=False)
+        client = V1Client(addr, timeout_s=60.0)
+        assert _debug(addr, "status")["snapshot"]["enabled"] is False
+        assert _hit(client, "k_deploy", hits=0) == LIMIT
+    finally:
+        _stop(proc)
+
+
+WRITER_LOOP = r"""
+import sys, numpy as np
+from gubernator_tpu.reshard import TransferColumns
+from gubernator_tpu.snapshot import write_snapshot
+
+path = sys.argv[1]
+gen = 0
+print("WRITING", flush=True)
+while True:
+    n = 64 + (gen % 3) * 37  # vary size so renames change length
+    cols = TransferColumns(
+        keys=[f"g{gen}_k{i}" for i in range(n)],
+        algorithm=np.zeros(n, np.int32), status=np.zeros(n, np.int32),
+        limit=np.full(n, 100, np.int64),
+        remaining=np.full(n, gen % 100, np.int64),
+        duration=np.full(n, 60000, np.int64),
+        stamp=np.full(n, 1, np.int64),
+        expire_at=np.full(n, 10**15, np.int64),
+    )
+    write_snapshot(path, cols, saved_at_ms=gen)
+    gen += 1
+"""
+
+
+def test_kill9_mid_write_leaves_previous_snapshot_loadable(tmp_path):
+    """SIGKILL a process hammering write_snapshot at random instants:
+    the snapshot path must read back a COMPLETE generation every time
+    (the rename is the commit point; a torn temp is never the file)."""
+    path = str(tmp_path / "torn.snap")
+    rng = random.Random(0xC0FFEE)
+    for round_ in range(4):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-c", WRITER_LOOP, path],
+            stdout=subprocess.PIPE, text=True, env=env, cwd=REPO,
+        )
+        try:
+            assert proc.stdout.readline().strip() == "WRITING"
+            time.sleep(rng.uniform(0.02, 0.35))
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+        finally:
+            _stop(proc)
+        # Whatever instant the kill landed on, the file is a complete,
+        # checksum-valid snapshot of exactly one generation.
+        cols, meta = snap.read_snapshot(path)
+        gens = {k.split("_")[0] for k in cols.keys}
+        assert len(gens) == 1, f"torn across generations: {gens}"
+        assert len(cols) in (64, 101, 138)
+        assert int(cols.remaining[0]) == meta["saved_at_ms"] % 100
