@@ -1,5 +1,5 @@
 # Reference Makefile:1-35 equivalents for the TPU build.
-.PHONY: test tier1 chaos bench bench-gate soak soak-smoke proto certs docker release clean
+.PHONY: test tier1 chaos bench bench-gate soak soak-smoke soak-regions proto certs docker release clean
 
 # The whole suite on the virtual 8-device CPU mesh (conftest.py forces
 # it); -p no:cacheprovider keeps runs hermetic like -count=1.
@@ -60,6 +60,18 @@ soak-smoke:
 # documented GLOBAL slack, negative remaining).
 soak:
 	env JAX_PLATFORMS=cpu python scripts/soak.py --minutes 3
+
+# The 2x2 multi-region soak (ISSUE 11's acceptance topology): two
+# 2-daemon regions (distinct GUBER_DATA_CENTER), MULTI_REGION lanes
+# replicating cross-region through the federation plane
+# (federation.py) with the inter-region wire under an always-on
+# seeded WAN shape (FaultPlan latency/jitter/loss), WAN storms
+# (effective partitions) injected and healed against one region at a
+# time, and membership churn rotating WITHIN regions so each region
+# reshards independently.  Same audit-silence gate as `make soak`,
+# plus the region ledger must have moved (the plane demonstrably ran).
+soak-regions:
+	env JAX_PLATFORMS=cpu python scripts/soak.py --minutes 3 --regions 2x2
 
 proto:
 	bash scripts/proto.sh

@@ -990,6 +990,95 @@ def measure_global_plane(mode: str = "columns", n_threads: int = 2,
         }
 
 
+def measure_region_plane(mode: str = "columns", n_threads: int = 4,
+                         iters: int = 2, batch: int = 4096) -> float:
+    """Loopback cross-region federation-plane throughput
+    (federation.py): the remote region's owner daemon runs in its OWN
+    process (own GIL, as in production — the measure_peer_forward
+    rule) and this process plays the origin region's FederationManager
+    flush, driving one federation.RegionBatch per send at it:
+
+      * "columns" — region_columns=True against a
+        GUBER_REGION_COLUMNS=1 receiver: ONE GUBC kind-7 frame per
+        flush, decoded and applied as ONE columnar batch.
+      * "classic" — region_columns=False against a
+        GUBER_REGION_COLUMNS=0 receiver (exactly a pre-federation
+        peer): the sticky per-item GetPeerRateLimits chunk train,
+        per-item decode into the receive path — the whole pre-PR
+        plane, no probe burned (the knob pins the client classic).
+
+    A FRESH RegionBatch per send reproduces the per-flush encode (the
+    encode-ONCE win is across the region fan-out, not across
+    flushes), and `batch` is sized like a production flush (thousands
+    of aggregated keys): the classic wire's 1000-item per-RPC cap
+    (behaviors.batch_limit) forces a chunk train there while ONE
+    kind-7 frame carries the whole flush — at small batches both fit
+    one RPC and the ratio collapses to transport noise (measured 0.97
+    at 512 vs 4.65 at 4096 on the 2-core dev box).  Both daemons
+    CPU-pinned (wire/decode cost, not device weather).  Returns
+    key-lanes/s over the best epoch; the same-run
+    region_plane_vs_classic gate ratio divides the two modes."""
+    import threading
+
+    from gubernator_tpu.config import BehaviorConfig
+    from gubernator_tpu.federation import RegionBatch, RegionColumns
+    from gubernator_tpu.peer_client import PeerClient
+    from gubernator_tpu.types import PeerInfo
+
+    columns = mode == "columns"
+    with contextlib.ExitStack() as stack:
+        owner_http, owner_grpc = stack.enter_context(_bench_daemon(
+            extra_env={
+                "GUBER_REGION_COLUMNS": "1" if columns else "0",
+                "GUBER_DATA_CENTER": "bench-remote",
+            },
+            what="remote-region daemon",
+        ))
+        behaviors = BehaviorConfig(
+            batch_timeout_s=30.0, region_columns=columns
+        )
+        client = PeerClient(
+            PeerInfo(
+                grpc_address=f"127.0.0.1:{owner_grpc}",
+                http_address=f"127.0.0.1:{owner_http}",
+            ),
+            behaviors,
+        )
+        # LIFO: the client drains before the daemon it talks to exits.
+        stack.callback(client.shutdown, timeout_s=2.0)
+        cols = RegionColumns(
+            origin="bench-origin",
+            names=["rp"] * batch,
+            unique_keys=[f"bench:{i}" for i in range(batch)],
+            algorithm=np.zeros(batch, np.int32),
+            behavior=np.zeros(batch, np.int32),
+            hits=np.ones(batch, np.int64),
+            limit=np.full(batch, 1_000_000, np.int64),
+            duration=np.full(batch, 3_600_000, np.int64),
+        )
+
+        def send():
+            # Fresh batch = fresh encode caches, the per-flush cost.
+            client.update_region_columns(RegionBatch(cols), timeout_s=30.0)
+
+        def worker():
+            for _ in range(iters):
+                send()
+
+        send()  # warm: negotiation + receiver pad-bucket compiles
+        best_rate = 0.0
+        for _ in range(3):
+            ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            dt = time.perf_counter() - t0
+            best_rate = max(best_rate, batch * iters * n_threads / dt)
+        return best_rate
+
+
 def measure_ingress_columns(mode: str = "columns", n_threads: int = 8,
                             iters: int = 8, batch: int = 1000) -> float:
     """Public-ingress throughput over the REAL wire against a daemon in
@@ -1261,6 +1350,19 @@ def gate() -> int:
             )
         except Exception as e:  # noqa: BLE001 — two-daemon spawn can fail
             print(f"gate global_plane_vs_classic: SKIP (measure failed: {e})")
+    if "region_plane_vs_classic" not in rows:
+        try:
+            rp_cols = measure_region_plane("columns")
+            rp_classic = measure_region_plane("classic")
+            # Same-run ratio: both legs back-to-back against identical
+            # subprocess receivers, so host weather cancels.
+            rows["region_plane_vs_classic"] = rp_cols / max(rp_classic, 1.0)
+            print(
+                f"gate region plane rows: columnar {rp_cols:.0f} lanes/s, "
+                f"classic {rp_classic:.0f} lanes/s"
+            )
+        except Exception as e:  # noqa: BLE001 — two-daemon spawn can fail
+            print(f"gate region_plane_vs_classic: SKIP (measure failed: {e})")
     if "snapshot_restore_ms" not in rows:
         try:
             snap_row = measure_snapshot()
@@ -1512,6 +1614,11 @@ def main():
     global_plane_ratio = global_plane["plane_items_per_sec"] / max(
         global_plane_classic["plane_items_per_sec"], 1.0
     )
+
+    # ---- multi-region federation plane: loopback cross-region sends --
+    region_plane_cps = measure_region_plane("columns")
+    region_plane_classic_cps = measure_region_plane("classic")
+    region_plane_ratio = region_plane_cps / max(region_plane_classic_cps, 1.0)
     _leg("peer_and_global_plane")
 
     # Re-save with the ingress + peer-forward rows so --gate covers
@@ -1530,6 +1637,7 @@ def main():
         "ingress_columns_checks_per_sec": ingress_columns_cps,
         "ingress_columns_vs_json": ingress_columns_ratio,
         "global_plane_vs_classic": global_plane_ratio,
+        "region_plane_vs_classic": region_plane_ratio,
         "dispatch_overlap_ratio": dispatch_overlap_ratio,
         # None (unobservable: telemetry off / listener absent) is kept
         # out of the saved rows so --gate SKIPs instead of passing a
@@ -1607,6 +1715,11 @@ def main():
                     global_plane_classic["forwarded_hits_per_sec"], 1
                 ),
                 "global_plane_vs_classic": round(global_plane_ratio, 2),
+                "region_plane_lanes_per_sec": round(region_plane_cps, 1),
+                "region_plane_classic_lanes_per_sec": round(
+                    region_plane_classic_cps, 1
+                ),
+                "region_plane_vs_classic": round(region_plane_ratio, 2),
                 "batch_size": batch_size,
                 "batch_latency_ms_median": round(batch_latency_ms, 2),
                 "batch_latency_n_samples": len(lat),

@@ -36,6 +36,23 @@ hits, which is what makes the reconciliation meaningful):
   snapshot_saved_lanes    lanes gathered into a completed snapshot dump
   snapshot_loaded_lanes   lanes decoded from a snapshot file at boot
   snapshot_committed_lanes lanes merge-committed by the boot restore
+  region_agg_hits         MULTI_REGION hits admitted toward a remote
+                          region at flush (new lanes only, counted per
+                          destination region; carried lanes were
+                          counted the flush they first aggregated)
+  region_sent_hits        region hits delivered to a remote owner (ok)
+  region_dropped_hits     region hits dropped counted (timeout-shaped
+                          sends that may have applied remotely, carry
+                          overflow, departed regions)
+  region_admitted_hits    region hits handed to the wire per logical
+                          send (federation.RegionBatch)
+  region_wire_hits        region hits that REACHED a peer, per
+                          transport delivery (success or
+                          timeout-ambiguous; provably-unapplied
+                          failures do not count)
+  region_recv_hits        hits decoded from a received
+                          UpdateRegionColumns batch
+  region_applied_hits     region hits the receiver applied locally
   negative_remaining      decoded lanes with remaining < 0 (device
                           arithmetic corruption; must stay 0)
 
@@ -55,7 +72,24 @@ break) trips it:
   snapshot_restore       snapshot_committed      <= snapshot_loaded
                          (a restore can only drop lanes — expired in
                          transit, duplicate keys — never mint them)
+  region_conservation    region_wire_hits        <= region_admitted_hits
+                         (the federation plane's exactly-once chain,
+                         sender side: a DUPLICATE re-delivery on the
+                         region wire doubles the wire side and fires)
+  region_delivery        region_sent + dropped   <= region_agg_hits
+  region_apply           region_applied_hits     <= region_recv_hits
+  region_slack           region carry keys       <= REGION_CARRY_MAX
+                         (federation.py's documented bounded-loss
+                         slack per destination region, summed)
   negative_remaining     negative_remaining      == 0
+
+The federation chain "origin-admitted >= wire-reached >= remote-applied"
+is audited as SIDE-LOCAL pairs: admitted/wire on the sender,
+recv/applied on the receiver.  In an in-process multi-daemon soak the
+shared ledger additionally keeps the cross-daemon inequality
+(wire >= recv) true by construction; across real processes each daemon
+reconciles only its own pairs, so a receiver is never falsely blamed
+for hits whose admit note lives in another process.
 
 A FaultPlan DUPLICATE rule (faults.py) — the injectable model of a
 network/proxy re-delivering an applied RPC — makes the sender count
@@ -101,6 +135,13 @@ COUNTERS = (
     "snapshot_saved_lanes",
     "snapshot_loaded_lanes",
     "snapshot_committed_lanes",
+    "region_agg_hits",
+    "region_sent_hits",
+    "region_dropped_hits",
+    "region_admitted_hits",
+    "region_wire_hits",
+    "region_recv_hits",
+    "region_applied_hits",
     "negative_remaining",
 )
 
@@ -163,6 +204,13 @@ INVARIANTS = {
     "snapshot_restore": (
         ("snapshot_committed_lanes",), ("snapshot_loaded_lanes",), 0,
     ),
+    "region_conservation": (
+        ("region_wire_hits",), ("region_admitted_hits",), 0,
+    ),
+    "region_delivery": (
+        ("region_sent_hits", "region_dropped_hits"), ("region_agg_hits",), 0,
+    ),
+    "region_apply": (("region_applied_hits",), ("region_recv_hits",), 0),
     "negative_remaining": (("negative_remaining",), (), 0),
 }
 
@@ -172,11 +220,22 @@ INVARIANTS = {
 # the architecture documents no longer holds.
 GLOBAL_CARRY_GAUGE = "global_carry_keys"
 
+# The federation requeue-carry bound (federation.REGION_CARRY_MAX),
+# checked the same way: carry beyond the cap means the documented
+# bounded-loss contract of the region plane no longer holds.
+REGION_CARRY_GAUGE = "region_carry_keys"
+
 
 def _carry_cap() -> int:
     from .service import GlobalManager
 
     return GlobalManager.HIT_CARRY_MAX
+
+
+def _region_carry_cap() -> int:
+    from .federation import REGION_CARRY_MAX
+
+    return REGION_CARRY_MAX
 
 
 class Auditor:
@@ -292,6 +351,17 @@ class Auditor:
                     "excess": excess,
                     "lhs": {GLOBAL_CARRY_GAUGE: int(carry)},
                     "rhs": {"HIT_CARRY_MAX": _carry_cap()},
+                })
+        rcarry = gauges_snapshot().get(REGION_CARRY_GAUGE)
+        if rcarry is not None and rcarry > _region_carry_cap():
+            excess = int(rcarry) - _region_carry_cap()
+            if excess > self._violation_extents.get("region_slack", 0):
+                self._violation_extents["region_slack"] = excess
+                found.append({
+                    "invariant": "region_slack",
+                    "excess": excess,
+                    "lhs": {REGION_CARRY_GAUGE: int(rcarry)},
+                    "rhs": {"REGION_CARRY_MAX": _region_carry_cap()},
                 })
         self.checks += 1
         self.last_check_monotonic = self._time()

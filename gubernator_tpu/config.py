@@ -108,9 +108,27 @@ class BehaviorConfig:
     # pre-columns sender fanned out serially).  Env: GUBER_GLOBAL_FANOUT.
     global_fanout: int = 8
 
+    # -- multi-region federation plane (federation.py) -----------------
+    # Per-send deadline of one cross-region batch.
+    # Env: GUBER_MULTI_REGION_TIMEOUT.
     multi_region_timeout_s: float = 0.5
+    # Flush window of the per-region accumulator: MULTI_REGION hits
+    # aggregate per key for this long, then one encode-once batch fans
+    # to every remote region's owners.  Env: GUBER_MULTI_REGION_SYNC_WAIT.
     multi_region_sync_wait_s: float = 0.1
+    # Queue-full early flush (multiregion.go batching semantics): the
+    # accumulator flushes IMMEDIATELY when it holds this many distinct
+    # keys instead of waiting out the window.  0 disables the early
+    # kick (window-only flushes).  Env: GUBER_MULTI_REGION_BATCH_LIMIT.
     multi_region_batch_limit: int = 1000
+    # Columnar inter-region wire (the GUBC region frame / proto
+    # RegionColumnsReq served as PeersV1/UpdateRegionColumns).  False
+    # disables BOTH directions — sends use the classic per-item
+    # GetPeerRateLimits encoding (byte-identical to the pre-federation
+    # sender) and the region surface is withheld so peers see
+    # UNIMPLEMENTED/404, exactly like a pre-federation daemon (the
+    # mixed-version interop mode).  Env: GUBER_REGION_COLUMNS.
+    region_columns: bool = True
 
     # -- peer fault tolerance (faults.py) ------------------------------
     # Per-peer circuit breaker: this many consecutive transport
@@ -503,11 +521,28 @@ def setup_daemon_config(
     b.multi_region_timeout_s = _env_float_ms(
         merged, "GUBER_MULTI_REGION_TIMEOUT", b.multi_region_timeout_s
     )
+    if b.multi_region_timeout_s <= 0:
+        raise ValueError("GUBER_MULTI_REGION_TIMEOUT must be > 0")
     b.multi_region_sync_wait_s = _env_float_ms(
         merged, "GUBER_MULTI_REGION_SYNC_WAIT", b.multi_region_sync_wait_s
     )
+    if b.multi_region_sync_wait_s <= 0:
+        raise ValueError("GUBER_MULTI_REGION_SYNC_WAIT must be > 0")
     b.multi_region_batch_limit = _env_int(
         merged, "GUBER_MULTI_REGION_BATCH_LIMIT", b.multi_region_batch_limit
+    )
+    # The federation accumulator HONORS the limit as its queue-full
+    # early flush (0 = window-only); a negative value is a config bug,
+    # not a mode (and >MAX_BATCH_SIZE would make the CLASSIC fallback
+    # chunks unsendable to a pre-federation peer).
+    if b.multi_region_batch_limit < 0:
+        raise ValueError("GUBER_MULTI_REGION_BATCH_LIMIT must be >= 0")
+    if b.multi_region_batch_limit > MAX_BATCH_SIZE:
+        raise ValueError(
+            f"GUBER_MULTI_REGION_BATCH_LIMIT cannot exceed '{MAX_BATCH_SIZE}'"
+        )
+    b.region_columns = _env_bool(
+        merged, "GUBER_REGION_COLUMNS", b.region_columns
     )
     b.circuit_threshold = _env_int(
         merged, "GUBER_CIRCUIT_THRESHOLD", b.circuit_threshold

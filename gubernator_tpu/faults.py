@@ -217,6 +217,19 @@ ERROR = "error"
 # one guarded call (breaker sees one call; the duplicate's own failure
 # is swallowed — a dropped duplicate is just a clean network again).
 DUPLICATE = "duplicate"
+# WAN: the wide-area link shape — every matching call pays a seeded
+# normal-ish latency (mean latency_s, stddev jitter_s, clamped at 0)
+# and a seeded fraction `loss` of calls is lost outright.  A lost call
+# presents timeout-shaped (DROP: the request — or its RESPONSE — died
+# in transit, so the RPC may have applied remotely and the caller must
+# not blind-retry).  The surviving calls resolve to ordinary DELAY
+# actions, so every existing interception point applies a WAN rule
+# with no new handling (gossip's delay-eats-ack-budget rule included).
+# All draws come from the plan's per-(peer, op) seeded streams: the
+# same seed yields the same loss pattern AND the same latency series,
+# which is what lets the 2x2 region soak replay a WAN weather system
+# deterministically.
+WAN = "wan"
 
 # Known interception points (the `op` a rule matches against):
 #   GetPeerRateLimits / UpdatePeerGlobals  — PeerClient data-plane RPCs
@@ -255,6 +268,12 @@ class FaultRule:
     delay_s: float = 0.0
     not_ready: bool = True
     message: str = ""
+    # WAN-shape parameters (kind=WAN only): per-call latency drawn
+    # from N(latency_s, jitter_s) clamped at 0, and `loss` = seeded
+    # probability the call is lost (timeout-shaped DROP).
+    latency_s: float = 0.0
+    jitter_s: float = 0.0
+    loss: float = 0.0
     # Times this rule decided a call's fate (FaultPlan.intercept bumps
     # it under the plan lock).  Lives on the rule itself so the count
     # can never be confused with another rule's after heal() frees one.
@@ -283,13 +302,19 @@ class FaultAction:
 class FaultPlan:
     """A seedable, ordered fault plan.
 
-    Rules are evaluated in insertion order; the first rule whose
+    Rules are evaluated MOST-SPECIFIC-FIRST: an exact `peer` beats
+    peer="*", then an exact `op` beats op="*"; equally specific rules
+    keep insertion order.  Within that order the first rule whose
     (peer, op) matches, whose per-(rule, peer, op) call window is
     active, and whose seeded RNG draw passes `rate` decides the call's
-    fate.  Per-(peer, op) call counters advance on EVERY intercepted
-    call, so "the Nth RPC to peer X" is well-defined regardless of how
-    many rules exist.  All state is behind one lock: a plan is shared
-    by every PeerClient in the process when installed globally.
+    fate — so a per-victim storm or `partition(victim)` laid over a
+    steady peer="*" WAN shape takes effect instead of being shadowed
+    by the earlier wildcard (the 2x2 region soak's layering), and
+    healing the specific rule falls back to the steady shape.
+    Per-(peer, op) call counters advance on EVERY intercepted call, so
+    "the Nth RPC to peer X" is well-defined regardless of how many
+    rules exist.  All state is behind one lock: a plan is shared by
+    every PeerClient in the process when installed globally.
     """
 
     def __init__(self, seed: Optional[int] = None):
@@ -349,6 +374,26 @@ class FaultPlan:
                       after=after, count=count)
         )
 
+    def wan(self, peer: str = "*", op: str = "*", latency_s: float = 0.05,
+            jitter_s: float = 0.01, loss: float = 0.0,
+            rate: float = 1.0) -> FaultRule:
+        """Shape matching RPCs like a wide-area link until healed:
+        every call pays a seeded normal-ish delay (mean `latency_s`,
+        stddev `jitter_s`, clamped at 0) and a seeded `loss` fraction
+        is lost outright (timeout-shaped — the call may have applied
+        remotely, so callers must not blind-retry; the federation
+        sender drops those hits COUNTED).  The 2x2 region soak installs
+        one of these per inter-region (peer, op) pair and heals it to
+        model a WAN partition ending."""
+        if not 0.0 <= loss <= 1.0:
+            raise ValueError(f"loss must be within [0, 1], got {loss}")
+        if latency_s < 0.0 or jitter_s < 0.0:
+            raise ValueError("latency_s/jitter_s must be >= 0")
+        return self.add(FaultRule(
+            peer=peer, op=op, kind=WAN, rate=rate,
+            latency_s=latency_s, jitter_s=jitter_s, loss=loss,
+        ))
+
     def heal(self, peer: str = "*", op: str = "*") -> int:
         """Remove matching rules (the partition ends, the peer returns).
         Returns how many rules were removed.  Call counters are kept:
@@ -382,7 +427,15 @@ class FaultPlan:
                 rng = self._rngs[key] = random.Random(
                     f"{self.seed}:{peer}:{op}" if self.seed is not None else None
                 )
-            for rule in self._rules:
+            # Most-specific-first (stable, so equal specificity keeps
+            # insertion order): exact peer beats "*", then exact op —
+            # a per-victim storm/partition layered over a steady
+            # peer="*" WAN rule must win, not be shadowed by it.
+            ordered = sorted(
+                self._rules,
+                key=lambda r: (r.peer == "*", r.op == "*"),
+            )
+            for rule in ordered:
                 if rule.kind in exclude:
                     continue
                 if not rule.matches(peer, op):
@@ -394,6 +447,33 @@ class FaultPlan:
                 if rule.rate < 1.0 and rng.random() >= rule.rate:
                     continue
                 rule.fired_count += 1
+                if rule.kind == WAN:
+                    # Resolve the WAN shape to an ordinary DROP/DELAY
+                    # action HERE, from the same per-(peer, op) seeded
+                    # stream as the rate draw — interception points
+                    # need no WAN-specific handling and the loss
+                    # pattern + latency series replay under a seed.
+                    # Draw ORDER is part of the wire format of a seed:
+                    # loss first, then latency only for survivors.
+                    if rule.loss > 0.0 and rng.random() < rule.loss:
+                        return FaultAction(
+                            kind=DROP, not_ready=False,
+                            message=rule.message or (
+                                f"injected wan loss (peer {peer}, "
+                                f"op {op}, call #{n})"
+                            ),
+                        )
+                    return FaultAction(
+                        kind=DELAY,
+                        delay_s=max(
+                            0.0, rng.gauss(rule.latency_s, rule.jitter_s)
+                        ),
+                        not_ready=rule.not_ready,
+                        message=rule.message or (
+                            f"injected wan latency (peer {peer}, "
+                            f"op {op}, call #{n})"
+                        ),
+                    )
                 msg = rule.message or (
                     f"injected {rule.kind} (peer {peer}, op {op}, call #{n})"
                 )

@@ -371,6 +371,33 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
             )
         if path == "/debug/profile":
             return _debug_profile(raw)
+        if (path == "/v1/peer.UpdateRegionColumns"
+                and service.serves_region_columns):
+            # Cross-region federation receive (federation.py): GUBC
+            # region frame in, ONE columnar apply.  A daemon with the
+            # plane off (GUBER_REGION_COLUMNS=0) never reaches here —
+            # it falls through to the 404 below, exactly what a
+            # pre-federation build answers, which is the sender's
+            # version probe (sticky classic fallback to the per-item
+            # GetPeerRateLimits path).
+            with service.metrics.observe_rpc(
+                "/pb.gubernator.PeersV1/UpdateRegionColumns"
+            ):
+                if not wire.is_region_frame(raw):
+                    raise ApiError(
+                        "InvalidArgument",
+                        "UpdateRegionColumns expects a GUBC region frame",
+                    )
+                try:
+                    cols = wire.decode_region_frame(raw)
+                except ValueError as e:
+                    raise ApiError(
+                        "InvalidArgument", f"invalid region frame: {e}"
+                    ) from e
+                applied = service.update_region_columns(cols)
+            return 200, "application/json", _json_bytes(
+                {"applied": applied}
+            )
         if path == "/v1/peer.TransferOwnership" and service.serves_reshard:
             # Ownership-transfer receive (elastic membership): GUBC
             # transfer frame in, ONE batched merge-commit.  A daemon

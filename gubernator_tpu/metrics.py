@@ -174,6 +174,39 @@ class Metrics:
             "would double-count) or requeue-carry overflow.",
             registry=self.registry,
         )
+        # -- multi-region federation plane (federation.py) -------------
+        self.region_batches = Counter(
+            "gubernator_region_batches",
+            "Cross-region hit batches sent by negotiated wire encoding "
+            "(columns = encode-once RegionColumns fast path, classic = "
+            "per-item GetPeerRateLimits fallback to a pre-federation "
+            "peer or GUBER_REGION_COLUMNS=0).",
+            ["encoding"],
+            registry=self.registry,
+        )
+        self.region_carry_keys = Gauge(
+            "gubernator_region_carry_keys",
+            "Distinct keys in the federation requeue carry, summed over "
+            "destination regions (bounded at federation.REGION_CARRY_MAX "
+            "per region; the region_slack audit invariant checks it).",
+            registry=self.registry,
+        )
+        self.region_requeued_hits = Counter(
+            "gubernator_region_requeued_hits",
+            "Aggregated cross-region hit lanes (one per key) requeued "
+            "into a destination region's next flush after a "
+            "provably-unapplied send failure (breaker fast-fail, "
+            "connection-level not-ready, unroutable owner).",
+            registry=self.registry,
+        )
+        self.region_dropped_hits = Counter(
+            "gubernator_region_dropped_hits",
+            "Aggregated cross-region hit lanes dropped counted: "
+            "timeout-shaped send failures that may have applied "
+            "remotely (re-sending would double-count), requeue-carry "
+            "overflow, or a destination region leaving the membership.",
+            registry=self.registry,
+        )
         # -- bounded ingress queue (service._IngressGate) --------------
         self.ingress_shed = Counter(
             "gubernator_ingress_shed_total",

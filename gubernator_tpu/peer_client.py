@@ -139,6 +139,7 @@ class PeerClient:
         self._rpc_update_peer_globals = None
         self._rpc_update_peer_globals_columns = None
         self._rpc_transfer_ownership = None
+        self._rpc_update_region_columns = None
         self._shutdown = threading.Event()
         self._err_lock = threading.Lock()
         self._last_err: Dict[str, float] = {}  # message -> expiry timestamp
@@ -164,6 +165,15 @@ class PeerClient:
         # the client's lifetime, like _columnar.
         self._globals_columnar: Optional[bool] = (
             None if getattr(self.behaviors, "global_columns", True) else False
+        )
+        # Multi-region federation negotiation (federation.py), on its
+        # own GUBER_REGION_COLUMNS knob: None = untried (the first
+        # region send probes the columnar encoding), True = peer takes
+        # RegionColumns, False = classic per-item GetPeerRateLimits
+        # only (pre-federation peer, or its knob is off) — sticky for
+        # the client's lifetime like the other planes.
+        self._region_columnar: Optional[bool] = (
+            None if getattr(self.behaviors, "region_columns", True) else False
         )
         # Ownership-transfer plane negotiation (reshard.py), on its own
         # GUBER_RESHARD knob: None = untried (the first transfer
@@ -447,6 +457,157 @@ class PeerClient:
             "/v1/peer.UpdatePeerGlobals", batch.classic_json_bytes(),
             timeout_s, "application/json",
         )
+
+    # ------------------------------------------------------------------
+    def update_region_columns(
+        self, batch, timeout_s: Optional[float] = None, trace_ctx=None,
+    ) -> None:
+        """One cross-region hit send from a pre-encoded
+        federation.RegionBatch (encode-once fan-out: every region's
+        owner reuses the same cached wire bytes).  Encoding negotiates
+        per peer like the other planes: proto columns (gRPC
+        UpdateRegionColumns) / the GUBC kind-7 frame (HTTP,
+        /v1/peer.UpdateRegionColumns) first; a peer that answers
+        UNIMPLEMENTED / 404 is remembered as classic-only and resent
+        the per-item GetPeerRateLimits encoding — the exact
+        pre-federation wire — inside the same guarded call, so the
+        probe is breaker- and health-neutral.
+
+        Conservation accounting (audit.py): the batch's hits are noted
+        `region_admitted_hits` once per logical send here, and
+        `region_wire_hits` once per delivery that reached the peer
+        (the guarded call's wire counter) — a FaultPlan DUPLICATE
+        delivery doubles the wire side and trips region_conservation."""
+        if self._shutdown.is_set():
+            raise PeerError(ERR_CLOSING, not_ready=True)
+        hits = batch.total_hits()
+        audit.note("region_admitted_hits", hits)
+        t0 = time.monotonic_ns()
+        rpc_err: Optional[Exception] = None
+        try:
+            if self.transport == "http":
+                self._guarded_call(
+                    "UpdateRegionColumns",
+                    lambda: self._post_region_inner(batch, timeout_s),
+                    wire_hits=hits, wire_counter="region_wire_hits",
+                )
+            else:
+                self._guarded_call(
+                    "UpdateRegionColumns",
+                    lambda: self._grpc_region_inner(batch, timeout_s),
+                    wire_hits=hits, wire_counter="region_wire_hits",
+                )
+        except Exception as e:  # noqa: BLE001 — re-raised below
+            rpc_err = e
+            raise
+        finally:
+            if trace_ctx is not None:
+                bt = tracing.new_batch([trace_ctx])
+                if bt is not None:
+                    attrs = dict(
+                        peer=self.info.grpc_address,
+                        op="UpdateRegionColumns",
+                        lanes=len(batch),
+                        encoding=(
+                            "columns" if self._region_columnar else "classic"
+                        ),
+                    )
+                    if rpc_err is not None:
+                        attrs["error"] = str(rpc_err)
+                    tracing.record_span(
+                        "peer.rpc", bt.ctx,
+                        start_ns=t0, end_ns=time.monotonic_ns(),
+                        links=bt.links, **attrs,
+                    )
+        if self._metrics is not None:
+            self._metrics.region_batches.labels(
+                encoding="columns" if self._region_columnar else "classic"
+            ).inc()
+
+    def _grpc_region_inner(self, batch, timeout_s: Optional[float]) -> None:
+        """Columnar UpdateRegionColumns over gRPC, falling back to the
+        classic per-item GetPeerRateLimits chunks on UNIMPLEMENTED (the
+        method never executed, so the classic resend cannot
+        double-apply).  A classic chunk train that fails AFTER a chunk
+        applied is no longer retry-safe: the error is re-shaped
+        timeout-like (not_ready=False) so the sender drops counted
+        instead of requeueing a partially-applied batch."""
+        timeout = (
+            timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
+        )
+        try:
+            get_rl, _upd, _get_cols, _upd_cols = self._ensure_channel()
+            with self._conn_lock:
+                rpc = self._rpc_update_region_columns
+            if rpc is None:  # torn down by a concurrent reset
+                raise PeerError(ERR_CLOSING, not_ready=True)
+            if self._region_columnar is not False:
+                try:
+                    rpc(batch.columns_pb(), timeout=timeout)
+                    self._region_columnar = True
+                    return
+                except grpc.RpcError as e:
+                    code = e.code() if hasattr(e, "code") else None
+                    if code == grpc.StatusCode.UNIMPLEMENTED:
+                        self._region_columnar = False
+                    else:
+                        raise
+            applied_any = False
+            try:
+                for m in batch.classic_pb_chunks(self._classic_cap):
+                    get_rl(m, timeout=timeout)
+                    applied_any = True
+            except grpc.RpcError as e:
+                err = self._wrap_grpc_error("UpdateRegionColumns", e)
+                if applied_any:
+                    err.not_ready = False
+                raise err from e
+        except PeerError:
+            raise
+        except grpc.RpcError as e:
+            raise self._wrap_grpc_error("UpdateRegionColumns", e) from e
+        except ValueError as e:
+            raise self._wrap_value_error("UpdateRegionColumns", e) from e
+
+    def _post_region_inner(self, batch, timeout_s: Optional[float]) -> None:
+        """Region send over HTTP: the GUBC kind-7 frame against
+        /v1/peer.UpdateRegionColumns.  An old peer (or
+        GUBER_REGION_COLUMNS=0) has no handler on that path — 404,
+        provably unapplied — so the classic per-item JSON resend to
+        /v1/peer.GetPeerRateLimits inside this same guarded call is
+        safe and the probe stays breaker/health-neutral.  Same
+        partial-apply rule as the gRPC twin: a chunk-train failure
+        after an applied chunk presents timeout-shaped."""
+        if self._region_columnar is not False:
+            try:
+                self._http_roundtrip(
+                    "/v1/peer.UpdateRegionColumns", batch.frame(), timeout_s,
+                    wire.COLUMNS_CONTENT_TYPE,
+                )
+                self._region_columnar = True
+                return
+            except PeerError as e:
+                rejected = e.http_status in (400, 404, 415, 501) or (
+                    e.http_status == 500 and "codec can't decode" in str(e)
+                )
+                if not rejected:
+                    raise
+                self._region_columnar = False
+                # A benign version probe, not a peer failure: it must
+                # not leave HealthCheck unhealthy for 5 minutes.
+                self._clear_last_err(str(e))
+        applied_any = False
+        try:
+            for body in batch.classic_json_chunks(self._classic_cap):
+                self._http_roundtrip(
+                    "/v1/peer.GetPeerRateLimits", body, timeout_s,
+                    "application/json",
+                )
+                applied_any = True
+        except PeerError as e:
+            if applied_any:
+                e.not_ready = False
+            raise
 
     # ------------------------------------------------------------------
     def transfer_ownership(
@@ -767,6 +928,11 @@ class PeerClient:
                     request_serializer=pc_pb.TransferColumnsReq.SerializeToString,
                     response_deserializer=pc_pb.TransferResp.FromString,
                 )
+                self._rpc_update_region_columns = self._channel.unary_unary(
+                    f"/{PEERS_V1_SERVICE}/UpdateRegionColumns",
+                    request_serializer=pc_pb.RegionColumnsReq.SerializeToString,
+                    response_deserializer=pc_pb.RegionColumnsResp.FromString,
+                )
             return (
                 self._rpc_get_peer_rate_limits,
                 self._rpc_update_peer_globals,
@@ -832,7 +998,8 @@ class PeerClient:
         )
         raise PeerError(msg, not_ready=act.not_ready)
 
-    def _attempt(self, fn, wire_hits: int):
+    def _attempt(self, fn, wire_hits: int,
+                 wire_counter: str = "forward_wire_hits"):
         """One transport delivery, conservation-accounted: the attempt
         counts its hits into the audit ledger when it REACHED the peer —
         a normal return, or a failure past the point of no return (a
@@ -840,21 +1007,23 @@ class PeerClient:
         Provably-unapplied failures (connection-level not_ready, the
         breaker's own fast-fail) never left this host, so they don't
         count — which is exactly why a legitimate retry/re-pick after
-        one keeps `forward_wire_hits <= forward_admitted_hits` intact
-        while a DUPLICATE delivery breaks it."""
+        one keeps `wire <= admitted` intact while a DUPLICATE delivery
+        breaks it.  `wire_counter` names the ledger counter (the
+        forward hop and the region plane keep separate pairs)."""
         try:
             out = fn()
         except BaseException as e:
             if wire_hits and not (
                 isinstance(e, PeerError) and e.not_ready
             ):
-                audit.note("forward_wire_hits", wire_hits)
+                audit.note(wire_counter, wire_hits)
             raise
         if wire_hits:
-            audit.note("forward_wire_hits", wire_hits)
+            audit.note(wire_counter, wire_hits)
         return out
 
-    def _guarded_call(self, op: str, fn, check=None, wire_hits: int = 0):
+    def _guarded_call(self, op: str, fn, check=None, wire_hits: int = 0,
+                      wire_counter: str = "forward_wire_hits"):
         """The breaker protocol, shared by BOTH transports: gate ->
         injected-fault check -> fn() -> optional reply check -> record.
         Every non-raising _breaker_gate() pairs with exactly one
@@ -864,18 +1033,22 @@ class PeerClient:
         breaker failure like any transport error, instead of resetting
         the failure streak before the caller notices.  `wire_hits` is
         the batch's hit total for the conservation ledger (audit.py):
-        counted once per delivery that reached the peer."""
+        counted once per delivery that reached the peer, into
+        `wire_counter`."""
         self._breaker_gate(op)
         try:
             dup = self._fault_check(op)
-            out = fn() if not wire_hits else self._attempt(fn, wire_hits)
+            out = (
+                fn() if not wire_hits
+                else self._attempt(fn, wire_hits, wire_counter)
+            )
             if dup:
                 # The injected re-delivery: the duplicate's OWN failure
                 # is swallowed (a dropped duplicate is a clean network
                 # again) and its result discarded — but its hits reached
                 # the peer, which the ledger must see.
                 try:
-                    self._attempt(fn, wire_hits)
+                    self._attempt(fn, wire_hits, wire_counter)
                 except Exception:  # noqa: BLE001 — duplicate lost in flight
                     pass
             if check is not None:
@@ -987,6 +1160,7 @@ class PeerClient:
                 self._rpc_get_peer_rate_limits_columns = None
                 self._rpc_update_peer_globals_columns = None
                 self._rpc_transfer_ownership = None
+                self._rpc_update_region_columns = None
 
     # ------------------------------------------------------------------
     # HTTP/JSON fallback transport (the peer's gateway surface)

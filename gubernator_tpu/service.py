@@ -32,6 +32,7 @@ from . import wire
 from .reshard import ReshardManager, TransferColumns
 from .config import MAX_BATCH_SIZE, PEER_COLUMNS_MAX_LANES, BehaviorConfig
 from .faults import Backoff
+from .federation import FederationManager
 from .metrics import Metrics
 from .parallel.global_mgr import GlobalsColumns, HitColumns
 from .parallel.hash_ring import ReplicatedConsistentHash
@@ -1124,7 +1125,7 @@ class V1Service:
         self.auditor.start()
         self._started_monotonic = time.monotonic()
         self.global_mgr = GlobalManager(self)
-        self.multi_region_mgr = MultiRegionManager(self)
+        self.multi_region_mgr = FederationManager(self)
 
     # ------------------------------------------------------------------
     @property
@@ -1189,6 +1190,22 @@ class V1Service:
         drain/commit pair."""
         return getattr(self.conf.behaviors, "reshard", True) and hasattr(
             self.store, "commit_transfer"
+        )
+
+    @property
+    def serves_region_columns(self) -> bool:
+        """Whether this daemon SPEAKS the columnar inter-region wire —
+        the single rule both transport edges consult (gRPC
+        UpdateRegionColumns registration, gateway path gate), so
+        mixed-version negotiation can never diverge per transport.
+        False under the GUBER_REGION_COLUMNS opt-out (the
+        pre-federation interop mode: senders see UNIMPLEMENTED / 404 —
+        exactly what a pre-federation daemon answers — and fall back
+        sticky to the classic per-item GetPeerRateLimits encoding,
+        which this daemon serves like any peer receive) and for stores
+        without columnar support."""
+        return getattr(self.conf.behaviors, "region_columns", True) and getattr(
+            self.store, "supports_columns", False
         )
 
     def get_peer(self, key: str) -> PeerClient:
@@ -2477,6 +2494,62 @@ class V1Service:
         for u in cols.to_updates():
             self.store.set_replica(u, now)
 
+    def update_region_columns(self, cols) -> int:
+        """Receive side of the multi-region federation plane
+        (federation.py): one cross-region hit batch (RegionColumnsReq /
+        the GUBC region frame) applied locally through the SAME
+        columnar receive path a classic per-item GetPeerRateLimits
+        send lands in — so the columnar and classic encodings are
+        behavior-identical by construction, only the wire differs.
+
+        The sender already stripped MULTI_REGION from the behavior
+        column (the no-amplification rule: applying must not re-queue
+        the hits toward other regions), and the receiver TRUSTS that
+        contract defensively: any lane still flagged is re-stripped
+        here, because an echo loop between two regions is strictly
+        worse than one misbehaving sender.
+
+        Conservation ledger (audit.py): the batch's hits note
+        `region_recv_hits` at decode and `region_applied_hits` for the
+        lanes that applied without error — `region_apply` keeps
+        applied <= recv.  Returns the applied lane count."""
+        n = len(cols)
+        if n > PEER_COLUMNS_MAX_LANES:
+            raise ApiError(
+                "OutOfRange",
+                f"'UpdateRegionColumns' columns list too large; "
+                f"max size is '{PEER_COLUMNS_MAX_LANES}'",
+            )
+        if n == 0:
+            return 0
+        hits = np.asarray(cols.hits, dtype=np.int64)
+        audit_mod.note("region_recv_hits", int(hits.sum()))
+        beh = np.asarray(cols.behavior, dtype=np.int32)
+        mr = int(Behavior.MULTI_REGION)
+        if bool((beh & mr).any()):
+            beh = beh & ~np.int32(mr)
+        ic = IngressColumns(
+            names=list(cols.names),
+            unique_keys=list(cols.unique_keys),
+            algorithm=np.asarray(cols.algorithm, dtype=np.int32),
+            behavior=beh,
+            hits=hits,
+            limit=np.asarray(cols.limit, dtype=np.int64),
+            duration=np.asarray(cols.duration, dtype=np.int64),
+        )
+        result = self.get_peer_rate_limits_columns(
+            ic, max_lanes=PEER_COLUMNS_MAX_LANES
+        )
+        errored = [
+            i for i, r in result.overrides.items()
+            if getattr(r, "error", "")
+        ]
+        applied = n - len(errored)
+        applied_hits = int(hits.sum()) - sum(int(hits[i]) for i in errored)
+        if applied_hits > 0:
+            audit_mod.note("region_applied_hits", applied_hits)
+        return applied
+
     def transfer_ownership(self, cols: "TransferColumns") -> "tuple[int, int]":
         """Receive side of an ownership transfer (elastic membership,
         reshard.py): fence the epoch, drop lanes this daemon does not
@@ -2591,6 +2664,10 @@ class V1Service:
             peer_list = list(self.local_picker.peers()) + list(
                 self.region_picker.peers()
             )
+            region_rings = {
+                dc: list(ring.peers())
+                for dc, ring in self.region_picker.regions.items()
+            }
             handoff_active = self._handoff_prev_picker() is not None
             ring = {
                 "generation": self.ring_generation,
@@ -2673,6 +2750,24 @@ class V1Service:
                 "steadyRecompiles": telemetry.steady_recompile_count(),
             },
             "snapshot": self.snapshots.snapshot(),
+            # Multi-region federation plane (federation.py): this
+            # daemon's data center, the accumulator/carry state, and
+            # per-remote-region peer + breaker counts — what the soak's
+            # 2x2 topology and scripts/cluster_status.py read.
+            "region": {
+                **self.multi_region_mgr.snapshot(),
+                "regions": {
+                    dc: {
+                        "peers": len(plist),
+                        "breakerOpen": sum(
+                            1 for p in plist
+                            if getattr(p, "breaker", None) is not None
+                            and p.breaker.is_open
+                        ),
+                    }
+                    for dc, plist in region_rings.items()
+                },
+            },
         }
         return status
 
@@ -3225,72 +3320,3 @@ class GlobalManager:
         self._interval.stop()
         if self._fanout_pool is not None:
             self._fanout_pool.shutdown(wait=False)
-
-
-class MultiRegionManager:
-    """MULTI_REGION hit pipeline (multiregion.go:8-83).  The reference's
-    send is an acknowledged stub (multiregion.go:79-83 TODOs); here the
-    aggregated hits ARE pushed to the owning peer of every OTHER region,
-    honoring those TODOs."""
-
-    def __init__(self, service: V1Service):
-        self.service = service
-        self._lock = threading.Lock()
-        self._hits: Dict[str, RateLimitRequest] = {}
-        self._stopped = False
-        self._interval = Interval(
-            service.conf.behaviors.multi_region_sync_wait_s, self._tick
-        )
-        self._interval.next()
-
-    def _tick(self) -> None:
-        try:
-            self.run_once()
-        finally:
-            if not self._stopped:
-                self._interval.next()
-
-    def queue_hits(self, r: RateLimitRequest) -> None:
-        """Aggregate by hash key, summing hits (multiregion.go:37-47)."""
-        with self._lock:
-            key = r.hash_key()
-            cur = self._hits.get(key)
-            if cur is None:
-                self._hits[key] = replace(r)
-            else:
-                cur.hits += r.hits
-
-    def run_once(self) -> None:
-        with self._lock:
-            hits, self._hits = self._hits, {}
-        if not hits:
-            return
-        svc = self.service
-        my_dc = svc.conf.data_center
-        by_peer: Dict[str, List[RateLimitRequest]] = {}
-        clients: Dict[str, PeerClient] = {}
-        # Strip MULTI_REGION on the wire: the receiving region applies
-        # the hits but must not re-queue them, or two regions push the
-        # same hits back and forth forever (each origin already fans
-        # out to every other region itself).
-        for key, r in hits.items():
-            wire = replace(r, behavior=set_behavior(r.behavior, Behavior.MULTI_REGION, False))
-            for peer in svc.get_region_picker().get_clients(key):
-                if peer is None or peer.info.data_center == my_dc:
-                    continue
-                addr = peer.info.grpc_address
-                by_peer.setdefault(addr, []).append(wire)
-                clients[addr] = peer
-        for addr, reqs in by_peer.items():
-            svc._peer_send(
-                "multi_region",
-                partial(
-                    clients[addr].get_peer_rate_limits,
-                    GetRateLimitsRequest(requests=reqs),
-                    timeout_s=svc.conf.behaviors.multi_region_timeout_s,
-                ),
-            )
-
-    def stop(self) -> None:
-        self._stopped = True
-        self._interval.stop()

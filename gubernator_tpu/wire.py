@@ -1233,6 +1233,125 @@ def transfer_cols_from_pb(m) -> "object":
     )
 
 
+# ---- Multi-region federation (federation.py) -------------------------
+# Cross-region hit replication batch (architecture.md "Multi-region
+# federation"): per-key summed MULTI_REGION hits + the origin region's
+# id, shipped to each remote region's owner:
+#   * proto columns (RegionColumnsReq) served as the gRPC
+#     PeersV1/UpdateRegionColumns method;
+#   * a GUBC frame (kind 7) POSTed to /v1/peer.UpdateRegionColumns on
+#     the HTTP transport.
+# A peer without the region surface answers UNIMPLEMENTED / 404 —
+# provably unapplied — and the sender falls back sticky to the classic
+# per-item GetPeerRateLimits encoding (exactly the pre-federation
+# wire; GUBER_REGION_COLUMNS=0 forces it, golden-tested
+# byte-identical).
+
+_FRAME_KIND_REGION = 7
+
+
+def is_region_frame(raw: bytes) -> bool:
+    return is_columns_frame(raw) and raw[5] == _FRAME_KIND_REGION
+
+
+def encode_region_frame(cols) -> bytes:
+    """federation.RegionColumns -> binary region frame: GUBC header
+    (kind 7) + `u32 origin_len | origin utf-8` + the seven kind-1
+    request columns (names/unique_keys string columns, algo/behavior
+    i32, hits/limit/duration i64)."""
+    n = len(cols.names)
+    origin = cols.origin.encode("utf-8")
+    return b"".join(
+        (
+            FRAME_MAGIC,
+            struct.pack("<BBI", FRAME_VERSION, _FRAME_KIND_REGION, n),
+            struct.pack("<I", len(origin)),
+            origin,
+            _pack_str_column(cols.names),
+            _pack_str_column(cols.unique_keys),
+            np.ascontiguousarray(cols.algorithm, dtype=np.int32).tobytes(),
+            np.ascontiguousarray(cols.behavior, dtype=np.int32).tobytes(),
+            np.ascontiguousarray(cols.hits, dtype=np.int64).tobytes(),
+            np.ascontiguousarray(cols.limit, dtype=np.int64).tobytes(),
+            np.ascontiguousarray(cols.duration, dtype=np.int64).tobytes(),
+        )
+    )
+
+
+def decode_region_frame(raw: bytes):
+    """Binary region frame -> federation.RegionColumns.  Raises
+    ValueError on a malformed/foreign frame (the gateway maps it to a
+    400)."""
+    from .federation import RegionColumns
+
+    if not is_columns_frame(raw):
+        raise ValueError("not a columns frame")
+    version, kind, n = struct.unpack_from("<BBI", raw, 4)
+    if version != FRAME_VERSION or kind != _FRAME_KIND_REGION:
+        raise ValueError(
+            f"unsupported region frame (version={version}, kind={kind})"
+        )
+    pos = _FRAME_HEADER_LEN
+    try:
+        (origin_len,) = struct.unpack_from("<I", raw, pos)
+    except struct.error:
+        raise ValueError("columns frame truncated") from None
+    pos += 4
+    origin_b = raw[pos:pos + origin_len]
+    if len(origin_b) != origin_len:
+        raise ValueError("columns frame truncated")
+    try:
+        origin = origin_b.decode("utf-8")
+    except UnicodeDecodeError:
+        raise ValueError("region frame origin is not valid utf-8") from None
+    pos += origin_len
+    no, nb, pos = _read_str_blob(raw, pos, n)
+    uo, ub, pos = _read_str_blob(raw, pos, n)
+    algo, pos = _read_array(raw, pos, np.int32, n)
+    beh, pos = _read_array(raw, pos, np.int32, n)
+    hits, pos = _read_array(raw, pos, np.int64, n)
+    limit, pos = _read_array(raw, pos, np.int64, n)
+    duration, pos = _read_array(raw, pos, np.int64, n)
+    if pos != len(raw):
+        raise ValueError("columns frame length mismatch")
+    return RegionColumns(
+        origin=origin,
+        names=[nb[no[i]:no[i + 1]].decode("utf-8") for i in range(n)],
+        unique_keys=[ub[uo[i]:uo[i + 1]].decode("utf-8") for i in range(n)],
+        algorithm=algo, behavior=beh,
+        hits=hits, limit=limit, duration=duration,
+    )
+
+
+def region_cols_to_pb(cols) -> "pc_pb.RegionColumnsReq":
+    m = pc_pb.RegionColumnsReq()
+    m.origin = cols.origin
+    m.names.extend(cols.names)
+    m.unique_keys.extend(cols.unique_keys)
+    m.algorithm.extend(np.asarray(cols.algorithm, dtype=np.int32).tolist())
+    m.behavior.extend(np.asarray(cols.behavior, dtype=np.int32).tolist())
+    m.hits.extend(np.asarray(cols.hits, dtype=np.int64).tolist())
+    m.limit.extend(np.asarray(cols.limit, dtype=np.int64).tolist())
+    m.duration.extend(np.asarray(cols.duration, dtype=np.int64).tolist())
+    return m
+
+
+def region_cols_from_pb(m) -> "object":
+    from .federation import RegionColumns
+
+    n = len(m.names)
+    return RegionColumns(
+        origin=m.origin,
+        names=list(m.names),
+        unique_keys=list(m.unique_keys),
+        algorithm=np.fromiter(m.algorithm, np.int32, count=n),
+        behavior=np.fromiter(m.behavior, np.int32, count=n),
+        hits=np.fromiter(m.hits, np.int64, count=n),
+        limit=np.fromiter(m.limit, np.int64, count=n),
+        duration=np.fromiter(m.duration, np.int64, count=n),
+    )
+
+
 def update_global_to_pb(u: UpdatePeerGlobal) -> peers_pb.UpdatePeerGlobal:
     return peers_pb.UpdatePeerGlobal(
         key=u.key, status=resp_to_pb(u.status), algorithm=int(u.algorithm)

@@ -95,6 +95,11 @@ EXTENSIONS = frozenset(
         "gubernator_audit_violations",
         "gubernator_audit_checks",
         "gubernator_audit_ledger",
+        # PR 11: multi-region federation plane (federation.py)
+        "gubernator_region_batches",
+        "gubernator_region_carry_keys",
+        "gubernator_region_requeued_hits",
+        "gubernator_region_dropped_hits",
         # PR 10: durability plane (snapshot.py)
         "gubernator_snapshot_writes",
         "gubernator_snapshot_restores",

@@ -2,8 +2,10 @@
 """Cluster status poller: render every daemon's GET /debug/status as
 one table — the whole-cluster view of the saturation & SLO plane
 (health, breaker state, bucket-table occupancy, ingress queue, SLO
-burn).  The soak harness (make soak-smoke, tests/test_soak_smoke.py)
-asserts against the same JSON doc this renders.
+burn) plus the federation plane (data center, remote-region rings with
+breaker-open marks, carry depth, last-flush age).  The soak harness
+(make soak-smoke, tests/test_soak_smoke.py) asserts against the same
+JSON doc this renders.
 
 Usage:
     python scripts/cluster_status.py HOST:PORT [HOST:PORT ...]
@@ -26,7 +28,8 @@ import urllib.request
 
 COLUMNS = ("daemon", "health", "peers", "brk-open", "ring", "handoff",
            "occupancy", "evict", "queue", "shed", "burn-5m", "burn-1h",
-           "audit", "recompiles", "hot-key")
+           "audit", "recompiles", "dc", "regions", "carry", "flush-age",
+           "hot-key")
 
 
 def fetch_status(addr: str, timeout_s: float = 5.0) -> dict:
@@ -40,6 +43,7 @@ def summarize(addr: str, doc: dict) -> dict:
     ingress = doc.get("ingress", {})
     slo = doc.get("slo", {})
     hot = doc.get("hotkeys") or []
+    region = doc.get("region", {})
     ring = doc.get("ring", {})
     reshard = ring.get("reshard", {})
     # gen@hash (short), e.g. "3@13db0387"; handoff column shows the
@@ -51,6 +55,19 @@ def summarize(addr: str, doc: dict) -> dict:
         handoff_cell = f"aborts:{reshard['transfersAborted']}"
     else:
         handoff_cell = "-"
+    # Federation plane (PR 11): remote-region ring sizes with their
+    # breaker-open counts, e.g. "eu:2 us:2!1" (! = open breakers), plus
+    # the carry depth and last-flush age a stalled WAN link shows up in.
+    remotes = region.get("regions", {})
+    if region.get("dataCenter") and remotes:
+        regions_cell = " ".join(
+            f"{dc}:{st.get('peers', 0)}"
+            + (f"!{st['breakerOpen']}" if st.get("breakerOpen") else "")
+            for dc, st in sorted(remotes.items())
+        )
+    else:
+        regions_cell = "-"
+    flush_age = region.get("lastFlushAgeS")
     return {
         "daemon": addr,
         "health": doc.get("health", {}).get("status", "?"),
@@ -73,6 +90,15 @@ def summarize(addr: str, doc: dict) -> dict:
         "recompiles": (
             doc.get("xla", {}).get("steadyRecompiles", 0)
             if doc.get("xla", {}).get("enabled", False) else "-"
+        ),
+        "dc": region.get("dataCenter") or "-",
+        "regions": regions_cell,
+        "carry": (
+            region.get("carryKeyTotal", 0)
+            if region.get("dataCenter") else "-"
+        ),
+        "flush-age": (
+            f"{flush_age}s" if flush_age is not None else "-"
         ),
         "hot-key": hot[0]["key"] if hot else "-",
     }

@@ -128,6 +128,26 @@ def build() -> bytes:
     _field(tresp, "committed", 1, F.TYPE_INT64, label=F.LABEL_OPTIONAL)
     _field(tresp, "rejected", 2, F.TYPE_INT64, label=F.LABEL_OPTIONAL)
 
+    # Multi-region federation (federation.py): one cross-region hit
+    # batch — per-key summed MULTI_REGION hits plus the origin region's
+    # GUBER_DATA_CENTER, the behavior column with MULTI_REGION already
+    # stripped (the receiver applies, never re-queues).  Served as
+    # PeersV1/UpdateRegionColumns.
+    rc = fd.message_type.add()
+    rc.name = "RegionColumnsReq"
+    _field(rc, "origin", 1, F.TYPE_STRING, label=F.LABEL_OPTIONAL)
+    _field(rc, "names", 2, F.TYPE_STRING)
+    _field(rc, "unique_keys", 3, F.TYPE_STRING)
+    _field(rc, "algorithm", 4, F.TYPE_INT32)
+    _field(rc, "behavior", 5, F.TYPE_INT32)
+    _field(rc, "hits", 6, F.TYPE_INT64)
+    _field(rc, "limit", 7, F.TYPE_INT64)
+    _field(rc, "duration", 8, F.TYPE_INT64)
+
+    rresp = fd.message_type.add()
+    rresp.name = "RegionColumnsResp"
+    _field(rresp, "applied", 1, F.TYPE_INT64, label=F.LABEL_OPTIONAL)
+
     return fd.SerializeToString()
 
 

@@ -19,6 +19,16 @@ drives it for minutes with:
 * **Membership churn** — periodically drops one daemon from everyone's
   peer list and re-adds it, driving ring deltas, the double-dispatch
   window, and reshard transfers.
+* **Multi-region federation** (`--regions RxD`, e.g. `2x2`) — the
+  daemons split into R regions of D (distinct GUBER_DATA_CENTER
+  labels), a slice of lanes turns MULTI_REGION so the federation plane
+  replicates cross-region, the inter-region wire runs under an
+  always-on seeded WAN shape (FaultPlan `wan`: normal-ish latency +
+  jitter + rate loss), fault events become WAN storms against one
+  region's daemons (heavy loss — an effective partition — injected
+  then healed), and churn rotates WITHIN a region so each region
+  reshards independently.  The exit gate additionally requires the
+  region ledger to have moved (the plane demonstrably ran).
 
 Trace-sampled (GUBER_TRACE_SAMPLE default 0.02) so
 scripts/trace_collect.py can stitch cross-daemon traces from the run.
@@ -73,6 +83,9 @@ def main() -> int:
                     help="partition duration seconds")
     ap.add_argument("--churn-every", type=float, default=45.0,
                     help="seconds between membership churn events (0=off)")
+    ap.add_argument("--regions", default="",
+                    help="RxD federation topology (e.g. 2x2 = two "
+                         "2-daemon regions); overrides --daemons")
     ap.add_argument("--smoke", action="store_true",
                     help="60s, 2 daemons, no churn (CI-speed)")
     args = ap.parse_args()
@@ -80,6 +93,16 @@ def main() -> int:
         args.minutes = 1.0
         args.daemons = 2
         args.churn_every = 0.0
+    n_regions, per_region = 0, 0
+    if args.regions:
+        try:
+            r, d = args.regions.lower().split("x")
+            n_regions, per_region = int(r), int(d)
+        except ValueError:
+            ap.error(f"--regions must look like 2x2, got {args.regions!r}")
+        if n_regions < 2 or per_region < 1:
+            ap.error("--regions needs >= 2 regions of >= 1 daemon")
+        args.daemons = n_regions * per_region
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.setdefault(
@@ -113,14 +136,30 @@ def main() -> int:
     faults.install(plan)
 
     deadline = time.time() + args.minutes * 60.0
+    # Region labels per daemon: "" (single-region, the pre-federation
+    # shape) unless --regions asked for an RxD split.
+    dcs = (
+        [f"region-{chr(97 + r)}"
+         for r in range(n_regions) for _ in range(per_region)]
+        if n_regions else [""] * args.daemons
+    )
     print(
-        f"soak: {args.daemons} daemons, {args.minutes:.1f} min, "
+        f"soak: {args.daemons} daemons"
+        + (f" in {n_regions} regions of {per_region}" if n_regions else "")
+        + f", {args.minutes:.1f} min, "
         f"zipf a={args.zipf_a} over {args.keys} keys, seed {args.seed}, "
         f"trace sample {args.trace_sample}"
     )
-    cl = Cluster().start_with([""] * args.daemons, behaviors=beh)
+    cl = Cluster().start_with(dcs, behaviors=beh)
     addrs = [d.gateway.address for d in cl.daemons]
     print(f"soak: gateways {addrs}")
+    if n_regions:
+        # Always-on WAN shape on the inter-region wire (the region op
+        # only matches cross-region sends, so local rings stay LAN).
+        plan.wan(op="UpdateRegionColumns",
+                 latency_s=0.02, jitter_s=0.005, loss=0.02)
+        print("soak: WAN shape on region wire "
+              "(20ms ± 5ms, 2% loss, seeded)")
 
     stop = threading.Event()
     lock = threading.Lock()
@@ -152,7 +191,10 @@ def main() -> int:
                         else Algorithm.LEAKY_BUCKET
                     ),
                     behavior=(
-                        int(Behavior.GLOBAL) if int(k) % 17 == 0 else 0
+                        int(Behavior.GLOBAL) if int(k) % 17 == 0
+                        else int(Behavior.MULTI_REGION)
+                        if n_regions and int(k) % 13 == 5
+                        else 0
                     ),
                 )
                 for j, k in enumerate(ids)
@@ -179,8 +221,10 @@ def main() -> int:
         t.start()
 
     failures: list = []
-    partition_until = 0.0
-    partitioned_rule = None
+    heal_at = None
+    heal_fault = None
+    fault_events = 0
+    churn_events = 0
     next_fault = time.time() + args.fault_every if args.fault_every else None
     next_churn = time.time() + args.churn_every if args.churn_every else None
     churned_idx = None
@@ -190,23 +234,67 @@ def main() -> int:
             time.sleep(args.poll_every)
             now = time.time()
             # -- fault scheduling --------------------------------------
-            if partitioned_rule is not None and now >= partition_until:
-                plan.heal(partitioned_rule.peer)
-                print(f"soak: healed partition of {partitioned_rule.peer}")
-                partitioned_rule = None
+            if heal_at is not None and now >= heal_at:
+                heal_fault()
+                heal_at, heal_fault = None, None
             if (next_fault is not None and now >= next_fault
-                    and partitioned_rule is None):
-                victim = cl.daemons[
-                    int(rng.randint(len(cl.daemons)))
-                ].peer_info.grpc_address
-                partitioned_rule = plan.partition(victim)
-                partition_until = now + args.fault_for
+                    and heal_at is None):
+                if n_regions and fault_events % 2 == 0:
+                    # WAN storm: near-total seeded loss on the region
+                    # wire TOWARD one region — an inter-region
+                    # partition the federation carry must ride out —
+                    # injected, then healed back to the steady WAN
+                    # shape (its peer="*" rule survives the per-peer
+                    # heal).
+                    region = int(rng.randint(n_regions))
+                    victims = [
+                        d.peer_info.grpc_address
+                        for d in cl.daemons[
+                            region * per_region:(region + 1) * per_region
+                        ]
+                    ]
+                    for v in victims:
+                        plan.wan(peer=v, op="UpdateRegionColumns",
+                                 latency_s=0.08, jitter_s=0.03, loss=0.9)
+
+                    def heal_fault(vs=tuple(victims),
+                                   label=chr(97 + region)) -> None:
+                        for v in vs:
+                            plan.heal(v, "UpdateRegionColumns")
+                        print(f"soak: healed WAN storm toward region-{label}")
+
+                    print(
+                        f"soak: WAN storm toward region-{chr(97 + region)} "
+                        f"({victims}) for {args.fault_for}s"
+                    )
+                else:
+                    victim = cl.daemons[
+                        int(rng.randint(len(cl.daemons)))
+                    ].peer_info.grpc_address
+                    plan.partition(victim)
+
+                    def heal_fault(v=victim) -> None:
+                        plan.heal(v)
+                        print(f"soak: healed partition of {v}")
+
+                    print(f"soak: partitioned {victim} for {args.fault_for}s")
+                heal_at = now + args.fault_for
+                fault_events += 1
                 next_fault = now + args.fault_every
-                print(f"soak: partitioned {victim} for {args.fault_for}s")
             if next_churn is not None and now >= next_churn:
                 next_churn = now + args.churn_every
                 if churned_idx is None:
-                    churned_idx = int(rng.randint(1, len(cl.daemons)))
+                    if n_regions and per_region >= 2:
+                        # Per-region churn: rotate regions, drop the
+                        # region's LAST member so its local ring
+                        # reshards while the other regions' ownership
+                        # stays put (the region-picker stability
+                        # property).
+                        region = churn_events % n_regions
+                        churned_idx = region * per_region + per_region - 1
+                        churn_events += 1
+                    else:
+                        churned_idx = int(rng.randint(1, len(cl.daemons)))
                     peers = [
                         p for j, p in enumerate(cl.peers) if j != churned_idx
                     ]
@@ -226,7 +314,7 @@ def main() -> int:
                 try:
                     aud = _fetch(addr, "/debug/audit")
                 except OSError as e:
-                    if partitioned_rule is None:
+                    if heal_at is None:
                         failures.append(f"{addr}: unreachable: {e}")
                     continue
                 if aud["violationTotal"]:
@@ -276,6 +364,17 @@ def main() -> int:
     )
     if reqs == 0:
         failures.append("soak made zero progress")
+    if n_regions:
+        # The topology must have EXERCISED the federation plane: a 2x2
+        # run whose region ledger never moved proves nothing about it.
+        # (The ledger is process-shared, so read it directly — it
+        # outlives the stopped cluster.)
+        from gubernator_tpu import audit as audit_ledger
+
+        if not audit_ledger.ledger_snapshot().get("region_sent_hits"):
+            failures.append(
+                "region plane made zero progress (region_sent_hits == 0)"
+            )
     if failures:
         print("soak: FAIL")
         for f in failures[:10]:

@@ -342,3 +342,135 @@ def test_gossip_probe_delay_eats_ack_timeout():
         assert plan.calls("127.0.0.1:9", faults.OP_GOSSIP_PROBE) == 1
     finally:
         g.close()
+
+
+# ----------------------------------------------------------------------
+# WAN rule (federation plane chaos: latency/jitter/loss)
+# ----------------------------------------------------------------------
+def test_wan_resolves_to_plain_delay_and_drop_actions():
+    """WAN resolves at intercept time to the ordinary action kinds, so
+    every interception point (PeerClient, gossip) applies it with no
+    WAN-specific handling."""
+    p = FaultPlan(seed=3)
+    p.wan("a:1", latency_s=0.05, jitter_s=0.0, loss=0.0)
+    act = p.intercept("a:1", "UpdateRegionColumns")
+    assert act.kind == faults.DELAY
+    assert act.delay_s == pytest.approx(0.05)
+
+    p2 = FaultPlan(seed=3)
+    p2.wan("a:1", latency_s=0.05, jitter_s=0.0, loss=1.0)
+    act = p2.intercept("a:1", "UpdateRegionColumns")
+    # A lost call is timeout-shaped: it may have applied remotely, so
+    # callers must not blind-retry (the federation sender drops these
+    # hits COUNTED rather than requeueing).
+    assert act.kind == faults.DROP
+    assert act.not_ready is False
+
+
+def test_wan_streams_are_seed_deterministic():
+    """Same seed -> the same loss pattern AND the same latency series,
+    per (peer, op) stream — the replayable WAN weather the 2x2 soak
+    leans on."""
+    def run(seed):
+        p = FaultPlan(seed=seed)
+        p.wan("a:1", latency_s=0.04, jitter_s=0.02, loss=0.3)
+        out = []
+        for _ in range(64):
+            act = p.intercept("a:1", "UpdateRegionColumns")
+            out.append(
+                ("drop",) if act.kind == faults.DROP
+                else ("delay", act.delay_s)
+            )
+        return out
+
+    a, b = run(11), run(11)
+    assert a == b
+    assert run(12) != a  # a different seed is different weather
+    kinds = {k for k, *_ in a}
+    assert kinds == {"drop", "delay"}  # loss=0.3 fires both ways
+    delays = [d for k, *rest in a for d in rest]
+    assert all(d >= 0.0 for d in delays)  # gauss clamped at 0
+    assert len(set(delays)) > 1  # jitter actually varies the latency
+
+
+def test_wan_streams_are_independent_per_peer_op():
+    """Concurrent calls to OTHER peers/ops must not perturb a stream
+    (the per-(peer, op) RNG rule every rate-gated kind shares)."""
+    p = FaultPlan(seed=5)
+    p.wan("*", latency_s=0.04, jitter_s=0.02, loss=0.3)
+    solo = FaultPlan(seed=5)
+    solo.wan("*", latency_s=0.04, jitter_s=0.02, loss=0.3)
+
+    seq = []
+    for i in range(32):
+        if i % 2:
+            p.intercept("other:1", "Noise")  # interleaved foreign draws
+        act = p.intercept("a:1", "UpdateRegionColumns")
+        seq.append(act.kind if act.kind == faults.DROP else act.delay_s)
+    expect = []
+    for _ in range(32):
+        act = solo.intercept("a:1", "UpdateRegionColumns")
+        expect.append(act.kind if act.kind == faults.DROP else act.delay_s)
+    assert seq == expect
+
+
+def test_wan_rate_gate_composes():
+    """rate<1 leaves a fraction of calls untouched (no delay at all) —
+    the WAN rule composes with the shared rate machinery."""
+    p = FaultPlan(seed=9)
+    p.wan("a:1", latency_s=0.01, jitter_s=0.0, loss=0.0, rate=0.5)
+    hits = sum(
+        1 for _ in range(200) if p.intercept("a:1", "Op") is not None
+    )
+    assert 60 < hits < 140  # ~half, seeded
+
+
+def test_wan_heal_removes_the_weather():
+    p = FaultPlan(seed=1)
+    p.wan("a:1", latency_s=0.01)
+    assert p.intercept("a:1", "Op") is not None
+    assert p.heal("a:1") == 1
+    assert p.intercept("a:1", "Op") is None
+
+
+def test_specific_rules_beat_wildcard_wan_shape():
+    """The 2x2 soak's layering: a steady peer="*" WAN shape installed
+    FIRST must not shadow a later per-victim storm or partition —
+    most-specific rule wins (exact peer beats "*", then exact op), and
+    healing the specific rule falls back to the steady shape."""
+    p = FaultPlan(seed=5)
+    steady = p.wan(op="UpdateRegionColumns", latency_s=0.02,
+                   jitter_s=0.0, loss=0.0)
+    # Storm: per-victim total loss layered over the steady shape.
+    storm = p.wan(peer="v:1", op="UpdateRegionColumns",
+                  latency_s=0.0, jitter_s=0.0, loss=1.0)
+    act = p.intercept("v:1", "UpdateRegionColumns")
+    assert act.kind == faults.DROP  # the storm, not a 20ms delay
+    # Other peers still ride the steady shape.
+    act = p.intercept("v:2", "UpdateRegionColumns")
+    assert act.kind == faults.DELAY
+    assert act.delay_s == pytest.approx(0.02)
+    # Healing ONLY the storm (exact peer) falls back to the steady
+    # wildcard for the victim too.
+    assert p.heal("v:1", "UpdateRegionColumns") == 1
+    act = p.intercept("v:1", "UpdateRegionColumns")
+    assert act.kind == faults.DELAY
+    assert p.fired(steady) >= 1 and p.fired(storm) >= 1
+
+    # partition(victim) is op="*" — less op-specific than the steady
+    # rule but MORE peer-specific, and peer specificity dominates: a
+    # fully partitioned daemon errors on its region wire as well.
+    part = p.partition("v:3")
+    act = p.intercept("v:3", "UpdateRegionColumns")
+    assert act.kind == faults.ERROR
+    assert p.fired(part) == 1
+
+
+def test_wan_parameter_validation():
+    p = FaultPlan(seed=1)
+    with pytest.raises(ValueError):
+        p.wan("a:1", loss=1.5)
+    with pytest.raises(ValueError):
+        p.wan("a:1", latency_s=-0.1)
+    with pytest.raises(ValueError):
+        p.wan("a:1", jitter_s=-0.1)

@@ -1,0 +1,161 @@
+"""Property tests for parallel/region.py (region_picker.go:7-95 parity).
+
+The federation plane (federation.py) leans on three picker properties
+that were previously untested:
+
+* `get_clients(key)` returns EXACTLY one owner per non-empty region and
+  never None (the pre-fix code emitted None when a ring mapped a key to
+  a departed peer, and raised outright on an emptied region — either
+  crashed the MULTI_REGION flush loop);
+* `pick(dc, key)` agrees with that region's ring (it IS the region
+  entry of the fan-out set);
+* regions are independent rings: add/remove in one region never moves
+  ownership in another (the per-region reshard-independence rule the
+  2x2 soak's per-region churn leans on).
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from gubernator_tpu.parallel.region import RegionPicker
+from gubernator_tpu.types import PeerInfo
+
+
+class FakePeer:
+    def __init__(self, addr: str, dc: str):
+        self.info = PeerInfo(
+            grpc_address=addr, http_address=f"h{addr}", data_center=dc
+        )
+
+    def __repr__(self):  # pragma: no cover — assertion messages only
+        return f"FakePeer({self.info.grpc_address}@{self.info.data_center})"
+
+
+def build(topology: dict) -> tuple:
+    """{dc: n_peers} -> (picker, {dc: [peers]})."""
+    rp = RegionPicker()
+    peers = {}
+    for dc, n in topology.items():
+        peers[dc] = [FakePeer(f"{dc}-{i}:81", dc) for i in range(n)]
+        for p in peers[dc]:
+            rp.add(p)
+    return rp, peers
+
+
+KEYS = [f"name_k{i}" for i in range(200)]
+
+
+def test_get_clients_exactly_one_owner_per_region():
+    rp, _ = build({"us": 3, "eu": 2, "ap": 1})
+    for key in KEYS:
+        owners = rp.get_clients(key)
+        assert len(owners) == 3
+        assert all(o is not None for o in owners)
+        # one owner PER region — no region double-represented
+        dcs = [o.info.data_center for o in owners]
+        assert sorted(dcs) == ["ap", "eu", "us"]
+
+
+def test_pick_agrees_with_the_per_region_ring():
+    rp, _ = build({"us": 3, "eu": 2})
+    for key in KEYS:
+        by_fanout = {
+            o.info.data_center: o.info.grpc_address
+            for o in rp.get_clients(key)
+        }
+        for dc in ("us", "eu"):
+            picked = rp.pick(dc, key)
+            assert picked is not None
+            assert picked.info.grpc_address == by_fanout[dc]
+            # and the underlying ring agrees with both
+            ring = rp.regions[dc]
+            assert picked.info.grpc_address == ring.get(key)
+
+
+def test_pick_unknown_or_empty_region_is_none():
+    rp, peers = build({"us": 1})
+    assert rp.pick("nowhere", "name_k") is None
+    rp.remove(peers["us"][0])
+    # last peer left: the region disappears rather than lingering empty
+    assert "us" not in rp.regions
+    assert rp.pick("us", "name_k") is None
+    assert rp.get_clients("name_k") == []
+    assert rp.region_names() == []
+
+
+def test_add_remove_keeps_other_regions_ownership_stable():
+    rp, peers = build({"us": 4, "eu": 3, "ap": 2})
+    before = {
+        dc: {k: rp.pick(dc, k).info.grpc_address for k in KEYS}
+        for dc in ("eu", "ap")
+    }
+    # Churn the US region hard: drop two members, add two new ones.
+    rp.remove(peers["us"][0])
+    rp.remove(peers["us"][2])
+    rp.add(FakePeer("us-9:81", "us"))
+    rp.add(FakePeer("us-10:81", "us"))
+    for dc in ("eu", "ap"):
+        after = {k: rp.pick(dc, k).info.grpc_address for k in KEYS}
+        assert after == before[dc], f"{dc} ownership moved under US churn"
+    # and US itself still answers exactly one live owner per key
+    live = {p.info.grpc_address for p in rp.regions["us"].peers()}
+    for k in KEYS:
+        assert rp.pick("us", k).info.grpc_address in live
+
+
+def test_remove_departed_peer_never_yields_none():
+    """The satellite bug: after a member departs, every key it owned
+    must re-map to a surviving peer — get_clients must keep the
+    one-owner-per-region property, not emit None."""
+    rng = random.Random(7)
+    rp, peers = build({"us": 5, "eu": 3})
+    order = peers["us"][:]
+    rng.shuffle(order)
+    for departing in order[:4]:  # leave one survivor
+        rp.remove(departing)
+        gone = departing.info.grpc_address
+        for key in KEYS:
+            owners = rp.get_clients(key)
+            assert len(owners) == 2
+            assert all(o is not None for o in owners)
+            assert all(o.info.grpc_address != gone for o in owners)
+
+
+def test_remove_non_member_is_a_noop():
+    rp, _ = build({"us": 2})
+    before = {k: rp.pick("us", k).info.grpc_address for k in KEYS}
+    rp.remove(FakePeer("us-99:81", "us"))       # never added
+    rp.remove(FakePeer("eu-0:81", "eu"))        # unknown region
+    after = {k: rp.pick("us", k).info.grpc_address for k in KEYS}
+    assert after == before
+
+
+def test_region_names_tracks_membership():
+    rp, peers = build({"us": 1, "eu": 1})
+    assert sorted(rp.region_names()) == ["eu", "us"]
+    rp.remove(peers["eu"][0])
+    assert rp.region_names() == ["us"]
+
+
+def test_new_inherits_template_but_not_members():
+    rp, _ = build({"us": 2})
+    fresh = rp.new()
+    assert fresh.regions == {}
+    assert fresh.get_clients("name_k") == []
+
+
+@pytest.mark.parametrize("n", [1, 2, 7])
+def test_pick_is_stable_and_member_valued(n):
+    """Owner picks are deterministic and always live members.  (Full
+    coverage of every member is NOT a property of this ring: at the
+    reference's replica count an unlucky vnode layout can leave a
+    member owning ~no keys — replicated_hash.go accepts that too.)"""
+    rp, peers = build({"us": n})
+    members = {p.info.grpc_address for p in peers["us"]}
+    first = {k: rp.pick("us", k).info.grpc_address for k in KEYS}
+    assert set(first.values()) <= members
+    again = {k: rp.pick("us", k).info.grpc_address for k in KEYS}
+    assert again == first

@@ -439,3 +439,128 @@ def test_etcd_subset_golden():
     assert ev.SerializeToString() == bytes(
         [0x08, 0x01, 0x12, 0x03, 0x0A, 0x01, ord("x")]
     )
+
+
+def test_region_columns_req_pb_golden():
+    """RegionColumnsReq (peers_columns.proto, the federation plane's
+    proto twin of the GUBC region frame): field numbers are a wire
+    contract with every deployed region."""
+    m = pc_pb.RegionColumnsReq(
+        origin="dc", names=["a"], unique_keys=["b"],
+        algorithm=[1], behavior=[2], hits=[3], limit=[4], duration=[5],
+    )
+    assert m.SerializeToString() == bytes(
+        [
+            0x0A, 0x02, *b"dc",    # 1: origin
+            0x12, 0x01, ord("a"),  # 2: names
+            0x1A, 0x01, ord("b"),  # 3: unique_keys
+            0x22, 0x01, 0x01,      # 4: algorithm (packed)
+            0x2A, 0x01, 0x02,      # 5: behavior (packed)
+            0x32, 0x01, 0x03,      # 6: hits (packed)
+            0x3A, 0x01, 0x04,      # 7: limit (packed)
+            0x42, 0x01, 0x05,      # 8: duration (packed)
+        ]
+    )
+    resp = pc_pb.RegionColumnsResp(applied=7)
+    assert resp.SerializeToString() == bytes([0x08, 0x07])
+
+
+def test_region_frame_golden():
+    """The GUBC region frame (kind 7) byte layout is a wire contract:
+    header | u32 origin_len | origin utf-8 | names column | unique_keys
+    column | algo i32 | behavior i32 | hits i64 | limit i64 | duration
+    i64, all little-endian (string columns in the shared
+    blob_len/offsets/blob form)."""
+    import numpy as np
+
+    from gubernator_tpu import wire
+    from gubernator_tpu.federation import RegionColumns
+
+    cols = RegionColumns(
+        origin="dc-a",
+        names=["a", "bc"],
+        unique_keys=["x", "yz"],
+        algorithm=np.array([1, 0], np.int32),
+        behavior=np.array([0, 4], np.int32),
+        hits=np.array([2, 3], np.int64),
+        limit=np.array([10, 20], np.int64),
+        duration=np.array([60, 70], np.int64),
+    )
+    raw = wire.encode_region_frame(cols)
+    i32 = lambda v: int(v).to_bytes(4, "little")  # noqa: E731
+    i64 = lambda v: int(v).to_bytes(8, "little")  # noqa: E731
+    expected = (
+        b"GUBC" + bytes([1, 7]) + i32(2)               # magic, ver, kind, n
+        + i32(4) + b"dc-a"                             # origin
+        + i32(3) + i32(0) + i32(1) + i32(3) + b"abc"   # names column
+        + i32(3) + i32(0) + i32(1) + i32(3) + b"xyz"   # unique_keys column
+        + i32(1) + i32(0)                              # algorithm
+        + i32(0) + i32(4)                              # behavior
+        + i64(2) + i64(3)                              # hits
+        + i64(10) + i64(20)                            # limit
+        + i64(60) + i64(70)                            # duration
+    )
+    assert raw == expected
+    assert wire.is_region_frame(raw)
+    assert not wire.is_transfer_frame(raw)
+    back = wire.decode_region_frame(raw)
+    assert back.origin == "dc-a"
+    assert back.names == ["a", "bc"]
+    assert back.unique_keys == ["x", "yz"]
+    assert list(back.hits) == [2, 3]
+    assert list(back.duration) == [60, 70]
+    # Truncation / foreign frames answer ValueError (the gateway's 400)
+    import pytest
+
+    with pytest.raises(ValueError):
+        wire.decode_region_frame(raw[:-1])
+    with pytest.raises(ValueError):
+        wire.decode_region_frame(raw + b"\x00")
+
+
+def test_classic_region_bytes_unchanged():
+    """GUBER_REGION_COLUMNS=0 / classic-negotiated peers must see
+    byte-identical wire to the PRE-FEDERATION sender in both
+    encodings: the RegionBatch classic chunk legs reproduce the legacy
+    per-item GetPeerRateLimits encoders exactly (MULTI_REGION already
+    stripped, as the old MultiRegionManager stripped it on the wire)."""
+    import dataclasses
+    import json
+
+    from gubernator_tpu import wire
+    from gubernator_tpu.federation import RegionBatch, RegionColumns
+    from gubernator_tpu.types import (
+        Behavior,
+        GetRateLimitsRequest,
+        RateLimitRequest,
+        set_behavior,
+    )
+
+    reqs = [
+        RateLimitRequest(
+            name="mr", unique_key=f"k{i}", hits=2, limit=10, duration=1000,
+            behavior=int(Behavior.MULTI_REGION), algorithm=i % 2,
+        )
+        for i in range(3)
+    ]
+    batch = RegionBatch(RegionColumns.from_requests("dc-a", reqs))
+    stripped = [
+        dataclasses.replace(
+            r, behavior=set_behavior(r.behavior, Behavior.MULTI_REGION, False)
+        )
+        for r in reqs
+    ]
+    legacy = GetRateLimitsRequest(requests=stripped)
+    # gRPC: the exact GetPeerRateLimitsReq the pre-PR sender serialized
+    (chunk,) = batch.classic_pb_chunks(1000)
+    assert (
+        chunk.SerializeToString()
+        == wire.peer_rate_limits_req_to_pb(legacy).SerializeToString()
+    )
+    # HTTP: the exact JSON body (peer_client._post_inner's json.dumps)
+    (body,) = batch.classic_json_chunks(1000)
+    assert body == json.dumps(legacy.to_json()).encode("utf-8")
+    # and chunking splits at the classic per-RPC cap, preserving order
+    chunks = batch.classic_pb_chunks(2)
+    assert [len(c.requests) for c in chunks] == [2, 1]
+    assert chunks[1].requests[0].unique_key == "k2"
