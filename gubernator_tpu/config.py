@@ -186,6 +186,27 @@ class BehaviorConfig:
     xla_storm: int = 3
     xla_storm_window_s: float = 60.0
 
+    # -- cost observatory (profiling.py) -------------------------------
+    # Continuous host sampling profiler: a daemon thread folds every
+    # thread's stack ~profile_hz times/s into phase-tagged flamegraph
+    # windows (GET /debug/pprof).  False compiles the plane out: the
+    # sampler tick is one branch, every scope hook one comparison
+    # returning a shared no-op (the bench gate pins the overhead ratio
+    # >= 0.95 — profiling_overhead_ratio).  Env: GUBER_PROFILE.
+    profile: bool = True
+    # Sampling rate in Hz (out-of-range [1, 1000] values are rejected
+    # loudly at boot, never clamped; the default 67 is deliberately not
+    # a divisor of common periodic work, and each tick adds seeded
+    # jitter so the sampler cannot phase-lock with a workload).  Env:
+    # GUBER_PROFILE_HZ.
+    profile_hz: float = 67.0
+    # Tenant cost ledger cardinality bound: the top-K rate-limit NAMES
+    # keep exact per-tenant accumulators (hits, over-limit, shed,
+    # ingress bytes, lane-time/queue shares); everyone else rolls into
+    # one `other` bucket, so metric cardinality is K+1 no matter how
+    # many distinct names exist.  Env: GUBER_TENANT_TOPK.
+    tenant_topk: int = 16
+
     # -- conservation audit (audit.py) ---------------------------------
     # Always-on windowed reconciliation of the exactly-once ledgers
     # (hits admitted vs dispatched vs applied vs forwarded, GLOBAL
@@ -573,6 +594,30 @@ def setup_daemon_config(
     )
     if b.xla_storm_window_s <= 0:
         raise ValueError("GUBER_XLA_STORM_WINDOW must be > 0")
+    b.profile = _env_bool(merged, "GUBER_PROFILE", b.profile)
+    v = merged.get("GUBER_PROFILE_HZ", "")
+    if v:
+        try:
+            hz = float(v)
+        except ValueError:
+            raise ValueError(
+                f"GUBER_PROFILE_HZ must be a number (Hz), got '{v}'"
+            ) from None
+        # Loud, not clamped: GUBER_PROFILE_HZ=5000 silently sampling at
+        # the 1000 cap would hide a 5x misconfiguration; 0 meaning
+        # "off" is GUBER_PROFILE=0's job, not a magic rate.
+        if not 1.0 <= hz <= 1000.0:
+            raise ValueError(
+                f"GUBER_PROFILE_HZ must be in [1, 1000], got '{v}'"
+            )
+        b.profile_hz = hz
+    b.tenant_topk = _env_int(merged, "GUBER_TENANT_TOPK", b.tenant_topk)
+    if not 1 <= b.tenant_topk <= 1024:
+        # The bound IS the point of the knob: 0 tenants tracks nothing
+        # and >1024 is an unbounded-cardinality config bug.
+        raise ValueError(
+            f"GUBER_TENANT_TOPK must be in [1, 1024], got '{b.tenant_topk}'"
+        )
     b.audit = _env_bool(merged, "GUBER_AUDIT", b.audit)
     b.audit_interval_s = _env_float_ms(
         merged, "GUBER_AUDIT_INTERVAL", b.audit_interval_s

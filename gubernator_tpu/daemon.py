@@ -51,7 +51,7 @@ class Daemon:
         # the module's import-time env default — unconditionally, so a
         # config that says 0 also DISABLES tracing a stale environment
         # variable turned on.
-        from . import telemetry, tracing
+        from . import profiling, telemetry, tracing
 
         tracing.set_sample_rate(self.conf.behaviors.trace_sample)
         # XLA telemetry is process-wide like tracing; the parsed
@@ -62,6 +62,13 @@ class Daemon:
             self.conf.behaviors.xla_storm,
             self.conf.behaviors.xla_storm_window_s,
         )
+        # The continuous host profiler is process-wide like tracing;
+        # the parsed GUBER_PROFILE/GUBER_PROFILE_HZ win over the
+        # module's import-time env defaults, in both directions (the
+        # sampler thread starts on first enable and idles at one
+        # branch per tick when disabled).
+        profiling.set_hz(self.conf.behaviors.profile_hz)
+        profiling.set_enabled(self.conf.behaviors.profile)
         # Everything compiled from here to the end of startup warmup is
         # warmup by definition; after mark_steady() below any further
         # backend compile counts as a steady-state recompile (shape

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import socket
 import ssl
 import threading
@@ -25,6 +26,7 @@ from urllib.parse import parse_qs, urlsplit
 import numpy as np
 
 from . import native as _native
+from . import profiling
 from . import saturation
 from . import telemetry
 from . import tracing
@@ -248,6 +250,7 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
                     service.metrics.observe_saturation(service)
                     service.metrics.observe_telemetry()
                     service.metrics.observe_audit(service)
+                    service.metrics.observe_cost(service)
                     service.metrics.observe_peers(
                         service.get_peer_list()
                         + list(service.get_region_picker().peers())
@@ -289,6 +292,16 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
                 return 200, "application/json", _json_bytes(
                     service.auditor.snapshot()
                 )
+            if qpath == "/debug/tenants":
+                # Cost observatory (profiling.py): per-tenant cost
+                # ledger — top-K exact rows + the `other` rollup;
+                # scripts/cluster_status.py --tenants aggregates these
+                # fleet-wide.
+                return 200, "application/json", _json_bytes(
+                    service.tenants.snapshot()
+                )
+            if qpath == "/debug/pprof":
+                return _debug_pprof(path)
             return 404, "application/json", _json_bytes(
                 {"code": 5, "message": f"no handler for {path}"}
             )
@@ -310,7 +323,8 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
                         # 400s exactly like a pre-columns build, which
                         # is the client's version probe.
                         t_parse = time.perf_counter()
-                        cols = _decode_ingress_frame_or_400(raw)
+                        with profiling.scope("ingress.parse"):
+                            cols = _decode_ingress_frame_or_400(raw)
                         saturation.observe_phase(
                             "ingress.parse", time.perf_counter() - t_parse
                         )
@@ -318,7 +332,8 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
                             cols, max_lanes=INGRESS_COLUMNS_MAX_LANES
                         )
                         t_enc = time.perf_counter()
-                        rendered = wire.encode_ingress_result_frame(result)
+                        with profiling.scope("response.encode"):
+                            rendered = wire.encode_ingress_result_frame(result)
                         saturation.observe_phase(
                             "response.encode", time.perf_counter() - t_enc
                         )
@@ -327,19 +342,23 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
                         ).inc()
                         return 200, wire.COLUMNS_CONTENT_TYPE, rendered
                     t_parse = time.perf_counter()
-                    cols = parse_body_native(raw) if raw else None
-                    native = cols is not None
-                    if not native:
-                        body = json.loads(raw) if raw else {}
-                        cols = parse_columns(body.get("requests", []))
+                    with profiling.scope("ingress.parse"):
+                        cols = parse_body_native(raw) if raw else None
+                        native = cols is not None
+                        if not native:
+                            body = json.loads(raw) if raw else {}
+                            cols = parse_columns(body.get("requests", []))
                     saturation.observe_phase(
                         "ingress.parse", time.perf_counter() - t_parse
                     )
                     result = service.get_rate_limits_columns(cols)
                     t_enc = time.perf_counter()
-                    rendered = render_result_native(result) if native else None
-                    if rendered is None:
-                        rendered = _json_bytes(render_columns(result))
+                    with profiling.scope("response.encode"):
+                        rendered = (
+                            render_result_native(result) if native else None
+                        )
+                        if rendered is None:
+                            rendered = _json_bytes(render_columns(result))
                     saturation.observe_phase(
                         "response.encode", time.perf_counter() - t_enc
                     )
@@ -494,6 +513,31 @@ def _debug_dump(path: str):
     )
 
 
+def _debug_pprof(path: str):
+    """GET /debug/pprof?seconds=N[&format=collapsed|json][&top=N]: the
+    continuous host profiler's window (profiling.py).  Default output
+    is flamegraph collapsed text ('phase;frame;...;frame count' lines —
+    pipe into flamegraph.pl / speedscope); format=json serves the
+    top-N + phase/program attribution view the integration gate
+    asserts against (>= 80% of samples on a loaded daemon must
+    attribute to a named phase)."""
+    q = parse_qs(urlsplit(path).query)
+
+    def _int_q(name: str, default: int) -> int:
+        try:
+            return int((q.get(name) or [str(default)])[0])
+        except ValueError:
+            return default
+
+    seconds = _int_q("seconds", 10)
+    if (q.get("format") or ["collapsed"])[0] == "json":
+        return 200, "application/json", _json_bytes(
+            profiling.profile_snapshot(seconds, top=_int_q("top", 30))
+        )
+    return (200, "text/plain; charset=utf-8",
+            profiling.collapsed(seconds).encode("utf-8"))
+
+
 _profile_state = {"thread": None, "dirs": [], "run_id": "", "log_dir": ""}
 _profile_seq = itertools.count(1)
 _profile_lock = threading.Lock()
@@ -565,12 +609,39 @@ def _debug_profile(raw: bytes):
                     jax.profiler.stop_trace()
                 except Exception:  # noqa: BLE001 — best-effort teardown
                     pass
+            # Cost-observatory pairing: the continuous host profiler's
+            # window covering the SAME interval lands beside the device
+            # trace, so one call yields device trace + host flamegraph
+            # for the same seconds (collapsed text, flamegraph.pl /
+            # speedscope ready).
+            if profiling.enabled():
+                try:
+                    with open(
+                        os.path.join(log_dir, "host_profile.collapsed"),
+                        "w",
+                    ) as f:
+                        f.write(
+                            profiling.collapsed(max(int(duration_s), 1))
+                        )
+                except OSError:
+                    pass
 
         t = threading.Thread(target=run, daemon=True, name="debug-profile")
         _profile_state["thread"] = t
         t.start()
+    host_seconds = max(int(duration_s), 1)
     return 202, "application/json", _json_bytes(
-        {"runId": run_id, "logDir": log_dir, "durationMs": duration_s * 1000.0}
+        {
+            "runId": run_id, "logDir": log_dir,
+            "durationMs": duration_s * 1000.0,
+            # Written when the run completes (the 202 answers before the
+            # trace finishes); the live equivalent is the pprof URL.
+            "hostProfile": (
+                f"{log_dir}/host_profile.collapsed"
+                if profiling.enabled() else None
+            ),
+            "hostPprof": f"/debug/pprof?seconds={host_seconds}",
+        }
     )
 
 
@@ -679,14 +750,16 @@ def handle_request_async(service: V1Service, method: str, path: str,
                 # the GIL released) to the submit path and returns to
                 # the ingress queue; the kind-6 response renders on the
                 # completion thread straight from the result arrays.
-                cols = _decode_ingress_frame_or_400(raw)
+                with profiling.scope("ingress.parse"):
+                    cols = _decode_ingress_frame_or_400(raw)
                 native = False
             else:
-                cols = parse_body_native(raw) if raw else None
-                native = cols is not None
-                if cols is None:
-                    body = json.loads(raw) if raw else {}
-                    cols = parse_columns(body.get("requests", []))
+                with profiling.scope("ingress.parse"):
+                    cols = parse_body_native(raw) if raw else None
+                    native = cols is not None
+                    if cols is None:
+                        body = json.loads(raw) if raw else {}
+                        cols = parse_columns(body.get("requests", []))
             saturation.observe_phase(
                 "ingress.parse", time.perf_counter() - t_parse
             )
@@ -701,7 +774,8 @@ def handle_request_async(service: V1Service, method: str, path: str,
                         return
                     t_enc = time.perf_counter()
                     if ingress_frame:
-                        rendered = wire.encode_ingress_result_frame(result)
+                        with profiling.scope("response.encode"):
+                            rendered = wire.encode_ingress_result_frame(result)
                         saturation.observe_phase(
                             "response.encode", time.perf_counter() - t_enc
                         )
@@ -710,11 +784,12 @@ def handle_request_async(service: V1Service, method: str, path: str,
                         ).inc()
                         finish("0", (200, wire.COLUMNS_CONTENT_TYPE, rendered))
                         return
-                    rendered = (
-                        render_result_native(result) if native else None
-                    )
-                    if rendered is None:  # native render unavailable/cap
-                        rendered = _json_bytes(render_columns(result))
+                    with profiling.scope("response.encode"):
+                        rendered = (
+                            render_result_native(result) if native else None
+                        )
+                        if rendered is None:  # native render unavailable/cap
+                            rendered = _json_bytes(render_columns(result))
                     saturation.observe_phase(
                         "response.encode", time.perf_counter() - t_enc
                     )
@@ -822,7 +897,11 @@ class NativeGatewayServer:
     def _worker(self) -> None:
         edge, service = self._edge, self.service
         while not self._stopped.is_set():
-            got = edge.next(timeout_ms=200)
+            # Cost profiler: time blocked in the native queue pull (the
+            # GIL is released inside edge.next) folds as epoll.wait —
+            # the "GIL-idle in epoll" answer, distinct from parse work.
+            with profiling.scope("epoll.wait"):
+                got = edge.next(timeout_ms=200)
             if got is None:
                 if edge.stopped:
                     return

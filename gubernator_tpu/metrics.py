@@ -24,7 +24,7 @@ from prometheus_client import (
 )
 
 from . import audit as audit_mod
-from . import saturation, telemetry, tracing
+from . import profiling, saturation, telemetry, tracing
 
 try:  # OpenMetrics exposition carries trace exemplars; text 0.0.4 cannot
     from prometheus_client.openmetrics.exposition import (
@@ -462,6 +462,48 @@ class Metrics:
             "a crash by the hits admitted inside this window.",
             registry=self.registry,
         )
+        # -- cost observatory (profiling.py) ---------------------------
+        self.tenant_cost = Gauge(
+            "gubernator_tenant_cost",
+            "Per-tenant cost attribution, TOP-K ONLY (tenant = the "
+            "rate-limit name; cardinality bounded at GUBER_TENANT_TOPK "
+            "label values, rebuilt per scrape so departed tenants drop "
+            "off).  stat = hits/lanes/over_limit/shed/ingress_bytes "
+            "(exact accumulators) plus lane_time_seconds/queue_seconds "
+            "(proportional shares: tenant lanes x the process-wide "
+            "per-lane cost).",
+            ["tenant", "stat"],
+            registry=self.registry,
+        )
+        self.tenant_other = Gauge(
+            "gubernator_tenant_other",
+            "The `other` rollup of every tenant outside the top-K "
+            "(same stats as gubernator_tenant_cost; rows + other == "
+            "totals exactly — the ledger conserves on eviction).",
+            ["stat"],
+            registry=self.registry,
+        )
+        self.tenant_total = Gauge(
+            "gubernator_tenant_total",
+            "Whole-daemon tenant-ledger totals (the conservation "
+            "denominator: hits here reconcile against the audit "
+            "ledger's ingress_hits + peer_ingress_hits at quiesce).",
+            ["stat"],
+            registry=self.registry,
+        )
+        self.profile_samples = Counter(
+            "gubernator_profile_samples",
+            "Stack samples folded by the continuous host profiler "
+            "(GUBER_PROFILE_HZ ticks x threads; GET /debug/pprof "
+            "serves the collapsed windows).",
+            registry=self.registry,
+        )
+        self.profile_hz = Gauge(
+            "gubernator_profile_hz",
+            "Configured host-profiler sampling rate (0 = the plane is "
+            "compiled out, GUBER_PROFILE=0).",
+            registry=self.registry,
+        )
         # -- conservation audit (audit.py) -----------------------------
         self.audit_violations = Counter(
             "gubernator_audit_violations_total",
@@ -713,6 +755,39 @@ class Metrics:
             self.device_live_buffers.labels(device=dev).set(
                 row.get("live_buffers", 0)
             )
+
+    def observe_cost(self, service) -> None:
+        """Refresh the cost-observatory families from the service's
+        tenant ledger and the process-global profiler (collect-on-
+        scrape, under the scrape lock like every observer).  Per-tenant
+        series are REBUILT each scrape from the top-K — the cardinality
+        bound the Zipf test pins (<= K tenant label values + the one
+        `other` rollup, under any number of distinct names)."""
+        tenants = getattr(service, "tenants", None)
+        if tenants is not None:
+            snap = tenants.snapshot()
+            stat_keys = (
+                ("hits", "hits"), ("lanes", "lanes"),
+                ("overLimit", "over_limit"), ("shed", "shed"),
+                ("ingressBytes", "ingress_bytes"),
+                ("laneTimeS", "lane_time_seconds"),
+                ("queueS", "queue_seconds"),
+            )
+            self.tenant_cost.clear()
+            for row in snap["topk"]:
+                for src, stat in stat_keys:
+                    self.tenant_cost.labels(
+                        tenant=row["tenant"], stat=stat
+                    ).set(row[src])
+            for family, doc in (
+                (self.tenant_other, snap["other"]),
+                (self.tenant_total, snap["totals"]),
+            ):
+                family.clear()
+                for src, stat in stat_keys:
+                    family.labels(stat=stat).set(doc[src])
+        self._bump(self.profile_samples, profiling.sample_count())
+        self.profile_hz.set(profiling.hz() if profiling.enabled() else 0)
 
     def observe_audit(self, service) -> None:
         """Refresh the conservation-ledger gauge from the service's

@@ -25,6 +25,7 @@ import numpy as np
 
 from .. import audit
 from .. import native
+from .. import profiling
 from .. import saturation
 from .. import telemetry
 from .. import tracing
@@ -554,7 +555,8 @@ class ColumnsHandle:
     def _do_resolve(self) -> None:
         t0 = time.perf_counter()
         try:
-            packed_np = self._fetch()
+            with profiling.scope("dispatch.fetch"):
+                packed_np = self._fetch()
         except Exception as e:  # noqa: BLE001 — launch failure
             self._finish_exc(e)
             return
@@ -563,7 +565,8 @@ class ColumnsHandle:
         tracing.stage_span("fetch", dt, self._trace)
         t1 = time.perf_counter()
         try:
-            status, remaining, reset = self._commit_fn(packed_np)
+            with profiling.scope("dispatch.commit"):
+                status, remaining, reset = self._commit_fn(packed_np)
         except Exception as e:  # noqa: BLE001 — surfaced at result()
             self._finish_exc(e)
             return
@@ -763,7 +766,7 @@ class ColumnarPipeline:
         # dispatch — the earlier-layer twin of the applied-hits count at
         # commit decode (applied <= dispatched is the device invariant).
         audit.note("dispatched_hits", int(cols.hits.sum()))
-        with self._plan_lock:
+        with self._plan_lock, profiling.scope("dispatch.prepare"):
             prep = self._prepare_columns(keys, cols, now_ms, force_wire)
             handle = ColumnsHandle(self, prep.commit, cols.limit, cols.hits)
             handle._trace = bt
@@ -781,7 +784,8 @@ class ColumnarPipeline:
         saturation.lane_util.add(prep.n, self._padded_lanes(prep))
         try:
             t1 = time.perf_counter()
-            staged = self._stage_columns(prep)
+            with profiling.scope("dispatch.stage"):
+                staged = self._stage_columns(prep)
             dt = time.perf_counter() - t1
             self._observe_stage("stage", dt)
             tracing.stage_span("stage", dt, bt)
@@ -859,12 +863,18 @@ class ColumnarPipeline:
         exc: "Optional[BaseException]" = None
         t0 = time.perf_counter()
         try:
-            with self._lock:
+            with self._lock, profiling.scope("dispatch.launch"):
                 self._launch_group(group)
         except BaseException as e:  # noqa: BLE001
             exc = e
         dt = time.perf_counter() - t0
         self._observe_stage("launch", dt)
+        # Lane-time pool (profiling.py): these lanes rode a launch of
+        # this wall cost — the tenant ledger's proportional-share
+        # denominator (the per-launch timing telemetry also drains).
+        profiling.note_lane_time(
+            sum(len(h._limit) for _, h in group), dt
+        )
         for _, h in group:
             # One launch span per batch (a fused group launches several
             # batches in one program; each batch's trace sees it).

@@ -39,6 +39,7 @@ import numpy as np
 
 from . import audit
 from . import faults as faults_mod
+from . import profiling
 from . import saturation
 from . import tracing
 from . import wire
@@ -788,10 +789,11 @@ class PeerClient:
             t0 = time.monotonic_ns()
             rpc_err = None
             try:
-                rc = self._send_columns(
-                    cols, self.behaviors.batch_timeout_s, _draining=True,
-                    trace=trace,
-                )
+                with profiling.scope("peer.rpc"):
+                    rc = self._send_columns(
+                        cols, self.behaviors.batch_timeout_s, _draining=True,
+                        trace=trace,
+                    )
             except Exception as e:  # noqa: BLE001 — re-raised below
                 rpc_err = e
                 raise

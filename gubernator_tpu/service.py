@@ -24,6 +24,7 @@ from .utils.logging import category_logger
 import numpy as np
 
 from . import audit as audit_mod
+from . import profiling
 from . import saturation
 from . import snapshot as snapshot_mod
 from . import telemetry
@@ -238,6 +239,9 @@ class LocalBatcher:
             st = getattr(fut, "_submit_t", None)
             if st is not None:
                 saturation.observe_phase("batch.window", t_flush - st)
+                # Queue-residency pool (profiling.py): one lane waited
+                # this long; tenants take proportional shares.
+                profiling.note_queue_wait(1, t_flush - st)
         try:
             resps = self.store.apply(
                 [r for r, _ in batch], self.clock.now_ms()
@@ -373,6 +377,9 @@ class _ColumnsPlan:
     # ("remote", forward future, lanes) | ("local", (handle, lo, hi),
     # lanes); all best-effort.
     peeks: list = field(default_factory=list)
+    # Tenant-ledger fold context (profiling.py): computed once at the
+    # admission fold, reused by the outcome/shed folds at finalize.
+    tenant_ctx: object = None
 
 
 def _lane_response(out: dict, lo: int) -> RateLimitResponse:
@@ -784,11 +791,16 @@ class _ColumnsJoin:
                         result, plan.remote_groups[addr], addr, resps
                     )
                 for fast_idx, out, sl, exc in self._fast_outs:
+                    if isinstance(exc, IngressShedError):
+                        # Tenant shed attribution, async twin of
+                        # _resolve_fast's.
+                        self.svc.tenants.fold_shed(plan.tenant_ctx, fast_idx)
                     _merge_fast_result(
                         result, plan.hash_keys, fast_idx, out, sl, exc
                     )
                 for lanes, payload in self._peek_res:
                     _merge_peek_result(result, lanes, payload)
+                self.svc.tenants.fold_outcome(plan.tenant_ctx, result)
             except Exception as e:  # noqa: BLE001
                 result, err = None, e
         self.callback(result if err is None else None, err)
@@ -868,10 +880,14 @@ class ColumnarBatcher:
         # Saturation plane: per-submission window-wait attribution and
         # the dispatcher's busy fraction (flush wall time over elapsed).
         t_flush = time.monotonic()
-        for _, fut in batch:
+        for item, fut in batch:
             st = getattr(fut, "_submit_t", None)
             if st is not None:
                 saturation.observe_phase("batch.window", t_flush - st)
+                # Queue-residency pool (profiling.py): this
+                # submission's lanes waited out the window; tenants
+                # take proportional shares of the pool.
+                profiling.note_queue_wait(len(item[0]), t_flush - st)
         # The window admits the submission that CROSSES the lane limit
         # (it cannot un-take from the queue), so one flush can overshoot
         # MAX_LANES by up to a submission; re-chunk so no single device
@@ -1112,6 +1128,15 @@ class V1Service:
         )
         self.metrics.slo = self.slo
         self.hotkeys = saturation.HotKeySketch()
+        # Cost observatory (profiling.py): the per-tenant cost ledger
+        # (cardinality-bounded by GUBER_TENANT_TOPK; every audit
+        # ingress note has a fold beside it).  The ledger must exist
+        # BEFORE any router runs; the host SAMPLER is process-wide and
+        # applied by the daemon (library embedders call
+        # profiling.set_enabled themselves, the tracing rule).
+        self.tenants = profiling.TenantLedger(
+            topk=getattr(conf.behaviors, "tenant_topk", 16)
+        )
         # Always-on conservation audit (audit.py): the chaos-suite
         # exactly-once oracles as a live windowed self-check.  The
         # auditor arms its ledger baseline here — post-construction
@@ -1290,6 +1315,10 @@ class V1Service:
         # front door on the columnar path (sync + async edges both
         # funnel here; the dataclass router counts in _route).
         audit_mod.note("ingress_hits", int(cols.hits.sum()))
+        # Tenant cost ledger (profiling.py): the SAME admission fold —
+        # every audit ingress note has a tenant fold beside it, so the
+        # two ledgers reconcile exactly at quiesce (the soak asserts).
+        tenant_ctx = self.tenants.fold_admit(cols)
         beh = cols.behavior
         # GLOBAL lanes need the replica-cache/dataclass path; MULTI_REGION
         # lanes stay columnar when locally owned (their only extra duty is
@@ -1567,6 +1596,7 @@ class V1Service:
             ),
             hash_keys=hash_keys,
             peeks=peeks,
+            tenant_ctx=tenant_ctx,
         )
 
     def _finalize_columns(self, plan: "_ColumnsPlan", result) -> ColumnarResult:
@@ -1582,7 +1612,10 @@ class V1Service:
             _merge_group_result(
                 result, plan.remote_groups[addr], addr, fut.result()
             )
-        self._resolve_fast(plan.pendings, plan.hash_keys, result)
+        self._resolve_fast(
+            plan.pendings, plan.hash_keys, result,
+            tenant_ctx=plan.tenant_ctx,
+        )
         for kind, payload, lanes in plan.peeks:
             data = None
             try:
@@ -1597,6 +1630,9 @@ class V1Service:
             except Exception:  # noqa: BLE001 — peek is best-effort
                 data = None
             _merge_peek_result(result, lanes, data)
+        # Tenant cost ledger: per-tenant OVER_LIMIT attribution from
+        # the resolved arrays (admission was folded at submit).
+        self.tenants.fold_outcome(plan.tenant_ctx, result)
         return result
 
     # -- shared fast-lane halves of the two columnar entry points ------
@@ -1696,7 +1732,8 @@ class V1Service:
             return [dispatch(fast_idx, True)]
         return [dispatch(fast_idx[nb], True), dispatch(fast_idx[~nb], False)]
 
-    def _resolve_fast(self, pendings, hash_keys, result) -> None:
+    def _resolve_fast(self, pendings, hash_keys, result,
+                      tenant_ctx=None) -> None:
         """Block on each fast dispatch and scatter its arrays into the
         result; a dispatch failure (e.g. shutdown race) converts to
         per-lane errors instead of failing lanes already computed."""
@@ -1710,6 +1747,11 @@ class V1Service:
                 sl = slice(lo, hi)
             except Exception as e:  # noqa: BLE001
                 exc = e
+            if isinstance(exc, IngressShedError):
+                # Tenant cost ledger: the bounded ingress gate refused
+                # these lanes — attribute the shed to their tenants
+                # (ROADMAP item 2's "one tenant's burst sheds itself").
+                self.tenants.fold_shed(tenant_ctx, fast_idx)
             _merge_fast_result(result, hash_keys, fast_idx, out, sl, exc)
 
     def _route(self, requests: Sequence[RateLimitRequest],
@@ -1725,6 +1767,11 @@ class V1Service:
             audit_mod.note(
                 "ingress_hits", sum(int(r.hits) for r in requests)
             )
+        # Tenant cost ledger: the dataclass router's admission fold
+        # (lanes the columnar funnel already folded arrive _counted).
+        tenant_names = (
+            None if _counted else self.tenants.fold_requests(requests)
+        )
         out: List[Optional[RateLimitResponse]] = [None] * n
         local: List[int] = []
         global_remote: List[int] = []
@@ -1852,6 +1899,8 @@ class V1Service:
             if out[i] is not None:
                 out[i] = self._merge_handoff(out[i], peek)
 
+        if tenant_names is not None:
+            self.tenants.fold_outcome_responses(tenant_names, out)
         return GetRateLimitsResponse(
             responses=[r if r is not None else RateLimitResponse() for r in out]
         )
@@ -2199,11 +2248,13 @@ class V1Service:
         audit_mod.note(
             "peer_ingress_hits", sum(int(r.hits) for r in req.requests)
         )
+        tenant_names = self.tenants.fold_requests(list(req.requests))
         now = self.clock.now_ms()
         resps = self.store.apply(list(req.requests), now)
         for r in req.requests:
             if has_behavior(r.behavior, Behavior.MULTI_REGION):
                 self.multi_region_mgr.queue_hits(r)
+        self.tenants.fold_outcome_responses(tenant_names, resps)
         return GetRateLimitsResponse(responses=resps)
 
     def get_peer_rate_limits_columns(
@@ -2245,6 +2296,11 @@ class V1Service:
         """Phase 1 of the PeersV1 columnar receive (shared by the sync
         entry above and get_peer_rate_limits_columns_async)."""
         n = len(cols)
+        # Tenant cost ledger: the peer-door admission fold (beside the
+        # callers' peer_ingress_hits audit notes) — forwarded traffic
+        # attributes on the OWNER, which is where the hot-tenant
+        # question is asked.
+        tenant_ctx = self.tenants.fold_admit(cols)
         beh = cols.behavior
         slow = (beh & int(Behavior.GLOBAL)) != 0
         fast = np.logical_not(slow)
@@ -2279,6 +2335,7 @@ class V1Service:
                 else None
             ),
             hash_keys=hash_keys,
+            tenant_ctx=tenant_ctx,
         )
 
     # -- async columnar entry points (native-edge completion path) -----
@@ -2370,6 +2427,8 @@ class V1Service:
         result = ColumnarResult.empty(1)
 
         def deliver_resp(resp: RateLimitResponse) -> None:
+            if resp.status == 1 and not resp.error:
+                self.tenants.fold_outcome_responses([r.name], [resp])
             result.overrides[0] = resp
             callback(result, None)
 
@@ -2382,6 +2441,11 @@ class V1Service:
             self.multi_region_mgr.queue_hits(r)
         # Conservation ledger: this lane bypasses both router funnels.
         audit_mod.note("ingress_hits", int(r.hits))
+        # Tenant ledger: same bypass, same pairing rule.
+        self.tenants.fold_one(
+            r.name, int(r.hits),
+            len(r.name) + len(r.unique_key) + profiling.NUMERIC_LANE_BYTES,
+        )
         try:
             w = self._submit_single_local(
                 r, direct=has_behavior(r.behavior, Behavior.NO_BATCHING)
@@ -2737,6 +2801,15 @@ class V1Service:
             },
             "slo": self.slo.snapshot(),
             "hotkeys": self.hotkeys.snapshot()["topk"][:5],
+            # Cost observatory (profiling.py): top tenants by cost and
+            # the host-profiler vitals — the fleet poller's per-daemon
+            # "who is spending the capacity" cells.
+            "tenants": self.tenants.snapshot(top=5),
+            "profile": {
+                "enabled": profiling.enabled(),
+                "hz": profiling.hz(),
+                "samples": profiling.sample_count(),
+            },
             "ring": {**ring, "reshard": self.reshard.snapshot()},
             "audit": {
                 "enabled": self.auditor.enabled,

@@ -54,7 +54,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
-from . import tracing
+from . import profiling, tracing
 from .utils.logging import category_logger
 
 logger = category_logger("telemetry")
@@ -243,6 +243,11 @@ class _Program:
         self._prev_lazy = getattr(_tls, "program_lazy", False)
         _tls.program = self.label
         _tls.program_lazy = self.lazy
+        if profiling.enabled():
+            # Mirror the label into the cost-profiler's cross-thread
+            # registry (thread-locals are invisible to the sampler):
+            # samples taken during this launch carry program identity.
+            profiling.set_program(self.label)
         self._t0 = time.perf_counter()
         return self
 
@@ -250,6 +255,11 @@ class _Program:
         dt = time.perf_counter() - self._t0
         _tls.program = self._prev
         _tls.program_lazy = self._prev_lazy
+        # Unconditional (unlike the enter-side mirror): if the profiler
+        # was toggled off mid-launch, a conditional restore would park
+        # this label in the cross-thread registry forever and every
+        # later sample of this thread would carry it.
+        profiling.set_program(self._prev)
         with _lock:
             st = _exec_stats.setdefault(self.label, [0, 0.0, 0.0])
             st[0] += 1

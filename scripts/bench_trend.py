@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Bench-history trend view + regression gate.
+
+`make bench` appends every run's JSON row (stamped with git sha +
+backend) to `benchmarks/history/`; the repo-root BENCH_r01–r05 files
+are the pre-history seed entries.  This script renders the per-metric
+trajectory across all of them and — for the newest run — exits
+non-zero on a >20% NOISE-ADJUSTED regression against the rolling
+median of the preceding same-backend runs, so a slow drift that no
+single-run gate row would trip still fails a release check.
+
+Rules (deliberately boring):
+
+* Runs compare only within one backend ("cpu" vs "tpu" vs the
+  unstamped legacy seeds, which group as "unknown"): a CPU dev box
+  legitimately runs the identical path 10-100x slower than the tunnel
+  (the gate_thresholds only_backend precedent) and must not read as a
+  regression of it.
+* The baseline is the rolling MEDIAN of up to the 5 preceding runs —
+  robust to one outlier run in either direction.
+* Lower-is-better metrics (latency ms, device µs) invert the
+  comparison; everything else is higher-is-better throughput.
+* Noise adjustment: the per-metric `*_noise_us` fields recorded by
+  bench.py widen the allowance where present; otherwise the 20%
+  threshold IS the noise allowance (bench absolutes swing ~2.5x with
+  host weather — the same-run ratio rows in `make bench-gate` stay the
+  sharp gates; this one catches multi-run drift).
+
+Usage:
+    python scripts/bench_trend.py                 # trajectory + gate
+    python scripts/bench_trend.py --metric service_ingress_checks_per_sec
+    python scripts/bench_trend.py --no-gate       # print only
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+# The stable cross-run rows worth a trajectory (absolute values; the
+# same-run ratio rows are gated per-run by bench --gate instead).
+DEFAULT_METRICS = (
+    "rate_limit_checks_per_sec",
+    "service_ingress_checks_per_sec",
+    "ingress_columns_checks_per_sec",
+    "peer_forward_checks_per_sec",
+    "device_checks_per_sec",
+    "device_batch_us",
+    "service_ingress_latency_ms_p50",
+    "service_ingress_latency_ms_p99",
+)
+
+# Lower-is-better name shapes (the gate_thresholds fail_above rows).
+LOWER_IS_BETTER_SUFFIXES = ("_us", "_ms", "_ms_p50", "_ms_p99")
+REGRESSION_FRACTION = 0.20
+ROLLING_WINDOW = 5
+
+
+def lower_is_better(metric: str) -> bool:
+    return metric.endswith(LOWER_IS_BETTER_SUFFIXES) or "_latency_" in metric
+
+
+def load_runs() -> list:
+    """All known runs, oldest first: the BENCH_r* seeds (legacy,
+    backend 'unknown'), then benchmarks/history/ by timestamp."""
+    runs = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_r[0-9]*.json"))):
+        try:
+            with open(path) as f:
+                row = json.load(f)
+        except (OSError, ValueError):
+            continue
+        # The r01-r05 seeds wrap the bench row as {"cmd", "rc",
+        # "parsed": {...}}; history entries are the row itself.
+        if isinstance(row.get("parsed"), dict):
+            row = row["parsed"]
+        _lift_headline(row)
+        runs.append({
+            "label": os.path.basename(path).replace(".json", ""),
+            "backend": row.get("backend", "unknown"),
+            "time": 0.0,
+            "row": row,
+        })
+    hist = []
+    for path in glob.glob(os.path.join(REPO, "benchmarks", "history", "*.json")):
+        try:
+            with open(path) as f:
+                row = json.load(f)
+        except (OSError, ValueError):
+            continue
+        _lift_headline(row)
+        hist.append({
+            "label": os.path.basename(path).replace(".json", ""),
+            "backend": row.get("backend", "unknown"),
+            "time": float(row.get("time", 0.0)),
+            "row": row,
+        })
+    hist.sort(key=lambda r: r["time"])
+    return runs + hist
+
+
+def _lift_headline(row: dict) -> None:
+    """The bench row names its headline metric indirectly
+    ({"metric": "rate_limit_checks_per_sec", "value": X}); lift it to
+    a first-class key so it trends like every other metric."""
+    name, value = row.get("metric"), row.get("value")
+    if isinstance(name, str) and isinstance(value, (int, float)):
+        row.setdefault(name, value)
+
+
+def median(vals: list) -> float:
+    vals = sorted(vals)
+    n = len(vals)
+    mid = n // 2
+    return vals[mid] if n % 2 else (vals[mid - 1] + vals[mid]) / 2.0
+
+
+def noise_for(run: dict, metric: str) -> float:
+    """Per-metric measurement noise where bench.py recorded it (the
+    device rows' `<metric>_noise_us` convention); 0 otherwise."""
+    return float(run["row"].get(f"{metric}_noise_us", 0.0) or 0.0)
+
+
+def spark(vals: list) -> str:
+    blocks = "▁▂▃▄▅▆▇█"
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return blocks[0] * len(vals)
+    return "".join(
+        blocks[min(int((v - lo) / (hi - lo) * (len(blocks) - 1)),
+                   len(blocks) - 1)]
+        for v in vals
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--metric", action="append", default=None,
+                    help="metric(s) to trend (default: the stable set)")
+    ap.add_argument("--window", type=int, default=ROLLING_WINDOW,
+                    help="rolling-median window (preceding runs)")
+    ap.add_argument("--threshold", type=float, default=REGRESSION_FRACTION,
+                    help="regression fraction vs the rolling median")
+    ap.add_argument("--no-gate", action="store_true",
+                    help="print trajectories only, always exit 0")
+    args = ap.parse_args()
+    metrics = tuple(args.metric) if args.metric else DEFAULT_METRICS
+
+    runs = load_runs()
+    if not runs:
+        print("bench-trend: no history (run `make bench` to record one)")
+        return 0
+    newest = runs[-1]
+    print(
+        f"bench-trend: {len(runs)} runs "
+        f"(newest: {newest['label']}, backend {newest['backend']})"
+    )
+    failures = []
+    for metric in metrics:
+        series = [
+            (r["label"], r["backend"], float(r["row"][metric]), r)
+            for r in runs
+            if isinstance(r["row"].get(metric), (int, float))
+        ]
+        if not series:
+            continue
+        vals = [v for _, _, v, _ in series]
+        direction = "v" if lower_is_better(metric) else "^"
+        print(
+            f"  {metric} [{direction}]  {spark(vals)}  "
+            + " ".join(f"{v:.4g}" for _, _, v, _ in series[-8:])
+        )
+        # Gate only the NEWEST run, only against preceding runs of the
+        # SAME backend (cross-backend absolutes are not comparable).
+        if args.no_gate or series[-1][3] is not newest:
+            continue
+        prior = [
+            v for _, be, v, r in series[:-1]
+            if be == newest["backend"] and r is not newest
+        ][-args.window:]
+        if len(prior) < 2:
+            continue  # one prior point is weather, not a trend
+        base = median(prior)
+        value = series[-1][2]
+        noise = noise_for(newest, metric)
+        if lower_is_better(metric):
+            limit = base * (1.0 + args.threshold)
+            regressed = value - noise > limit
+        else:
+            limit = base * (1.0 - args.threshold)
+            regressed = value + noise < limit
+        if regressed:
+            failures.append(
+                f"{metric}: {value:.4g} vs rolling median {base:.4g} "
+                f"(limit {limit:.4g}, n={len(prior)}, "
+                f"backend {newest['backend']})"
+            )
+    if failures:
+        print("bench-trend: REGRESSION vs rolling median")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("bench-trend: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

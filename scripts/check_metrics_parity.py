@@ -105,6 +105,14 @@ EXTENSIONS = frozenset(
         "gubernator_snapshot_restores",
         "gubernator_snapshot_lanes",
         "gubernator_snapshot_age_seconds",
+        # PR 12: cost observatory (profiling.py) — per-tenant cost
+        # attribution (top-K + other rollup, cardinality-bounded) and
+        # the continuous host profiler's vitals.
+        "gubernator_tenant_cost",
+        "gubernator_tenant_other",
+        "gubernator_tenant_total",
+        "gubernator_profile_samples",
+        "gubernator_profile_hz",
     }
 )
 

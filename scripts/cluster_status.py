@@ -3,14 +3,18 @@
 one table — the whole-cluster view of the saturation & SLO plane
 (health, breaker state, bucket-table occupancy, ingress queue, SLO
 burn) plus the federation plane (data center, remote-region rings with
-breaker-open marks, carry depth, last-flush age).  The soak harness
-(make soak-smoke, tests/test_soak_smoke.py) asserts against the same
-JSON doc this renders.
+breaker-open marks, carry depth, last-flush age) and the cost
+observatory (hot tenant per daemon; `--tenants` renders the
+fleet-aggregated per-tenant cost table from every daemon's
+GET /debug/tenants — "which tenant is burning region X's SLO" in one
+view).  The soak harness (make soak-smoke, tests/test_soak_smoke.py)
+asserts against the same JSON doc this renders.
 
 Usage:
     python scripts/cluster_status.py HOST:PORT [HOST:PORT ...]
     python scripts/cluster_status.py --watch 5 10.0.0.1:1050 10.0.0.2:1050
     python scripts/cluster_status.py --json HOST:PORT      # raw docs
+    python scripts/cluster_status.py --tenants HOST:PORT [...]  # cost table
 
 Exit status: 0 when every polled daemon answered and reports healthy
 with all breakers closed; 1 otherwise — so a deploy script can gate on
@@ -29,7 +33,10 @@ import urllib.request
 COLUMNS = ("daemon", "health", "peers", "brk-open", "ring", "handoff",
            "occupancy", "evict", "queue", "shed", "burn-5m", "burn-1h",
            "audit", "recompiles", "dc", "regions", "carry", "flush-age",
-           "hot-key")
+           "hot-key", "hot-tenant")
+
+TENANT_COLUMNS = ("tenant", "hits", "lanes", "over-limit", "shed",
+                  "ingress-MB", "lane-time-s", "queue-s", "daemons")
 
 
 def fetch_status(addr: str, timeout_s: float = 5.0) -> dict:
@@ -68,6 +75,7 @@ def summarize(addr: str, doc: dict) -> dict:
     else:
         regions_cell = "-"
     flush_age = region.get("lastFlushAgeS")
+    top_tenants = doc.get("tenants", {}).get("topk") or []
     return {
         "daemon": addr,
         "health": doc.get("health", {}).get("status", "?"),
@@ -101,20 +109,93 @@ def summarize(addr: str, doc: dict) -> dict:
             f"{flush_age}s" if flush_age is not None else "-"
         ),
         "hot-key": hot[0]["key"] if hot else "-",
+        # Cost observatory (PR 12): the daemon's costliest tenant by
+        # ledger rank, e.g. "tenant-hot:4821" (name:hits).
+        "hot-tenant": (
+            f"{top_tenants[0]['tenant']}:{top_tenants[0]['hits']}"
+            if top_tenants else "-"
+        ),
     }
 
 
-def render(rows: list) -> str:
+def render(rows: list, columns: tuple = COLUMNS) -> str:
     widths = {
         c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
-        for c in COLUMNS
+        for c in columns
     }
-    lines = ["  ".join(c.ljust(widths[c]) for c in COLUMNS)]
+    lines = ["  ".join(c.ljust(widths[c]) for c in columns)]
     for r in rows:
         lines.append(
-            "  ".join(str(r.get(c, "")).ljust(widths[c]) for c in COLUMNS)
+            "  ".join(str(r.get(c, "")).ljust(widths[c]) for c in columns)
         )
     return "\n".join(lines)
+
+
+def poll_tenants(addrs: list, as_json: bool) -> int:
+    """Fleet-aggregated per-tenant cost table: every daemon's
+    GET /debug/tenants summed by tenant name (a forwarded lane folds
+    at both its ingress daemon and its owner, so fleet rows read as
+    door-crossings — consistent across daemons, like the audit's
+    ingress counters).  Each daemon's `other` rollup and totals are
+    carried as their own rows so the fleet view conserves too."""
+    agg: dict = {}
+    docs = {}
+    other = dict.fromkeys(
+        ("hits", "lanes", "overLimit", "shed", "ingressBytes",
+         "laneTimeS", "queueS"), 0.0
+    )
+    totals = dict(other)
+    rc = 0
+    for addr in addrs:
+        try:
+            with urllib.request.urlopen(
+                f"http://{addr}/debug/tenants", timeout=5.0
+            ) as resp:
+                doc = json.loads(resp.read())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"{addr}: UNREACHABLE ({e})", file=sys.stderr)
+            rc = 1
+            continue
+        docs[addr] = doc
+        for row in doc.get("topk", []):
+            cell = agg.setdefault(
+                row["tenant"], {**dict.fromkeys(other, 0.0), "daemons": 0}
+            )
+            for k in other:
+                cell[k] += row.get(k, 0)
+            cell["daemons"] += 1
+        for k in other:
+            other[k] += doc.get("other", {}).get(k, 0)
+            totals[k] += doc.get("totals", {}).get(k, 0)
+    if as_json:
+        print(json.dumps(docs, indent=2))
+        return rc
+    if not agg and not docs:
+        return rc
+
+    def _row(name, cell, daemons):
+        return {
+            "tenant": name,
+            "hits": int(cell["hits"]),
+            "lanes": int(cell["lanes"]),
+            "over-limit": int(cell["overLimit"]),
+            "shed": int(cell["shed"]),
+            "ingress-MB": round(cell["ingressBytes"] / 1e6, 3),
+            "lane-time-s": round(cell["laneTimeS"], 3),
+            "queue-s": round(cell["queueS"], 3),
+            "daemons": daemons,
+        }
+
+    rows = [
+        _row(name, cell, cell["daemons"])
+        for name, cell in sorted(
+            agg.items(), key=lambda kv: kv[1]["hits"], reverse=True
+        )
+    ]
+    rows.append(_row("(other)", other, len(docs)))
+    rows.append(_row("(fleet total)", totals, len(docs)))
+    print(render(rows, TENANT_COLUMNS))
+    return rc
 
 
 def poll_once(addrs: list, as_json: bool) -> int:
@@ -151,14 +232,18 @@ def main() -> int:
                     help="re-poll every N seconds until interrupted")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="print raw /debug/status docs instead of the table")
+    ap.add_argument("--tenants", action="store_true",
+                    help="fleet-aggregated per-tenant cost table "
+                         "(GET /debug/tenants across all daemons)")
     args = ap.parse_args()
+    poll = poll_tenants if args.tenants else poll_once
     if not args.watch:
-        return poll_once(args.addrs, args.as_json)
+        return poll(args.addrs, args.as_json)
     rc = 0
     try:
         while True:
             print(f"-- {time.strftime('%H:%M:%S')} --")
-            rc = max(rc, poll_once(args.addrs, args.as_json))
+            rc = max(rc, poll(args.addrs, args.as_json))
             time.sleep(args.watch)
     except KeyboardInterrupt:
         # Exit-code contract holds in watch mode too: nonzero if ANY

@@ -9,9 +9,17 @@ drives it for minutes with:
 * **Zipf traffic** — key popularity drawn from a seeded Zipf
   distribution (the viral-key shape), mixed token/leaky algorithms,
   a slice of GLOBAL-behavior lanes, through rotating entry daemons so
-  every request shape crosses the peer hop.
+  every request shape crosses the peer hop.  Lanes spread over a small
+  TENANT pool (the rate-limit name is the tenant unit), with one
+  PLANTED HOT TENANT soaking the burst traffic — the cost
+  observatory's per-tenant ledger (profiling.py, GET /debug/tenants)
+  must rank it #1 on its owner daemon and must conserve
+  (top-K + other == totals) on every poll, and at final quiesce the
+  summed tenant ledgers must reconcile EXACTLY against the audit
+  ledger's ingress counters (ingress_hits + peer_ingress_hits).
 * **Burst replay** — periodic bursts replaying one hot key at
-  many-lane batches (the retry-storm shape).
+  many-lane batches (the retry-storm shape), under the hot tenant's
+  name on one fixed key so the tenant has a single owner daemon.
 * **FaultPlan partitions** — a seeded fault plan periodically
   partitions one daemon's data plane (ERROR rules) and heals it, so
   breakers trip, degraded evaluation engages, and the GLOBAL plane
@@ -167,12 +175,25 @@ def main() -> int:
     # Zipf ranks -> key ids (bounded; np.random.zipf is unbounded)
     zipf_pool = (rng.zipf(args.zipf_a, size=200_000) - 1) % args.keys
 
+    # Tenant pool (the cost-observatory soak satellite): the planted
+    # hot tenant rides every burst ON ONE FIXED KEY — a single hash
+    # key has a single owner daemon, which is where the "is the hot
+    # tenant ranked #1 on its owner" assertion is checked; steady
+    # lanes rotate over the cold tenants.
+    HOT_TENANT = "tenant-hot"
+    HOT_KEY = f"{HOT_TENANT}_hot"  # name_unique-key, the hash-key rule
+    cold_tenants = [f"tenant-{c}" for c in "abcdef"]
+
     def worker(wid: int) -> None:
         wrng = np.random.RandomState(args.seed * 1000 + wid)
         client = V1Client(addrs[wid % len(addrs)], timeout_s=60.0)
         i = 0
         while not stop.is_set():
-            burst = (i % 40) == 39
+            # Burst cadence sized so the hot tenant DOMINATES: ~1/15
+            # of requests x 200 lanes ≈ half of all lanes, vs ~1/6 of
+            # the rest per cold tenant — rank #1 must be unambiguous
+            # on every daemon even in a 60s smoke.
+            burst = (i % 15) == 14
             lanes = 200 if burst else int(wrng.choice([1, 8, 50]))
             ids = (
                 np.full(lanes, zipf_pool[wrng.randint(len(zipf_pool))])
@@ -181,8 +202,11 @@ def main() -> int:
             )
             reqs = [
                 RateLimitRequest(
-                    name="soak",
-                    unique_key=f"k{int(k)}",
+                    name=(
+                        HOT_TENANT if burst
+                        else cold_tenants[(int(k) + j) % len(cold_tenants)]
+                    ),
+                    unique_key="hot" if burst else f"k{int(k)}",
                     hits=1,
                     limit=1_000_000_000,
                     duration=300_000,
@@ -191,7 +215,10 @@ def main() -> int:
                         else Algorithm.LEAKY_BUCKET
                     ),
                     behavior=(
-                        int(Behavior.GLOBAL) if int(k) % 17 == 0
+                        # The hot tenant stays on the plain forwarded
+                        # fast path: its folds land at ONE owner.
+                        0 if burst
+                        else int(Behavior.GLOBAL) if int(k) % 17 == 0
                         else int(Behavior.MULTI_REGION)
                         if n_regions and int(k) % 13 == 5
                         else 0
@@ -322,6 +349,26 @@ def main() -> int:
                         f"{addr}: AUDIT VIOLATIONS {aud['violations']} "
                         f"ledger={aud['ledger']}"
                     )
+                # Cost observatory: the tenant ledger must CONSERVE on
+                # every poll — top-K rows + the `other` rollup must sum
+                # exactly to the totals for every stat (eviction moves
+                # stats between buckets, never loses them).
+                try:
+                    ten = _fetch(addr, "/debug/tenants")
+                except OSError:
+                    continue  # reachability already judged above
+                for stat in ("hits", "lanes", "overLimit", "shed",
+                             "ingressBytes"):
+                    parts = (
+                        sum(r[stat] for r in ten["topk"])
+                        + ten["other"][stat]
+                    )
+                    if parts != ten["totals"][stat]:
+                        failures.append(
+                            f"{addr}: tenant ledger LEAK on {stat}: "
+                            f"topk+other={parts} != "
+                            f"totals={ten['totals'][stat]}"
+                        )
             with lock:
                 nerr = len(stats["errors"])
                 reqs = stats["requests"]
@@ -353,6 +400,62 @@ def main() -> int:
             sample = _fetch(addrs[0], "/debug/audit")
         except OSError:
             pass
+        # -- cost-observatory final reconciliation (quiesced) ----------
+        # (1) The planted hot tenant must be ranked #1 on its owner
+        # daemon: HOT_KEY has exactly one owner in the current ring,
+        # and every burst lane folded there (locally or through the
+        # peer door).
+        try:
+            owner_addr = (
+                cl.daemons[0].service.get_peer(HOT_KEY).info.grpc_address
+            )
+            owner = next(
+                d for d in cl.daemons
+                if d.peer_info.grpc_address == owner_addr
+            )
+            ten = _fetch(owner.gateway.address, "/debug/tenants")
+            if not ten["topk"] or ten["topk"][0]["tenant"] != HOT_TENANT:
+                failures.append(
+                    f"hot tenant not #1 on owner {owner.gateway.address}: "
+                    f"top={[r['tenant'] for r in ten['topk'][:3]]}"
+                )
+            else:
+                print(
+                    f"soak: hot tenant '{HOT_TENANT}' ranked #1 on owner "
+                    f"{owner.gateway.address} "
+                    f"(hits={ten['topk'][0]['hits']})"
+                )
+        except Exception as e:  # noqa: BLE001
+            failures.append(f"hot-tenant owner check failed: {e}")
+        # (2) The summed per-daemon tenant ledgers must reconcile
+        # EXACTLY with the audit ledger's ingress counters: every
+        # audit ingress note has a tenant fold beside it, so at
+        # quiesce  sum(tenant totals.hits) == ingress_hits +
+        # peer_ingress_hits  (the in-process cluster shares one audit
+        # ledger; forwarded lanes count once per door on both sides).
+        try:
+            from gubernator_tpu import audit as audit_ledger
+
+            tenant_hits = sum(
+                d.service.tenants.totals()["hits"] for d in cl.daemons
+            )
+            led = audit_ledger.ledger_snapshot()
+            audit_ingress = (
+                led.get("ingress_hits", 0) + led.get("peer_ingress_hits", 0)
+            )
+            if tenant_hits != audit_ingress:
+                failures.append(
+                    f"tenant ledger does not reconcile with audit: "
+                    f"sum(tenant hits)={tenant_hits} != ingress_hits+"
+                    f"peer_ingress_hits={audit_ingress}"
+                )
+            else:
+                print(
+                    f"soak: tenant ledgers reconcile with audit ingress "
+                    f"({tenant_hits} hits)"
+                )
+        except Exception as e:  # noqa: BLE001
+            failures.append(f"tenant/audit reconciliation failed: {e}")
         faults.uninstall()
         cl.stop()
 
