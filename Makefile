@@ -1,5 +1,15 @@
 # Reference Makefile:1-35 equivalents for the TPU build.
-.PHONY: test tier1 chaos bench bench-gate bench-trend soak soak-smoke soak-regions proto certs docker release clean
+.PHONY: test tier1 chaos bench bench-gate bench-trend soak soak-smoke soak-regions proto certs docker release clean native
+
+# Compile the C++ host runtime for the CURRENT source of
+# gubernator_tpu/native/host_runtime.cpp.  Flags are pinned in ONE
+# place (native.CXX_FLAGS) shared with the on-import rebuild, and the
+# output is the hash-suffixed `_host_runtime_<sha256[:16]>.so` that
+# tests/test_native_build.py requires to match the source in tier-1 —
+# after editing the .cpp, run this and commit the fresh .so (deleting
+# the superseded one).
+native:
+	python -c "from gubernator_tpu import native; print(native.build())"
 
 # The whole suite on the virtual 8-device CPU mesh (conftest.py forces
 # it); -p no:cacheprovider keeps runs hermetic like -count=1.

@@ -1384,6 +1384,226 @@ def measure_ingress_columns(mode: str = "columns", n_threads: int = 8,
                 c.close()
 
 
+def measure_native_ingress(conns: int = 8, depth: int = 10,
+                           batch: int = 4096, dup: int = 4,
+                           window_s: float = 3.0, quads: int = 2) -> dict:
+    """Native-service-loop ingress throughput over the REAL wire, BOTH
+    legs in one run: a GUBER_NATIVE_INGRESS=1 daemon (the GIL-free loop
+    — accept -> kind-5 validate -> FNV-1 hash + ring route -> coalesce
+    -> one Python dispatch per batch -> kind-6 fill -> write) and a
+    GUBER_NATIVE_INGRESS=0 daemon (exactly the PR 8 Python-assembled
+    edge), each in its OWN subprocess (the loopback GIL rule) with
+    GUBER_ACCEPTORS=2, alive SIMULTANEOUSLY and driven ALTERNATELY in
+    ABBA quads — host weather drifts cancel inside a quad instead of
+    landing on whichever leg ran second (the PR 12 _overhead_pairs
+    discipline), which is what makes native_vs_pr8_ratio trustworthy on
+    a weather-prone box.
+
+    The driver is deliberately client-cost-free: each connection
+    pipelines ONE pre-encoded `batch`-lane frame `depth` deep and just
+    counts responses, so both legs measure the SERVER.  The workload is
+    the HOT-WINDOW shape the columnar client produces under load — each
+    frame carries `batch` checks over batch/dup distinct keys (`dup`
+    concurrent callers per key coalesced into one window flush, the
+    reference's thundering-herd case and the analytic-duplicate
+    kernel's reason to exist), and the deep pipeline keeps many frames
+    pending so the native ring coalesces them into device-ceiling
+    takes.
+
+    Returns {"checks_per_s" (best native window), "noise"
+    (best-vs-median half-gap), "pr8_checks_per_s", "ratio" (median
+    per-quad ratio), "ratio_noise" (quad half-spread),
+    "steady_recompiles" (native daemon, during the timed windows; None
+    if the telemetry plane is absent), "audit_violations"}."""
+    import contextlib
+    import json as _json
+    import socket
+    import threading
+    import urllib.request
+
+    from gubernator_tpu import wire
+
+    base_env = {
+        "GUBER_NATIVE_HTTP": "1",
+        "GUBER_ACCEPTORS": "2",
+        "GUBER_INGRESS_COLUMNS": "1",
+        "GUBER_CACHE_SIZE": "262144",
+        # The pipelined in-flight lanes (conns x depth x batch = 327k)
+        # must fit the shed bound — this bench measures throughput, not
+        # the 429 path (tests/test_native_loop.py covers shed parity).
+        "GUBER_INGRESS_QUEUE_LANES": "524288",
+        # A 4-way virtual mesh pipelines measurably better than the
+        # harness default 2 on this box at device-ceiling takes
+        # (smaller per-shard pads + deeper inter-op overlap: +12%
+        # measured; both legs get the same config so the ratio is
+        # untouched).
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        # Pad LADDER: takes are 1-15 frames of `batch` lanes over the 4
+        # CPU shards (per-shard m = take/4 -> pow2 pads 1024..16384), so
+        # force-warm EVERY bucket a take can land in — a weather-starved
+        # window can shrink a take to one frame, and any compile during
+        # the timed windows is shape churn the steady_recompiles row
+        # must catch, not pay.
+        "GUBER_WARMUP_SHAPES": "1,1000,4096,8192,16384,32768,60000",
+        "GUBER_AUDIT_INTERVAL": "1s",
+    }
+
+    def _debug(port: int, path: str) -> dict:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/{path}", timeout=10
+        ) as f:
+            return _json.loads(f.read())
+
+    payloads = []
+    for t in range(conns):
+        frame = wire.encode_ingress_frame((
+            ["bench"] * batch,
+            [f"ni{t}:{i // dup}" for i in range(batch)],
+            # Algorithm alternates per KEY (constant inside a duplicate
+            # group — mixed configs would demote the group off the
+            # analytic round-0 path).
+            (np.arange(batch) // dup % 2).astype(np.int32),
+            np.zeros(batch, np.int32),
+            np.ones(batch, np.int64),
+            np.full(batch, 1_000_000_000, np.int64),
+            np.full(batch, 3_600_000, np.int64),
+        ))
+        payloads.append((
+            f"POST /v1/GetRateLimits HTTP/1.1\r\nHost: b\r\n"
+            f"Content-Type: {wire.COLUMNS_CONTENT_TYPE}\r\n"
+            f"Content-Length: {len(frame)}\r\n\r\n"
+        ).encode() + frame)
+
+    def _window(port: int, timed_s: float) -> float:
+        """One driver session: connect, fill the pipeline, settle, time
+        a mid-stream window, tear down.  Returns checks/s."""
+        stop = threading.Event()
+        counts = [0] * conns
+        errors: list = []
+
+        def run_conn(t: int) -> None:
+            try:
+                s = socket.create_connection(("127.0.0.1", port),
+                                             timeout=60.0)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                rf = s.makefile("rb")
+                payload = payloads[t]
+                try:
+                    for _ in range(depth):
+                        s.sendall(payload)
+                    while not stop.is_set():
+                        line = rf.readline()
+                        if not line.startswith(b"HTTP/1.1 200"):
+                            raise RuntimeError(f"bad response: {line!r}")
+                        clen = 0
+                        while True:
+                            h = rf.readline()
+                            if h in (b"\r\n", b"\n", b""):
+                                break
+                            if h.lower().startswith(b"content-length"):
+                                clen = int(h.split(b":")[1])
+                        body = rf.read(clen)
+                        if len(body) != clen or body[:4] != b"GUBC":
+                            raise RuntimeError("truncated/non-frame body")
+                        counts[t] += 1
+                        s.sendall(payload)
+                finally:
+                    rf.close()
+                    s.close()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                stop.set()
+
+        threads = [
+            threading.Thread(target=run_conn, args=(t,)) for t in range(conns)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.8)  # pipeline fill + settle
+        c0 = sum(counts)
+        t0 = time.perf_counter()
+        time.sleep(timed_s)
+        dt = time.perf_counter() - t0
+        rate = (sum(counts) - c0) * batch / dt
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        if errors:
+            raise RuntimeError(f"native ingress driver failed: {errors[0]}")
+        return rate
+
+    with contextlib.ExitStack() as stack:
+        native_port, _ = stack.enter_context(_bench_daemon(
+            extra_env={**base_env, "GUBER_NATIVE_INGRESS": "1"},
+            what="native ingress daemon (native)",
+        ))
+        # Phase A — the ABSOLUTE row, native daemon SOLE resident (the
+        # deployed shape: one daemon owns the box): warm, then timed
+        # windows.
+        _window(native_port, window_s)  # warm: residual pads, caches
+        try:
+            rc0 = _debug(native_port, "device").get("steadyRecompiles")
+        except Exception:  # noqa: BLE001 — plane off
+            rc0 = None
+        rates = {"native": [], "pr8": []}
+        for _ in range(3):
+            rates["native"].append(_window(native_port, window_s))
+        # Phase B — the RATIO: bring up the PR 8 leg beside it and
+        # alternate ABBA quads so weather drift cancels inside a quad.
+        pr8_port, _ = stack.enter_context(_bench_daemon(
+            extra_env={**base_env, "GUBER_NATIVE_INGRESS": "0"},
+            what="native ingress daemon (pr8)",
+        ))
+        ports = {"native": native_port, "pr8": pr8_port}
+        _window(pr8_port, window_s)  # warm the PR 8 leg
+        quad_ratios = []
+        quad_rates = {"native": [], "pr8": []}
+        for q in range(quads):
+            order = (
+                ("native", "pr8", "pr8", "native") if q % 2 == 0
+                else ("pr8", "native", "native", "pr8")
+            )
+            quad = {"native": [], "pr8": []}
+            for leg in order:
+                r = _window(ports[leg], window_s)
+                quad_rates[leg].append(r)
+                quad[leg].append(r)
+            quad_ratios.append(
+                (sum(quad["native"]) / 2.0) / max(sum(quad["pr8"]) / 2.0, 1.0)
+            )
+        rates["pr8"] = quad_rates["pr8"]
+        steady = None
+        if rc0 is not None:
+            try:
+                steady = (
+                    _debug(native_port, "device")["steadyRecompiles"] - rc0
+                )
+            except Exception:  # noqa: BLE001
+                steady = None
+        # Let the 1s auditor reconcile the final window, then read the
+        # violation total — the ledger must stay balanced at rate.
+        time.sleep(2.5)
+        violations = _debug(native_port, "audit")["violationTotal"]
+
+    nat = sorted(rates["native"])
+    best = nat[-1]
+    quad_ratios.sort()
+    ratio = quad_ratios[len(quad_ratios) // 2]
+    return {
+        # Noise = the best window's half-gap to the median: the row is
+        # a best-of (one clean multi-second window demonstrates the
+        # sustainable rate); the gate's noise-adjusted verdict turns a
+        # weather dip into an inconclusive SKIP, never a silent flip.
+        "checks_per_s": best,
+        "noise": (best - nat[len(nat) // 2]) / 2.0,
+        "pr8_checks_per_s": max(rates["pr8"]),
+        "ratio": ratio,
+        "ratio_noise": (quad_ratios[-1] - quad_ratios[0]) / 2.0,
+        "steady_recompiles": steady,
+        "audit_violations": violations,
+    }
+
+
 GATE_THRESHOLDS = "benchmarks/gate_thresholds.json"
 LAST_DEVICE_ROWS = "benchmarks/last_device_rows.json"
 
@@ -1416,6 +1636,11 @@ def _save_device_rows(dev, extra=None) -> None:
         },
     }
     if extra:
+        extra = dict(extra)
+        # Per-row noise riding along with non-device rows (the native
+        # ingress windows' spread): merged into the shared noise dict
+        # the gate's noise-adjusted verdicts read.
+        rows["noise"].update(extra.pop("extra_noise", {}))
         rows.update(extra)
     with open(LAST_DEVICE_ROWS, "w") as f:
         json.dump(rows, f)
@@ -1540,6 +1765,30 @@ def gate() -> int:
             )
         except Exception as e:  # noqa: BLE001 — daemon spawn can fail
             print(f"gate ingress_columns_vs_json: SKIP (measure failed: {e})")
+    if "native_ingress_checks_per_s" not in rows:
+        try:
+            ni = measure_native_ingress()
+            rows["native_ingress_checks_per_s"] = ni["checks_per_s"]
+            noise["native_ingress_checks_per_s"] = ni["noise"]
+            # ABBA-interleaved ratio: both daemons alive at once, legs
+            # alternately driven, so host weather cancels inside each
+            # quad and the ratio isolates the native loop itself.
+            rows["native_vs_pr8_ratio"] = ni["ratio"]
+            noise["native_vs_pr8_ratio"] = ni["ratio_noise"]
+            rows["native_ingress_audit_violations"] = ni["audit_violations"]
+            if ni["steady_recompiles"] is not None:
+                rows["native_ingress_steady_recompiles"] = (
+                    ni["steady_recompiles"]
+                )
+            print(
+                f"gate native ingress rows: native {ni['checks_per_s']:.0f} "
+                f"checks/s, pr8 {ni['pr8_checks_per_s']:.0f} checks/s, "
+                f"ratio {ni['ratio']:.2f}, "
+                f"steady_recompiles {ni['steady_recompiles']}, "
+                f"audit_violations {ni['audit_violations']}"
+            )
+        except Exception as e:  # noqa: BLE001 — daemon spawn can fail
+            print(f"gate native_ingress_checks_per_s: SKIP (measure failed: {e})")
     if "global_plane_vs_classic" not in rows:
         try:
             gp_cols = measure_global_plane("columns")
@@ -1825,6 +2074,11 @@ def main():
     ingress_columns_ratio = ingress_columns_cps / max(ingress_json_cps, 1.0)
     _leg("ingress_columns")
 
+    # ---- native service loop vs the PR 8 Python-assembled edge -------
+    native_ingress = measure_native_ingress()
+    native_vs_pr8 = native_ingress["ratio"]
+    _leg("native_ingress")
+
     # ---- peer hop: loopback two-daemon forward (CPU-pinned) ----------
     peer_forward_cps = measure_peer_forward("columns")
     peer_forward_classic_cps = measure_peer_forward("classic")
@@ -1857,6 +2111,16 @@ def main():
         ),
         "ingress_columns_checks_per_sec": ingress_columns_cps,
         "ingress_columns_vs_json": ingress_columns_ratio,
+        "native_ingress_checks_per_s": native_ingress["checks_per_s"],
+        "native_vs_pr8_ratio": native_vs_pr8,
+        "native_ingress_audit_violations": native_ingress["audit_violations"],
+        "extra_noise": {
+            "native_ingress_checks_per_s": native_ingress["noise"],
+            "native_vs_pr8_ratio": native_ingress["ratio_noise"],
+        },
+        **({"native_ingress_steady_recompiles":
+            native_ingress["steady_recompiles"]}
+           if native_ingress["steady_recompiles"] is not None else {}),
         "global_plane_vs_classic": global_plane_ratio,
         "region_plane_vs_classic": region_plane_ratio,
         "dispatch_overlap_ratio": dispatch_overlap_ratio,
@@ -1915,6 +2179,19 @@ def main():
                 ),
                 "ingress_json_checks_per_sec": round(ingress_json_cps, 1),
                 "ingress_columns_vs_json": round(ingress_columns_ratio, 2),
+                "native_ingress_checks_per_s": round(
+                    native_ingress["checks_per_s"], 1
+                ),
+                "native_pr8_checks_per_s": round(
+                    native_ingress["pr8_checks_per_s"], 1
+                ),
+                "native_vs_pr8_ratio": round(native_vs_pr8, 2),
+                "native_ingress_steady_recompiles": (
+                    native_ingress["steady_recompiles"]
+                ),
+                "native_ingress_audit_violations": (
+                    native_ingress["audit_violations"]
+                ),
                 "peer_forward_checks_per_sec": round(peer_forward_cps, 1),
                 "peer_forward_classic_checks_per_sec": round(
                     peer_forward_classic_cps, 1

@@ -46,6 +46,30 @@ from .types import (
 )
 
 
+def _uds_endpoint(endpoint: str) -> Optional[str]:
+    """Socket path of a `unix:///path` endpoint, else None.  The UDS
+    lane (GUBER_UDS_PATH on the native edge) speaks the identical
+    HTTP/1.1 + GUBC protocol over an AF_UNIX stream — same clients,
+    same bytes, no TCP stack."""
+    if endpoint.startswith("unix://"):
+        return endpoint[len("unix://"):]
+    return None
+
+
+class _UnixHTTPConnection(http.client.HTTPConnection):
+    """http.client over an AF_UNIX stream (the classic-JSON leg of a
+    unix:// target; the frame leg rides _PipelinedConn)."""
+
+    def __init__(self, path: str, timeout_s: float):
+        super().__init__("localhost", timeout=timeout_s)
+        self._uds_path = path
+
+    def connect(self):  # noqa: D102 — stdlib override
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.settimeout(self.timeout)
+        self.sock.connect(self._uds_path)
+
+
 class V1Client:
     """HTTP/JSON gateway client.
 
@@ -56,7 +80,11 @@ class V1Client:
     is retried once on a fresh connection transparently, the urllib3
     retry rule — the request provably never reached a handler, so the
     retry cannot double-count.  Failures on a fresh connection surface
-    to the caller unchanged."""
+    to the caller unchanged.
+
+    `endpoint` may be host:port or `unix:///path` (the native edge's
+    same-host UDS lane, GUBER_UDS_PATH); TLS does not apply to UDS
+    targets."""
 
     def __init__(
         self,
@@ -70,6 +98,11 @@ class V1Client:
         self._local = threading.local()  # per-thread persistent conn
 
     def _connect(self):
+        uds = _uds_endpoint(self.endpoint)
+        if uds is not None:
+            if self.tls_context is not None:
+                raise ValueError("TLS is not supported over unix:// targets")
+            return _UnixHTTPConnection(uds, self.timeout_s)
         host, _, port = self.endpoint.partition(":")
         if self.tls_context is not None:
             return http.client.HTTPSConnection(
@@ -183,12 +216,22 @@ class _PipelinedConn:
 
     def __init__(self, endpoint: str, timeout_s: float,
                  tls_context: Optional[ssl.SSLContext] = None):
-        host, _, port = endpoint.partition(":")
-        self._host = host
-        self._sock = socket.create_connection(
-            (host, int(port or 80)), timeout=timeout_s
-        )
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        uds = _uds_endpoint(endpoint)
+        if uds is not None:
+            # Same-host UDS lane: identical protocol, no TCP stack.
+            if tls_context is not None:
+                raise ValueError("TLS is not supported over unix:// targets")
+            self._host = "localhost"
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.settimeout(timeout_s)
+            self._sock.connect(uds)
+        else:
+            host, _, port = endpoint.partition(":")
+            self._host = host
+            self._sock = socket.create_connection(
+                (host, int(port or 80)), timeout=timeout_s
+            )
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if tls_context is not None:
             # Handshake still under timeout_s: a server that accepts
             # TCP but never completes TLS must not park the window's

@@ -61,7 +61,12 @@ class BehaviorConfig:
     # past the point where queued work exceeds any useful deadline,
     # shedding is strictly kinder than queueing.  The default admits
     # ~4 full device dispatch ceilings (4 x 64k lanes); 0 disables the
-    # bound.  Env: GUBER_INGRESS_QUEUE_LANES.
+    # bound.  The bound is PER INGRESS LANE: the native service loop's
+    # ring (GUBER_NATIVE_INGRESS) and the Python coalescing windows
+    # each enforce it on the lanes they queue — mixed fast-lane +
+    # fallback traffic can therefore hold up to 2x this many lanes
+    # total, still bounded, before both lanes shed.
+    # Env: GUBER_INGRESS_QUEUE_LANES.
     ingress_queue_lanes: int = 262_144
     # Columnar peer hop (wire.py "columnar peer hop"): forwarded batches
     # travel as column arrays (proto columns on gRPC, the binary frame
@@ -71,6 +76,16 @@ class BehaviorConfig:
     # mixed-version interop tests run one daemon in this mode).
     # Env: GUBER_PEER_COLUMNS.
     peer_columns: bool = True
+    # Native ingress service loop (host_runtime.cpp gt_ingress_*): on
+    # the native HTTP edge, steady-state kind-5 ingress frames are
+    # validated, hashed, ring-routed, coalesced, dispatched and
+    # answered with Python touching only batch-granularity control —
+    # the GIL leaves the per-frame path entirely.  False = the PR 8
+    # edge: every frame decodes/encodes through the Python gateway
+    # path (behavior-identical — the fast lane serves only semantics
+    # the Python path also serves; this knob exists for A/B and as the
+    # interop-proof off switch).  Env: GUBER_NATIVE_INGRESS.
+    native_ingress: bool = True
     # Public columnar ingress (wire.py "public columnar ingress", the
     # front door): the daemon sniffs GUBC kind-5 frames on
     # POST /v1/GetRateLimits and serves V1/GetRateLimitsColumns over
@@ -282,6 +297,23 @@ class DaemonConfig:
     # /metrics shows ingress-queue 503s).  None = NativeGatewayServer
     # default (4).  Env: GUBER_NATIVE_WORKERS.
     native_workers: "int | None" = None
+    # Native-edge acceptor sharding: N SO_REUSEPORT listen sockets on
+    # the HTTP port, each with its own epoll loop thread, all feeding
+    # the one shared device pipeline — the kernel spreads accepted
+    # connections across the group, so a single serializing accept/
+    # read loop stops being the ingress ceiling.  1 (default) is the
+    # classic single loop, behavior-identical to the pre-sharding
+    # edge.  Only meaningful with GUBER_NATIVE_HTTP=1.
+    # Env: GUBER_ACCEPTORS.
+    acceptors: int = 1
+    # Same-host UDS lane: when set, the native edge ALSO listens on
+    # this AF_UNIX socket path, speaking the identical HTTP/1.1 +
+    # GUBC kind-5/6 protocol (the sidecar deployment shape — a
+    # same-pod client skips the TCP stack entirely).  Clients target
+    # it as `unix:///path` (ColumnsV1Client / V1Client).  A stale
+    # socket file at the path is unlinked at startup; "" disables.
+    # Only meaningful with GUBER_NATIVE_HTTP=1.  Env: GUBER_UDS_PATH.
+    uds_path: str = ""
     # Durability plane (snapshot.py): path of the crash-safe columnar
     # device-state snapshot file.  "" (and the explicit opt-outs "0"/
     # "false"/"off" in the env var) = disabled — every restart is a
@@ -452,6 +484,15 @@ def setup_daemon_config(
     conf.native_workers = _env_int(
         merged, "GUBER_NATIVE_WORKERS", conf.native_workers
     )
+    conf.acceptors = _env_int(merged, "GUBER_ACCEPTORS", conf.acceptors)
+    # Loud, not clamped: GUBER_ACCEPTORS=0 would accept-but-never-
+    # serve and >64 is a misconfiguration, not a scaling plan (each
+    # acceptor is a native thread).
+    if not 1 <= conf.acceptors <= 64:
+        raise ValueError(
+            f"GUBER_ACCEPTORS must be in [1, 64], got '{conf.acceptors}'"
+        )
+    conf.uds_path = merged.get("GUBER_UDS_PATH", conf.uds_path)
     conf.data_center = merged.get("GUBER_DATA_CENTER", "")
     if merged.get("GUBER_WARMUP_SHAPES"):
         conf.warmup_shapes = [
@@ -525,6 +566,9 @@ def setup_daemon_config(
     b.peer_columns = _env_bool(merged, "GUBER_PEER_COLUMNS", b.peer_columns)
     b.ingress_columns = _env_bool(
         merged, "GUBER_INGRESS_COLUMNS", b.ingress_columns
+    )
+    b.native_ingress = _env_bool(
+        merged, "GUBER_NATIVE_INGRESS", b.native_ingress
     )
     b.global_timeout_s = _env_float_ms(merged, "GUBER_GLOBAL_TIMEOUT", b.global_timeout_s)
     b.global_sync_wait_s = _env_float_ms(

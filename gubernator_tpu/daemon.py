@@ -142,7 +142,23 @@ class Daemon:
             self.gateway = NativeGatewayServer(
                 self.service, self.conf.listen_address,
                 n_workers=self.conf.native_workers,
+                acceptors=getattr(self.conf, "acceptors", 1),
+                uds_path=getattr(self.conf, "uds_path", ""),
             )
+            # Native ingress service loop (architecture.md "Native
+            # service loop"): steady-state kind-5 frames run GIL-free
+            # from socket to device pipeline, Python at batch
+            # granularity only.  GUBER_NATIVE_INGRESS=0 = the PR 8
+            # edge, behavior-identical (the interop/A-B off switch).
+            if (
+                self.conf.behaviors.native_ingress
+                and self.service.serves_ingress_columns
+            ):
+                from .gateway import NativeIngressPump
+
+                pump = NativeIngressPump(self.service).start()
+                pump.update_ring()
+                self.gateway.pump = pump
         if self.gateway is None:
             self.gateway = GatewayServer(
                 self.service, self.conf.listen_address, tls_context=server_tls

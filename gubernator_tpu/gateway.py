@@ -25,6 +25,7 @@ from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
+from . import audit as audit_mod
 from . import native as _native
 from . import profiling
 from . import saturation
@@ -251,6 +252,7 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
                     service.metrics.observe_telemetry()
                     service.metrics.observe_audit(service)
                     service.metrics.observe_cost(service)
+                    service.metrics.observe_native_ingress(service)
                     service.metrics.observe_peers(
                         service.get_peer_list()
                         + list(service.get_region_picker().peers())
@@ -843,6 +845,311 @@ _HTTP_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
                  500: "Internal Server Error"}
 
 
+class NativeIngressPump:
+    """Batch-granularity control of the native ingress service loop
+    (host_runtime.cpp gt_ingress_*, architecture.md "Native service
+    loop").
+
+    Gateway workers feed kind-5 frames into the native ring without
+    ever copying their bytes into Python (HttpEdge.next(ingress=...));
+    this pump is the ONLY Python in the steady-state hot path: one
+    take per coalesced batch (zero-copy column views), the
+    batch-granularity observability folds (audit ledger, tenant
+    ledger, hot-key sketch, phase attribution — the PR 6/9/12 planes
+    stay honest), one store dispatch, and one complete that hands the
+    result arrays back to C++ for the per-frame kind-6 response fill
+    and socket write.
+
+    Lanes needing Python semantics never reach here — the native
+    submit falls back to the ordinary gateway path for them (slow
+    behavior bits, validation errors, remote owners, sampled traces,
+    malformed frames), so correctness is identical with the pump on or
+    off; the pump only removes interpreter time from the
+    already-columnar common case."""
+
+    # Behavior bits that demand the Python router (GLOBAL replica
+    # path, MULTI_REGION hit queueing, Gregorian resolution,
+    # NO_BATCHING direct dispatch): any lane carrying one makes the
+    # whole frame fall back.
+    FALLBACK_BEHAVIOR = 1 | 2 | 4 | 16
+
+    #: Lane ceiling of one coalesced take = the device dispatch
+    #: ceiling (ColumnarBatcher.MAX_LANES — an oversized dispatch
+    #: would pad into a brand-new XLA bucket and compile mid-traffic).
+    TAKE_LANES = 64_000
+    #: Overlapping dispatches in flight (the PR 3 pipeline overlaps
+    #: host work behind device compute underneath this bound; 6 keeps
+    #: the device fed through a host-side hiccup without queueing work
+    #: past any useful deadline — the native ring's shed bound still
+    #: caps total admitted lanes).
+    DEPTH = 6
+    #: Take/dispatch threads.  Two, like the headline bench loop: the
+    #: PREPARE of take N+1 (the C++ mesh plan, under `_plan_lock`)
+    #: overlaps take N's STAGE/LAUNCH (store lock) — on one thread the
+    #: two stages serialize and the ~equal-cost halves each idle while
+    #: the other runs (measured ~1.6x at 60k-lane takes on the 2-core
+    #: dev box).
+    N_PUMPS = 2
+
+    def __init__(self, service: V1Service, take_lanes: "Optional[int]" = None):
+        from concurrent.futures import ThreadPoolExecutor
+
+        from . import native as _nat
+
+        self.service = service
+        self.batcher = _nat.IngressBatcher()
+        self.take_lanes = take_lanes or self.TAKE_LANES
+        self._sem = threading.Semaphore(self.DEPTH)
+        self._stopped = threading.Event()
+        self._threads: list = []
+        self._done_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="native-ingress-done"
+        )
+        self._ring_lock = threading.Lock()
+        self._ring = None
+        self._eligible = False
+        self._enable_at = 0.0
+        self._shed_seen = 0
+        # The set_peers hook: the service pushes ring snapshots here.
+        service.native_ingress = self
+
+    @property
+    def active(self) -> bool:
+        """Whether workers should offer frames to the native lane.
+        Sampled tracing turns it off wholesale — the Python path owns
+        span creation — which keeps GUBER_TRACE_SAMPLE>0 semantics
+        identical to PR 8 at the cost of the fast lane."""
+        return (
+            not self._stopped.is_set()
+            and not tracing.enabled()
+            and not getattr(self.service, "_closed", False)
+        )
+
+    def stats(self) -> dict:
+        return self.batcher.stats()
+
+    # -- ring push (service.set_peers -> update_ring) ------------------
+    def update_ring(self) -> None:
+        """Recompute and push the native route snapshot: sorted vnode
+        hashes + per-vnode self bits off the live picker (the
+        ownership-code pass of hash_ring.get_batch_codes reduced to
+        the one question the fast lane asks).  During a reshard
+        double-dispatch window the lane DISABLES — moved keys owe the
+        old owner a peek only the Python router performs — and
+        re-enables when the window closes."""
+        from .parallel import hash_ring as _hr
+
+        svc = self.service
+        with svc._peer_mutex:
+            picker = svc.local_picker
+            handoff_until = (
+                svc._handoff_deadline if svc._prev_picker is not None else 0.0
+            )
+            vh = np.array(picker._vnode_hashes, dtype=np.uint64, copy=True)
+            codes = np.array(picker._vnode_code, dtype=np.int32, copy=True)
+            ids = list(picker._code_ids)
+            self_codes = []
+            for c, pid in enumerate(ids):
+                peer = picker.get_by_peer_id(pid)
+                info = getattr(peer, "info", None)
+                if info is not None and info.is_owner:
+                    self_codes.append(c)
+            hash_fn = picker.hash_fn
+        if hash_fn is _hr._fnv1a_str:
+            variant = 1
+        elif hash_fn is _hr._fnv1_str:
+            variant = 0
+        else:
+            variant = -1  # custom hash: the native route cannot mirror it
+        vself = (
+            np.isin(codes, np.asarray(self_codes, dtype=np.int32))
+            .astype(np.uint8)
+            if codes.size else np.zeros(0, np.uint8)
+        )
+        now = time.monotonic()
+        enabled = (
+            variant >= 0
+            and bool(ids)
+            and handoff_until <= now
+            and not self._stopped.is_set()
+        )
+        with self._ring_lock:
+            self._ring = (vh, vself, bool(ids) and len(self_codes) == len(ids),
+                          max(variant, 0))
+            # Eligibility WITHOUT the window: what the deadline re-push
+            # may enable (a custom hash_fn or empty ring stays off).
+            self._eligible = variant >= 0 and bool(ids)
+            self._enable_at = handoff_until if handoff_until > now else 0.0
+            self._push(enabled)
+
+    def _push(self, enabled: bool) -> None:
+        # _ring_lock held.
+        vh, vself, all_self, variant = self._ring
+        b = self.service.conf.behaviors
+        self.batcher.set_ring(
+            vh, vself, all_self=all_self, enabled=enabled,
+            cap_lanes=getattr(b, "ingress_queue_lanes", 0),
+            max_frame_lanes=INGRESS_COLUMNS_MAX_LANES,
+            behavior_mask=self.FALLBACK_BEHAVIOR,
+            hash_variant=variant,
+        )
+
+    # -- pump loop ------------------------------------------------------
+    def start(self) -> "NativeIngressPump":
+        for i in range(self.N_PUMPS):
+            t = threading.Thread(
+                target=self._run, daemon=True, name=f"native-ingress-pump-{i}"
+            )
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _run(self) -> None:
+        batcher = self.batcher
+        while not self._stopped.is_set():
+            with self._ring_lock:
+                # Check-and-push under ONE lock hold: a set_peers that
+                # opens a NEW window between a read and the push must
+                # not be re-enabled over; and the re-push honors the
+                # SAME eligibility update_ring derived (a custom
+                # hash_fn or empty ring stays disabled).
+                if self._enable_at and time.monotonic() >= self._enable_at:
+                    self._enable_at = 0.0
+                    self._push(
+                        self._eligible and not self._stopped.is_set()
+                    )
+            with profiling.scope("epoll.wait"):
+                tb = batcher.take(self.take_lanes, timeout_ms=200)
+            # Overload-signal parity with the Python gate: native sheds
+            # happen entirely in C++, so the pump surfaces them into the
+            # flight recorder (the automatic-dump trigger shedding
+            # exists for) and samples the ring depth for /debug/status.
+            st = batcher.stats()
+            saturation.observe_queue_depth(st["pendingLanes"])
+            shed = st["shedLanes"]
+            if shed > self._shed_seen:
+                tracing.record_event(
+                    "shed", lanes=shed - self._shed_seen,
+                    queued=st["pendingLanes"],
+                    cap=getattr(
+                        self.service.conf.behaviors,
+                        "ingress_queue_lanes", 0,
+                    ),
+                )
+                self._shed_seen = shed
+            if tb is None:
+                if batcher.stopped:
+                    return
+                continue
+            self._sem.acquire()
+            try:
+                args = self._submit(tb)
+            except BaseException as e:  # noqa: BLE001
+                self._sem.release()
+                self._fail(tb, e)
+                continue
+            self._done_pool.submit(self._complete, *args)
+
+    def _submit(self, tb):
+        """One batch through the funnel's batch-granularity duties:
+        conservation ledger, tenant fold, hot-key sketch (riding the
+        hashes the native route already computed — zero extra
+        hashing), phase attribution, then ONE columnar dispatch."""
+        svc = self.service
+        audit_mod.note("ingress_hits", int(tb.hits.sum()))
+        tenant_ctx = svc.tenants.fold_admit(tb)
+        svc.hotkeys.update(tb.hashes, tb.hash_keys)
+        nf = max(tb.n_frames, 1)
+        saturation.observe_phase("ingress.parse", tb.parse_ns_total / 1e9 / nf)
+        for age_us in tb.frame_age_us:
+            saturation.observe_phase("batch.window", float(age_us) / 1e6)
+        t0 = time.perf_counter()
+        handle = svc.store.apply_columns_async(
+            tb.hash_keys, tb.algorithm, tb.behavior, tb.hits, tb.limit,
+            tb.duration, svc.clock.now_ms(),
+        )
+        return tb, handle, tenant_ctx, t0
+
+    def _complete(self, tb, handle, tenant_ctx, t0) -> None:
+        svc = self.service
+        m = svc.metrics
+        rpc = "/pb.gubernator.V1/GetRateLimits"
+        try:
+            try:
+                out = handle.result()
+                nf = tb.n_frames
+                # Copies of everything needed past complete() — the
+                # batch's views die inside it.
+                ages_s = tb.frame_age_us.astype(np.float64) / 1e6
+                result = ColumnarResult(
+                    n=tb.n,
+                    status=np.asarray(out["status"], dtype=np.int32),
+                    limit=np.asarray(out["limit"], dtype=np.int64),
+                    remaining=np.asarray(out["remaining"], dtype=np.int64),
+                    reset_time=np.asarray(out["reset_time"], dtype=np.int64),
+                    overrides={},
+                )
+                svc.tenants.fold_outcome(tenant_ctx, result)
+                t_enc = time.perf_counter()
+                with profiling.scope("response.encode"):
+                    self.batcher.complete(
+                        tb, result.status, result.limit, result.remaining,
+                        result.reset_time,
+                    )
+                saturation.observe_phase(
+                    "response.encode",
+                    (time.perf_counter() - t_enc) / max(nf, 1),
+                )
+                dt_disp = time.perf_counter() - t0
+                m.ingress_columns_batches.labels(encoding="frame").inc(nf)
+                m.request_counts.labels(status="0", method=rpc).inc(nf)
+                duration = m.request_duration.labels(method=rpc)
+                for age in ages_s:
+                    dt = float(age) + dt_disp
+                    duration.observe(dt)
+                    m.observe_latency(rpc, dt)
+            except BaseException as e:  # noqa: BLE001
+                self._fail(tb, e)
+        finally:
+            self._sem.release()
+
+    def _fail(self, tb, exc: BaseException) -> None:
+        nf = tb.n_frames
+        status, ctype, body = _error_triplet(exc)
+        self.batcher.fail(
+            tb, status, _HTTP_REASONS.get(status, "Error"), ctype, body
+        )
+        self.service.metrics.request_counts.labels(
+            status="1", method="/pb.gubernator.V1/GetRateLimits"
+        ).inc(nf)
+
+    def stop(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        # Detach from the scrape surface FIRST: a /metrics scrape must
+        # not read batcher stats across the free below.
+        if getattr(self.service, "native_ingress", None) is self:
+            self.service.native_ingress = None
+        # Wake the pump + 503 queued frames; in-flight dispatches
+        # complete through the done pool.  The batcher is NOT freed
+        # here: gateway workers may still be blocked in
+        # edge.next(ingress=...) and a submit against freed memory is a
+        # use-after-free — a stopped batcher answers every submit with
+        # the fallback code instead.  NativeGatewayServer.close calls
+        # release() once its workers are joined.
+        self.batcher.stop()
+        for t in self._threads:
+            t.join(timeout=15.0)
+        self._done_pool.shutdown(wait=True)
+
+    def release(self) -> None:
+        """Free the native batcher.  Only safe after every thread that
+        could submit into it (the gateway workers) has exited."""
+        if all(not t.is_alive() for t in self._threads):
+            self.batcher.free()
+
+
 class NativeGatewayServer:
     """The C++ epoll edge (host_runtime.cpp gt_http_*): one native
     thread owns accept/read/frame/write for every connection; N Python
@@ -861,7 +1168,8 @@ class NativeGatewayServer:
     N_WORKERS = 4
 
     def __init__(self, service: V1Service, listen_address: str = "127.0.0.1:0",
-                 n_workers: "Optional[int]" = None):
+                 n_workers: "Optional[int]" = None, acceptors: int = 1,
+                 uds_path: str = ""):
         from . import native as _nat
 
         self.service = service
@@ -871,10 +1179,21 @@ class NativeGatewayServer:
                 f"native_workers must be >= 1, got {n_workers}"
             )
         self.n_workers = self.N_WORKERS if n_workers is None else n_workers
-        self._edge = _nat.HttpEdge(listen_address)  # raises if unavailable
+        self._edge = _nat.HttpEdge(  # raises if unavailable
+            listen_address, acceptors=acceptors, uds_path=uds_path,
+        )
         self._host = listen_address.partition(":")[0] or "127.0.0.1"
         self._threads: list = []
         self._stopped = threading.Event()
+        # The native ingress service loop (NativeIngressPump): attached
+        # by the daemon when the fast lane is on.  Workers hand kind-5
+        # tokens to its batcher via edge.next(ingress=...); close()
+        # stops it BEFORE the edge so staged responses never touch a
+        # freed server.
+        self.pump: "Optional[NativeIngressPump]" = None
+        # Per-service scrape surface (metrics.observe_native_ingress).
+        service.native_edges = getattr(service, "native_edges", [])
+        service.native_edges.append(self._edge)
         # Responses not yet handed back to the C++ edge: free() must
         # wait for this to reach zero — async completions outlive the
         # worker threads, and edge.respond on freed memory is a
@@ -895,16 +1214,28 @@ class NativeGatewayServer:
             self._threads.append(t)
 
     def _worker(self) -> None:
+        from .native import FAST_LANE
+
         edge, service = self._edge, self.service
         while not self._stopped.is_set():
+            # The native fast lane: when the pump is attached, a kind-5
+            # ingress frame is validated/hashed/routed/enqueued INSIDE
+            # edge.next (one GIL-released native call) and this worker
+            # never sees its bytes — Python's per-frame cost is the
+            # token round trip.  Fallback reasons fall through to the
+            # unchanged path below.
+            pump = self.pump
+            ingress = pump.batcher if pump is not None and pump.active else None
             # Cost profiler: time blocked in the native queue pull (the
             # GIL is released inside edge.next) folds as epoll.wait —
             # the "GIL-idle in epoll" answer, distinct from parse work.
             with profiling.scope("epoll.wait"):
-                got = edge.next(timeout_ms=200)
+                got = edge.next(timeout_ms=200, ingress=ingress)
             if got is None:
                 if edge.stopped:
                     return
+                continue
+            if got is FAST_LANE:
                 continue
             token, method, path, body = got
             if getattr(service, "_closed", False):
@@ -934,8 +1265,12 @@ class NativeGatewayServer:
         # the workers — possibly mid-device-round, about to respond() —
         # are joined BEFORE free() releases it.  A worker stuck past the
         # join timeout leaks the server instead of crashing into freed
-        # memory.
+        # memory.  The pump stops FIRST: its completions stage
+        # responses into the edge, so it must drain while the server is
+        # still allocated (respond-after-shutdown is a C++-side no-op).
         self._stopped.set()
+        if self.pump is not None:
+            self.pump.stop()
         self._edge.shutdown()
         deadline = time.monotonic() + 30.0
         for t in self._threads:
@@ -950,7 +1285,17 @@ class NativeGatewayServer:
                 timeout=max(deadline - time.monotonic(), 0.1),
             )
             drained = self._pending == 0
-        if drained and all(not t.is_alive() for t in self._threads):
+        workers_done = all(not t.is_alive() for t in self._threads)
+        if self.pump is not None and workers_done:
+            # Workers are out of edge.next: no submit can reach the
+            # batcher anymore.
+            self.pump.release()
+        # Off the scrape surface before the native server frees: a
+        # /metrics scrape must never reach a freed edge.
+        edges = getattr(self.service, "native_edges", None)
+        if edges is not None and self._edge in edges:
+            edges.remove(self._edge)
+        if drained and workers_done:
             self._edge.free()
 
 

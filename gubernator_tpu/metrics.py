@@ -144,6 +144,45 @@ class Metrics:
             ["encoding"],
             registry=self.registry,
         )
+        # -- native service loop (host_runtime.cpp gt_ingress_*) -------
+        self.native_ingress_batches = Counter(
+            "gubernator_native_ingress_batches",
+            "Coalesced batches the native ingress service loop handed "
+            "to the Python pump (stat = frames/lanes/batches/fallbacks; "
+            "fallbacks = kind-5 frames that took the Python path for "
+            "semantics the fast lane does not serve).",
+            ["stat"],
+            registry=self.registry,
+        )
+        self.ingress_acceptor_requests = Gauge(
+            "gubernator_ingress_acceptor_requests",
+            "Requests parsed per native acceptor loop (GUBER_ACCEPTORS "
+            "SO_REUSEPORT sharding + the GUBER_UDS_PATH lane; the "
+            "fairness surface — all acceptors of a loaded group must "
+            "show progress).",
+            ["acceptor", "transport"],
+            registry=self.registry,
+        )
+        self.ingress_acceptor_conns = Gauge(
+            "gubernator_ingress_acceptor_conns",
+            "Connections accepted per native acceptor loop (cumulative).",
+            ["acceptor", "transport"],
+            registry=self.registry,
+        )
+        self.ingress_acceptor_frames = Gauge(
+            "gubernator_ingress_acceptor_frames",
+            "Kind-5 ingress frames consumed by the native fast lane per "
+            "acceptor loop (cumulative).",
+            ["acceptor", "transport"],
+            registry=self.registry,
+        )
+        self.ingress_acceptor_lanes = Gauge(
+            "gubernator_ingress_acceptor_lanes",
+            "Rate-limit check lanes consumed by the native fast lane "
+            "per acceptor loop (cumulative).",
+            ["acceptor", "transport"],
+            registry=self.registry,
+        )
         # -- columnar GLOBAL replication plane (service.GlobalManager) -
         self.global_broadcast_batches = Counter(
             "gubernator_global_broadcast_batches",
@@ -715,6 +754,46 @@ class Metrics:
                 time.time() - snaps.last_save_unix
                 if snaps.last_save_unix else -1.0
             )
+
+    def observe_native_ingress(self, service) -> None:
+        """Refresh the native-service-loop families (collect-on-scrape,
+        under the scrape lock like every observer): per-acceptor
+        counters from the epoll edges (the REUSEPORT fairness surface)
+        and the pump's batch/fallback/shed totals.  Native sheds feed
+        the SAME gubernator_ingress_shed_total the Python gate
+        increments — one overload signal regardless of which tier
+        declined the work — via a delta so the two sources compose."""
+        for edge in getattr(service, "native_edges", ()):
+            try:
+                rows = edge.acceptor_stats()
+            except (OSError, AttributeError):
+                continue
+            for i, row in enumerate(rows):
+                transport = "uds" if row["uds"] else "tcp"
+                lab = {"acceptor": str(i), "transport": transport}
+                self.ingress_acceptor_conns.labels(**lab).set(row["accepted"])
+                self.ingress_acceptor_requests.labels(**lab).set(
+                    row["requests"]
+                )
+                self.ingress_acceptor_frames.labels(**lab).set(
+                    row["ingressFrames"]
+                )
+                self.ingress_acceptor_lanes.labels(**lab).set(
+                    row["ingressLanes"]
+                )
+        pump = getattr(service, "native_ingress", None)
+        if pump is None:
+            return
+        stats = pump.stats()
+        for stat in ("frames", "lanes", "batches", "fallbacks"):
+            self._bump(
+                self.native_ingress_batches.labels(stat=stat), stats[stat]
+            )
+        shed = stats["shedLanes"]
+        prev = getattr(self, "_native_shed_seen", 0)
+        if shed > prev:
+            self.ingress_shed.inc(shed - prev)
+            self._native_shed_seen = shed
 
     def observe_telemetry(self) -> None:
         """Refresh the XLA/device telemetry families from the

@@ -27,12 +27,44 @@ _lib = None
 _lib_err: Optional[str] = None
 _build_lock = threading.Lock()
 
+# THE compile flags, pinned in one place: `make native`, the on-import
+# rebuild and the tier-1 source-hash check all go through here, so a
+# flag tweak cannot fork a differently-built .so from the one the
+# hash-suffix discipline vouches for.
+CXX = "g++"
+CXX_FLAGS = ["-O2", "-std=c++17", "-shared", "-fPIC"]
+
+
+def source_digest() -> str:
+    """First 16 hex chars of sha256(host_runtime.cpp) — the .so name
+    suffix (`_host_runtime_<digest>.so`).  A checked-in binary whose
+    suffix does not match the current source is stale by definition
+    (tests/test_native_build.py enforces this in tier-1)."""
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
+def lib_path() -> str:
+    """Path the current source compiles to (exists or not)."""
+    return _LIB_TMPL.format(digest=source_digest())
+
+
+def build() -> str:
+    """Compile the runtime for the current source if its .so is absent
+    (the `make native` entry point); returns the .so path."""
+    path = lib_path()
+    if not os.path.exists(path):
+        err = _compile(path)
+        if err is not None:
+            raise RuntimeError(err)
+    return path
+
 
 def _compile(lib_path: str) -> Optional[str]:
     """Compile the runtime to lib_path via unique-tmp + rename; returns
     an error string or None."""
     tmp = f"{lib_path}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
+    cmd = [CXX, *CXX_FLAGS, _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, lib_path)
@@ -43,9 +75,7 @@ def _compile(lib_path: str) -> Optional[str]:
 
 def _build() -> Optional[ctypes.CDLL]:
     global _lib_err
-    with open(_SRC, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    lib_path = _LIB_TMPL.format(digest=digest)
+    lib_path = _LIB_TMPL.format(digest=source_digest())
     if os.path.exists(lib_path):
         # Refresh mtime: the stale-prune below is age-based, and an
         # old-mtime .so being REUSED by this process must not look
@@ -197,9 +227,12 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.gt_frame_fill.argtypes = [c.c_void_p] + [c.c_void_p] * 3
     lib.gt_frame_free.argtypes = [c.c_void_p]
     lib.gt_http_start.restype = c.c_void_p
-    lib.gt_http_start.argtypes = [c.c_char_p, c.c_int]
+    lib.gt_http_start.argtypes = [c.c_char_p, c.c_int, c.c_int, c.c_char_p]
     lib.gt_http_port.restype = c.c_int
     lib.gt_http_port.argtypes = [c.c_void_p]
+    lib.gt_http_acceptor_count.restype = c.c_int
+    lib.gt_http_acceptor_count.argtypes = [c.c_void_p]
+    lib.gt_http_acceptor_stats.argtypes = [c.c_void_p, c.c_void_p]
     lib.gt_http_next.restype = c.c_int
     lib.gt_http_next.argtypes = [c.c_void_p, c.c_int64, c.c_void_p]
     lib.gt_http_respond.argtypes = [
@@ -208,6 +241,28 @@ def _build() -> Optional[ctypes.CDLL]:
     ]
     lib.gt_http_shutdown.argtypes = [c.c_void_p]
     lib.gt_http_free.argtypes = [c.c_void_p]
+    lib.gt_ingress_new.restype = c.c_void_p
+    lib.gt_ingress_new.argtypes = []
+    lib.gt_ingress_set_ring.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64,  # vh, vself, nv
+        c.c_int32, c.c_int32,                           # all_self, enabled
+        c.c_int64, c.c_int64,                # cap_lanes, max_frame_lanes
+        c.c_int32, c.c_int32,                # behavior_mask, hash_variant
+    ]
+    lib.gt_ingress_submit.restype = c.c_int
+    lib.gt_ingress_submit.argtypes = [c.c_void_p, c.c_void_p, c.c_uint64]
+    lib.gt_ingress_take.restype = c.c_int
+    lib.gt_ingress_take.argtypes = [
+        c.c_void_p, c.c_int64, c.c_int64,
+        c.POINTER(c.c_void_p), c.c_void_p,
+    ]
+    lib.gt_ingress_complete.argtypes = [c.c_void_p] + [c.c_void_p] * 4
+    lib.gt_ingress_fail.argtypes = [
+        c.c_void_p, c.c_int, c.c_char_p, c.c_char_p, c.c_char_p, c.c_int64,
+    ]
+    lib.gt_ingress_stop.argtypes = [c.c_void_p]
+    lib.gt_ingress_stats.argtypes = [c.c_void_p, c.c_void_p]
+    lib.gt_ingress_free.argtypes = [c.c_void_p]
     return lib
 
 
@@ -862,14 +917,25 @@ class _GtHttpReq(ctypes.Structure):
 _HTTP_METHODS = {0: "GET", 1: "POST"}
 
 
+#: Sentinel next() returns when the native fast lane consumed the
+#: request (gt_ingress_submit took ownership — no Python handling).
+FAST_LANE = object()
+
+_INGRESS_SNIFF = b"GUBC\x01\x05"  # magic + version + kind-5
+
+
 class HttpEdge:
     """ctypes wrapper over the C++ epoll HTTP server (gt_http_*).
 
-    One native thread owns every socket; Python workers call next()
-    (GIL released while blocked in the native wait) and answer with
-    respond().  See gateway.NativeGatewayServer for the worker loop."""
+    `acceptors` native epoll threads share the TCP port via
+    SO_REUSEPORT (1 = the classic single loop); `uds_path` adds an
+    AF_UNIX listener speaking the same protocol.  Python workers call
+    next() (GIL released while blocked in the native wait) and answer
+    with respond().  See gateway.NativeGatewayServer for the worker
+    loop."""
 
-    def __init__(self, listen_address: str = "127.0.0.1:0"):
+    def __init__(self, listen_address: str = "127.0.0.1:0",
+                 acceptors: int = 1, uds_path: str = ""):
         lib = _get_lib()
         if lib is None:
             raise RuntimeError(f"native runtime unavailable: {build_error()}")
@@ -881,18 +947,49 @@ class HttpEdge:
         import socket as _socket
 
         host_ip = _socket.gethostbyname(host or "127.0.0.1")
-        self._ptr = lib.gt_http_start(host_ip.encode(), int(port or 0))
+        self._ptr = lib.gt_http_start(
+            host_ip.encode(), int(port or 0), int(acceptors),
+            uds_path.encode(),
+        )
         if not self._ptr:
-            raise OSError(f"gt_http_start failed to bind {listen_address}")
+            raise OSError(
+                f"gt_http_start failed to bind {listen_address}"
+                + (f" / uds {uds_path}" if uds_path else "")
+            )
         self.port = int(lib.gt_http_port(self._ptr))
+        self.acceptors = int(lib.gt_http_acceptor_count(self._ptr))
+        self.uds_path = uds_path
         self.stopped = False
         self._freed = False
         self._stop_lock = threading.Lock()
 
-    def next(self, timeout_ms: int = 200):
+    def acceptor_stats(self):
+        """Per-acceptor counters: list of dicts {uds, accepted,
+        requests, ingressFrames, ingressLanes, wakeups, conns} — the
+        gubernator_ingress_acceptor_* metric source and the fairness
+        tests' oracle.  A freed edge reads as empty, never a crash."""
+        if self._ptr is None:
+            return []
+        n = self.acceptors
+        out = np.zeros(n * 7, dtype=np.int64)
+        self._lib.gt_http_acceptor_stats(self._ptr, out.ctypes.data)
+        keys = ("uds", "accepted", "requests", "ingressFrames",
+                "ingressLanes", "wakeups", "conns")
+        return [
+            dict(zip(keys, (int(v) for v in out[i * 7:(i + 1) * 7])))
+            for i in range(n)
+        ]
+
+    def next(self, timeout_ms: int = 200, ingress=None):
         """Blocks up to timeout_ms for one parsed request.  Returns
-        (token, method, path, body_bytes) or None (timeout/stopping).
-        The body is copied out, so the token may be answered from any
+        (token, method, path, body_bytes), None (timeout/stopping), or
+        FAST_LANE when `ingress` (an IngressBatcher) consumed the
+        request natively — a POST /v1/GetRateLimits whose body sniffs
+        as a kind-5 frame goes through gt_ingress_submit WITHOUT
+        copying the body into Python; any fallback reason (malformed,
+        slow lanes, remote owners, disabled) falls through to the
+        ordinary copy-out so the Python path serves it unchanged.
+        The copied body means the token may be answered from any
         thread at any later time."""
         if self.stopped:
             return None
@@ -900,6 +997,17 @@ class HttpEdge:
         rc = self._lib.gt_http_next(self._ptr, timeout_ms, ctypes.byref(req))
         if rc != 1:
             return None
+        if (
+            ingress is not None
+            and req.method == 1
+            and req.body_len >= 10
+            and ctypes.string_at(req.body, 6) == _INGRESS_SNIFF
+            and req.path == b"/v1/GetRateLimits"
+        ):
+            if self._lib.gt_ingress_submit(
+                self._ptr, ingress._ptr, req.token
+            ) == 0:
+                return FAST_LANE
         method = _HTTP_METHODS.get(req.method, "OTHER")
         path = req.path.decode("utf-8", "replace") if req.path else ""
         body = ctypes.string_at(req.body, req.body_len) if req.body_len else b""
@@ -932,3 +1040,193 @@ class HttpEdge:
             self._freed = True
         self._lib.gt_http_free(self._ptr)
         self._ptr = None
+
+
+class _GtTakenInfo(ctypes.Structure):
+    _fields_ = [
+        ("n", ctypes.c_int64),
+        ("n_frames", ctypes.c_int64),
+        ("algo", ctypes.POINTER(ctypes.c_int32)),
+        ("beh", ctypes.POINTER(ctypes.c_int32)),
+        ("hits", ctypes.POINTER(ctypes.c_int64)),
+        ("limit", ctypes.POINTER(ctypes.c_int64)),
+        ("duration", ctypes.POINTER(ctypes.c_int64)),
+        ("hk", ctypes.POINTER(ctypes.c_uint8)),
+        ("hkoff", ctypes.POINTER(ctypes.c_int64)),
+        ("hk_bytes", ctypes.c_int64),
+        ("hashes", ctypes.POINTER(ctypes.c_uint64)),
+        ("name_blob", ctypes.POINTER(ctypes.c_uint8)),
+        ("name_off", ctypes.POINTER(ctypes.c_int64)),
+        ("name_bytes", ctypes.c_int64),
+        ("uk_blob", ctypes.POINTER(ctypes.c_uint8)),
+        ("uk_off", ctypes.POINTER(ctypes.c_int64)),
+        ("uk_bytes", ctypes.c_int64),
+        ("frame_lanes", ctypes.POINTER(ctypes.c_int64)),
+        ("frame_age_us", ctypes.POINTER(ctypes.c_int64)),
+        ("parse_ns_total", ctypes.c_int64),
+    ]
+
+
+def _view(ptr, n, dtype):
+    """Zero-copy numpy view over a C pointer (no ownership)."""
+    if n == 0:
+        return np.zeros(0, dtype=dtype)
+    return np.ctypeslib.as_array(
+        ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)),
+        shape=((n * np.dtype(dtype).itemsize),),
+    ).view(dtype)
+
+
+class IngressTakenBatch:
+    """One coalesced batch from the native ingress ring: contiguous
+    kernel-ready column arrays spanning every taken frame, as ZERO-COPY
+    numpy views of C++-owned buffers.  Valid ONLY until
+    IngressBatcher.complete()/fail() releases the handle — the pump is
+    the sole owner and must not let views escape the dispatch round.
+
+    Quacks like wire.FrameIngressColumns where the batch-granularity
+    folds need it (len, .hits/.behavior/..., `_nb`/`_no`/`_uo` name
+    columns for the tenant fold, packed hash keys + ring hashes for
+    the hot-key sketch)."""
+
+    __slots__ = ("_ptr", "n", "n_frames", "algorithm", "behavior", "hits",
+                 "limit", "duration", "hash_keys", "hashes", "frame_lanes",
+                 "frame_age_us", "parse_ns_total", "_nb", "_no", "_ub",
+                 "_uo", "trace_ctx")
+
+    def __init__(self, ptr, info: _GtTakenInfo):
+        self._ptr = ptr
+        n = int(info.n)
+        self.n = n
+        self.n_frames = int(info.n_frames)
+        self.algorithm = _view(info.algo, n, np.int32)
+        self.behavior = _view(info.beh, n, np.int32)
+        self.hits = _view(info.hits, n, np.int64)
+        self.limit = _view(info.limit, n, np.int64)
+        self.duration = _view(info.duration, n, np.int64)
+        self.hash_keys = PackedKeys(
+            _view(info.hk, int(info.hk_bytes), np.uint8),
+            _view(info.hkoff, n + 1, np.int64),
+        )
+        self.hashes = _view(info.hashes, n, np.uint64)
+        self._nb = _view(info.name_blob, int(info.name_bytes), np.uint8)
+        self._no = _view(info.name_off, n + 1, np.int64)
+        self._ub = _view(info.uk_blob, int(info.uk_bytes), np.uint8)
+        self._uo = _view(info.uk_off, n + 1, np.int64)
+        self.frame_lanes = _view(info.frame_lanes, self.n_frames, np.int64)
+        self.frame_age_us = _view(info.frame_age_us, self.n_frames, np.int64)
+        self.parse_ns_total = int(info.parse_ns_total)
+        self.trace_ctx = None  # fast lane never carries sampled frames
+
+    def __len__(self) -> int:
+        return self.n
+
+    def _name_at(self, i: int) -> str:
+        return bytes(self._nb[self._no[i]:self._no[i + 1]]).decode("utf-8")
+
+    def _uk_at(self, i: int) -> str:
+        return bytes(self._ub[self._uo[i]:self._uo[i + 1]]).decode("utf-8")
+
+
+class IngressBatcher:
+    """The native ingress ring (gt_ingress_*): gateway workers submit
+    kind-5 frames GIL-free; the NativeIngressPump takes coalesced
+    batches, dispatches them at batch granularity, and completes them
+    back into native kind-6 response fills.  See host_runtime.cpp
+    'Native ingress service loop' for the full contract."""
+
+    STAT_KEYS = ("frames", "lanes", "batches", "shedFrames", "shedLanes",
+                 "fallbacks", "pendingFrames", "pendingLanes")
+
+    def __init__(self):
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError(f"native runtime unavailable: {build_error()}")
+        self._lib = lib
+        self._ptr = lib.gt_ingress_new()
+        self.stopped = False
+
+    def set_ring(self, vnode_hashes, vnode_self, *, all_self: bool,
+                 enabled: bool, cap_lanes: int, max_frame_lanes: int,
+                 behavior_mask: int, hash_variant: int = 0) -> None:
+        vh = np.ascontiguousarray(vnode_hashes, dtype=np.uint64)
+        vs = np.ascontiguousarray(vnode_self, dtype=np.uint8)
+        self._lib.gt_ingress_set_ring(
+            self._ptr, vh.ctypes.data, vs.ctypes.data, len(vh),
+            1 if all_self else 0, 1 if enabled else 0,
+            int(cap_lanes), int(max_frame_lanes), int(behavior_mask),
+            int(hash_variant),
+        )
+
+    def disable(self) -> None:
+        """Fast path off (every submit falls back to Python) without
+        touching the rest of the config."""
+        self.set_ring(
+            np.zeros(0, np.uint64), np.zeros(0, np.uint8),
+            all_self=False, enabled=False, cap_lanes=0,
+            max_frame_lanes=0, behavior_mask=0,
+        )
+
+    def take(self, max_lanes: int, timeout_ms: int = 200):
+        """Block (GIL released) for one coalesced batch; None on
+        timeout or shutdown (check .stopped)."""
+        tb = ctypes.c_void_p()
+        info = _GtTakenInfo()
+        rc = self._lib.gt_ingress_take(
+            self._ptr, int(max_lanes), int(timeout_ms),
+            ctypes.byref(tb), ctypes.byref(info),
+        )
+        if rc == -1:
+            self.stopped = True
+            return None
+        if rc != 1:
+            return None
+        return IngressTakenBatch(tb, info)
+
+    def complete(self, tb: IngressTakenBatch, status, limit, remaining,
+                 reset_time) -> None:
+        """Native response fill: per-frame kind-6 encode + write.
+        Consumes the handle — the batch's views die here.  A handle
+        already consumed is a no-op (an error in post-complete
+        bookkeeping must never double-answer or crash)."""
+        if tb._ptr is None:
+            return
+        status = np.ascontiguousarray(status, dtype=np.int32)
+        limit = np.ascontiguousarray(limit, dtype=np.int64)
+        remaining = np.ascontiguousarray(remaining, dtype=np.int64)
+        reset_time = np.ascontiguousarray(reset_time, dtype=np.int64)
+        ptr, tb._ptr = tb._ptr, None
+        self._lib.gt_ingress_complete(
+            ptr, status.ctypes.data, limit.ctypes.data,
+            remaining.ctypes.data, reset_time.ctypes.data,
+        )
+
+    def fail(self, tb: IngressTakenBatch, status: int, reason: str,
+             content_type: str, body: bytes) -> None:
+        """Error fill: every frame of the batch answers `body`.
+        Consumes the handle; a handle already consumed is a no-op —
+        passing a freed batch into the native fill would be a
+        use-after-free, and its frames were already answered."""
+        if tb._ptr is None:
+            return
+        ptr, tb._ptr = tb._ptr, None
+        self._lib.gt_ingress_fail(
+            ptr, int(status), reason.encode(), content_type.encode(),
+            body, len(body),
+        )
+
+    def stop(self) -> None:
+        """Wake the pump and 503 any still-queued frames."""
+        self.stopped = True
+        self._lib.gt_ingress_stop(self._ptr)
+
+    def stats(self) -> dict:
+        out = np.zeros(8, dtype=np.int64)
+        if self._ptr:  # freed batchers read as all-zero, never crash
+            self._lib.gt_ingress_stats(self._ptr, out.ctypes.data)
+        return dict(zip(self.STAT_KEYS, (int(v) for v in out)))
+
+    def free(self) -> None:
+        ptr, self._ptr = self._ptr, None
+        if ptr:
+            self._lib.gt_ingress_free(ptr)

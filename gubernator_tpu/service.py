@@ -1080,6 +1080,11 @@ class V1Service:
             max_s=conf.behaviors.retry_backoff_max_s,
         )
         self._closed = False
+        # Native service loop attachments (gateway.NativeIngressPump /
+        # NativeGatewayServer register themselves; the /metrics scrape
+        # and set_peers consult these).
+        self.native_ingress = None
+        self.native_edges: list = []
 
         if conf.loader is not None:
             # Loader SPI over the columnar path (store.go:49-58 call
@@ -2934,6 +2939,15 @@ class V1Service:
                     handoff = True
             gen, rh = self.ring_generation, self.ring_hash
 
+        # Native service loop (gateway.NativeIngressPump): push the new
+        # ring snapshot so the GIL-free route check tracks membership —
+        # a membership change with a double-dispatch window DISABLES
+        # the fast lane until the window closes (moved keys owe the old
+        # owner a peek only the Python router performs).
+        pump = getattr(self, "native_ingress", None)
+        if pump is not None:
+            pump.update_ring()
+
         # Handoff FIRST, then dropped-peer shutdowns: both ride the
         # same bounded FIFO pool, and a delta dropping several peers
         # must not park every worker in blocking client drains while
@@ -2951,6 +2965,12 @@ class V1Service:
         if self._closed:
             return
         self._closed = True
+        # Native service loop first: the pump's in-flight dispatches
+        # must resolve against a live store, and its queued frames get
+        # their 503s while the edge still accepts staged responses.
+        pump = getattr(self, "native_ingress", None)
+        if pump is not None:
+            pump.stop()
         self.local_batcher.stop()
         self.columnar_batcher.stop()
         # After the batchers stop every pending future is resolved, so

@@ -79,6 +79,12 @@ EXTENSIONS = frozenset(
         "gubernator_hotkey_topk",
         # PR 8: public columnar ingress (the front door)
         "gubernator_ingress_columns_batches",
+        # PR 13: native service loop (host_runtime.cpp gt_ingress_*)
+        "gubernator_native_ingress_batches",
+        "gubernator_ingress_acceptor_requests",
+        "gubernator_ingress_acceptor_conns",
+        "gubernator_ingress_acceptor_frames",
+        "gubernator_ingress_acceptor_lanes",
         # PR 7: elastic membership / live resharding (reshard.py)
         "gubernator_reshard_transfers",
         "gubernator_reshard_lanes",

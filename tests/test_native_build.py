@@ -1,0 +1,43 @@
+"""Build-chain discipline for the native runtime: the shipped
+`_host_runtime_<digest>.so` (the gitignored build cache `make native`
+and the on-import rebuild both populate) must match a source hash of
+host_runtime.cpp — the hash-suffix rule — so a source edit can never
+silently serve a stale binary and superseded binaries never linger in
+the package."""
+
+from __future__ import annotations
+
+import glob
+import os
+
+from gubernator_tpu import native
+
+
+def test_built_so_matches_source_hash():
+    """The .so whose name suffix is sha256(host_runtime.cpp)[:16] must
+    exist next to the source (build it with `make native`)."""
+    path = native.lib_path()
+    assert os.path.exists(path), (
+        f"native runtime binary is stale or missing: expected {path} "
+        f"(source digest {native.source_digest()}); run `make native`"
+    )
+
+
+def test_no_stale_binaries_shipped():
+    """Exactly one hash-suffixed .so may live in the package: stale
+    siblings from superseded sources must not serve (defense in depth
+    over the age-based runtime prune)."""
+    here = os.path.dirname(os.path.abspath(native.__file__))
+    sos = sorted(glob.glob(os.path.join(here, "_host_runtime_*.so")))
+    assert sos == [native.lib_path()], (
+        f"unexpected native binaries checked in: {sos} "
+        f"(want exactly {native.lib_path()})"
+    )
+
+
+def test_build_is_idempotent_and_loads():
+    """`native.build()` with the binary already present is a no-op
+    returning the same path, and the runtime actually loads."""
+    path = native.build()
+    assert path == native.lib_path()
+    assert native.available(), native.build_error()
