@@ -172,14 +172,51 @@ class BehaviorConfig:
     # Env: GUBER_TRACE_SAMPLE.
     trace_sample: float = 0.0
 
+    # -- millisecond express lane (architecture.md "Express lane") -----
+    # Shallow-queue latency bypass: small submissions dispatch
+    # IMMEDIATELY (no coalescing window) when the batcher queue and the
+    # dispatch pipeline are shallow, singleton checks on CPU backends
+    # take the host-side scalar path (ops/scalar.py, zero device
+    # programs), NO_BATCHING frames ride the native express queue
+    # instead of the Python fallback, and GUBER_LATENCY_TARGET_MS caps
+    # the effective coalescing window (see latency_target_ms below).
+    # False = exact pre-express behavior: every submission waits out
+    # the window, NO_BATCHING frames on the native edge fall back to
+    # Python, the window is occupancy-sized only (the interop/A-B off
+    # switch; byte-identical results either way — the bypass changes
+    # WHEN a dispatch launches, never what it computes).
+    # Env: GUBER_EXPRESS.
+    express: bool = True
+    # Bypass shallow-queue threshold, in queued LANES: a submission
+    # takes the express bypass only while fewer than this many lanes
+    # are queued at its batcher (deeper queues mean the window is
+    # already coalescing real backlog — bypassing it would only add
+    # dispatches without helping latency).  Env: GUBER_EXPRESS_QUEUE_DEPTH.
+    express_queue_depth: int = 64
+    # Bypass small-batch ceiling, in lanes: submissions wider than this
+    # always take the window (a wide batch amortizes its own dispatch;
+    # the bypass exists for the 1-4 lane interactive shapes the fused
+    # size-1/2/4 programs serve).  Env: GUBER_EXPRESS_MAX_LANES.
+    express_max_lanes: int = 4
+    # Host-side scalar fast path for singleton checks on CPU backends
+    # (ops/scalar.py): skip device dispatch entirely, same ticket-order
+    # commit discipline.  Only meaningful with express on; exists as a
+    # separate switch so the bypass can be A/B-tested with and without
+    # the scalar slot.  Env: GUBER_EXPRESS_SCALAR.
+    express_scalar: bool = True
+
     # -- latency SLO engine (saturation.py) ----------------------------
     # Ingress latency target in ms.  > 0 turns on the SLO burn-rate
     # engine: every V1/GetRateLimits is judged good/bad against the
     # target, multi-window (5m/1h) error-budget burn rates export as
     # gubernator_slo_burn_rate, and a page-level fast burn (>= 14.4x
-    # on the 5m window) dumps the flight recorder.  0 (default)
-    # disables the engine — observe degrades to one comparison.
-    # Env: GUBER_LATENCY_TARGET_MS.
+    # on the 5m window) dumps the flight recorder.  Since the express
+    # lane (PR 14) the knob is also BINDING: it caps the effective
+    # coalescing window of both ingress batchers at target/2 (half the
+    # budget for coalescing, half for dispatch+readback — architecture
+    # .md "Express lane"), so occupancy mode yields to latency mode.
+    # 0 (default) disables the engine and leaves the window
+    # occupancy-sized.  Env: GUBER_LATENCY_TARGET_MS.
     latency_target_ms: float = 0.0
     # SLO objective: the fraction of ingress requests that must answer
     # under the target (the error budget is 1 - objective).
@@ -698,6 +735,33 @@ def setup_daemon_config(
                 f"GUBER_TRACE_SAMPLE must be a float in [0, 1], got '{v}'"
             )
         b.trace_sample = rate
+    b.express = _env_bool(merged, "GUBER_EXPRESS", b.express)
+    b.express_queue_depth = _env_int(
+        merged, "GUBER_EXPRESS_QUEUE_DEPTH", b.express_queue_depth
+    )
+    # Loud, not clamped: 0 would make the bypass unreachable while the
+    # knob reads enabled (GUBER_EXPRESS=0 is the off switch), and a
+    # threshold past the ingress-queue cap is a misconfiguration, not
+    # a latency plan.
+    if not 1 <= b.express_queue_depth <= 1_000_000:
+        raise ValueError(
+            f"GUBER_EXPRESS_QUEUE_DEPTH must be in [1, 1000000], "
+            f"got '{b.express_queue_depth}'"
+        )
+    b.express_max_lanes = _env_int(
+        merged, "GUBER_EXPRESS_MAX_LANES", b.express_max_lanes
+    )
+    if not 1 <= b.express_max_lanes <= 64:
+        # The bypass exists for the small interactive shapes the warm
+        # fused size-1/2/4 programs serve; >64 lanes would bypass into
+        # a fresh pad bucket and compile mid-traffic.
+        raise ValueError(
+            f"GUBER_EXPRESS_MAX_LANES must be in [1, 64], "
+            f"got '{b.express_max_lanes}'"
+        )
+    b.express_scalar = _env_bool(
+        merged, "GUBER_EXPRESS_SCALAR", b.express_scalar
+    )
     v = merged.get("GUBER_LATENCY_TARGET_MS", "")
     if v:
         try:

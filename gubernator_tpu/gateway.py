@@ -272,9 +272,14 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
                 )
             if qpath == "/debug/latency":
                 # Live per-phase percentile snapshots from the always-on
-                # attribution reservoirs (saturation.py).
+                # attribution reservoirs (saturation.py).  `express` is
+                # the express-vs-batched split: per-path lane counts +
+                # hit rate, with the bypass's own submit wall under
+                # phases["express.submit"] beside the windowed path's
+                # batch.window/queue.wait.
                 return 200, "application/json", _json_bytes({
                     "phases": saturation.phase_snapshot(),
+                    "express": saturation.express_snapshot(),
                     "slo": service.slo.snapshot(),
                 })
             if qpath == "/debug/hotkeys":
@@ -868,10 +873,17 @@ class NativeIngressPump:
     already-columnar common case."""
 
     # Behavior bits that demand the Python router (GLOBAL replica
-    # path, MULTI_REGION hit queueing, Gregorian resolution,
-    # NO_BATCHING direct dispatch): any lane carrying one makes the
-    # whole frame fall back.
+    # path, MULTI_REGION hit queueing, Gregorian resolution — and
+    # NO_BATCHING direct dispatch when the express lane is off): any
+    # lane carrying one makes the whole frame fall back.  This mask is
+    # the PR 13 set; with GUBER_EXPRESS on, NO_BATCHING moves out of
+    # the fallback mask and into the native EXPRESS queue instead
+    # (frames jump the ring, never the Python path — the bit means
+    # "skip coalescing waits", which the native loop satisfies
+    # directly).
     FALLBACK_BEHAVIOR = 1 | 2 | 4 | 16
+    EXPRESS_FALLBACK_BEHAVIOR = 2 | 4 | 16
+    EXPRESS_MASK = 1  # Behavior.NO_BATCHING
 
     #: Lane ceiling of one coalesced take = the device dispatch
     #: ceiling (ColumnarBatcher.MAX_LANES — an oversized dispatch
@@ -910,6 +922,8 @@ class NativeIngressPump:
         self._eligible = False
         self._enable_at = 0.0
         self._shed_seen = 0
+        self._express_seen = 0
+        self._lanes_seen = 0
         # The set_peers hook: the service pushes ring snapshots here.
         service.native_ingress = self
 
@@ -986,12 +1000,17 @@ class NativeIngressPump:
         # _ring_lock held.
         vh, vself, all_self, variant = self._ring
         b = self.service.conf.behaviors
+        express = bool(getattr(b, "express", False))
         self.batcher.set_ring(
             vh, vself, all_self=all_self, enabled=enabled,
             cap_lanes=getattr(b, "ingress_queue_lanes", 0),
             max_frame_lanes=INGRESS_COLUMNS_MAX_LANES,
-            behavior_mask=self.FALLBACK_BEHAVIOR,
+            behavior_mask=(
+                self.EXPRESS_FALLBACK_BEHAVIOR if express
+                else self.FALLBACK_BEHAVIOR
+            ),
             hash_variant=variant,
+            express_mask=self.EXPRESS_MASK if express else 0,
         )
 
     # -- pump loop ------------------------------------------------------
@@ -1026,6 +1045,21 @@ class NativeIngressPump:
             # exists for) and samples the ring depth for /debug/status.
             st = batcher.stats()
             saturation.observe_queue_depth(st["pendingLanes"])
+            # Express-lane attribution: NO_BATCHING frames served by
+            # the native express queue (counted in C++ at submit), and
+            # the ring's BULK lanes into the batched denominator — the
+            # hit-rate gauge must reflect the native edge's coalesced
+            # traffic, not just the batchers' windows.
+            xl = st.get("expressLanes", 0)
+            tl = st.get("lanes", 0)
+            d_express = xl - self._express_seen
+            d_bulk = (tl - self._lanes_seen) - d_express
+            if d_express > 0:
+                saturation.note_express("native", d_express)
+            if d_bulk > 0:
+                saturation.note_express("windowed", d_bulk)
+            self._express_seen = xl
+            self._lanes_seen = tl
             shed = st["shedLanes"]
             if shed > self._shed_seen:
                 tracing.record_event(

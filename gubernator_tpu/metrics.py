@@ -154,6 +154,31 @@ class Metrics:
             ["stat"],
             registry=self.registry,
         )
+        # -- millisecond express lane (architecture.md "Express lane") -
+        self.express_lanes = Counter(
+            "gubernator_express_lanes_total",
+            "Ingress lanes by dispatch path (bypass = batcher "
+            "shallow-queue bypass, scalar = host-side small-batch "
+            "slot, native = NO_BATCHING frames on the native express "
+            "queue, windowed = lanes that rode a coalesced batch — a "
+            "window flush or the native ring's bulk path).",
+            ["path"],
+            registry=self.registry,
+        )
+        self.express_hit_ratio = Gauge(
+            "gubernator_express_hit_ratio",
+            "Fraction of batcher/native ingress lanes that took an "
+            "express path (bypass + native over those plus windowed), "
+            "cumulative since start.",
+            registry=self.registry,
+        )
+        self.readback_retries = Counter(
+            "gubernator_readback_retries_total",
+            "Device->host readbacks retried once for the known jax CPU "
+            "IndexError flake (_copy_single_device_array_to_host_async "
+            "under load); a retry that also fails propagates.",
+            registry=self.registry,
+        )
         self.ingress_acceptor_requests = Gauge(
             "gubernator_ingress_acceptor_requests",
             "Requests parsed per native acceptor loop (GUBER_ACCEPTORS "
@@ -728,6 +753,23 @@ class Metrics:
             lab(stat="ratio").set(lanes / padded)
         busy, elapsed = saturation.dispatcher_busy.take()
         self.dispatcher_busy_ratio.set(min(busy / elapsed, 1.0))
+        # Express lane: per-path lane deltas since the last scrape plus
+        # the cumulative hit rate (saturation.ExpressStats).
+        for path, lanes in saturation.express.take().items():
+            if lanes:
+                self.express_lanes.labels(path=path).inc(lanes)
+        self.express_hit_ratio.set(
+            saturation.express.snapshot()["hitRate"]
+        )
+        # Readback-flake quarantine counter (models/shard.py): delta
+        # against the cumulative module total, the native-shed pattern.
+        from .models import shard as _shard
+
+        retries = _shard.readback_retries_total()
+        prev = getattr(self, "_readback_retries_seen", 0)
+        if retries > prev:
+            self.readback_retries.inc(retries - prev)
+            self._readback_retries_seen = retries
         slo = self.slo
         if slo is not None:
             self.slo_latency_target_ms.set(slo.target_ms if slo.enabled else 0)

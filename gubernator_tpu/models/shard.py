@@ -30,6 +30,7 @@ from .. import saturation
 from .. import telemetry
 from .. import tracing
 from ..ops import buckets
+from ..ops import scalar as scalar_ops
 from ..types import (
     Algorithm,
     Behavior,
@@ -413,6 +414,37 @@ def make_columns(algorithm, behavior, hits, limit, duration, n,
     return cols
 
 
+# ---------------------------------------------------------------------
+# Device->host readback with the known-flake quarantine: under heavy
+# suite load jax 0.4.x CPU occasionally raises a spurious IndexError
+# ("list index out of range") from _copy_single_device_array_to_host_async
+# inside np.asarray of a device array.  The array is intact — an
+# immediate retry succeeds — so the dispatch readback sites retry ONCE
+# and count, instead of failing a whole batch (and a tier-1 run) on a
+# runtime race that is not ours.  Anything else (or a second failure)
+# propagates unchanged.
+_readback_lock = threading.Lock()
+_readback_retries_total = 0
+
+
+def readback_retries_total() -> int:
+    """Cumulative retry count (scraped into
+    gubernator_readback_retries_total)."""
+    with _readback_lock:
+        return _readback_retries_total
+
+
+def host_readback(arr) -> np.ndarray:
+    """np.asarray(device_array) with the single-retry quarantine."""
+    global _readback_retries_total
+    try:
+        return np.asarray(arr)
+    except IndexError:
+        with _readback_lock:
+            _readback_retries_total += 1
+        return np.asarray(arr)
+
+
 def _wire_donate_ok(device) -> bool:
     """Whether a freshly uploaded wire buffer is donatable on this
     device.  CPU device_put zero-copies host numpy (the device array
@@ -453,7 +485,7 @@ class _FusedFetch:
     def get(self, i: int):
         with self._lock:
             if self._np is None:
-                self._np = np.asarray(self._arr)
+                self._np = host_readback(self._arr)
                 self._arr = None  # drop the device reference
             return self._np[i]
 
@@ -465,12 +497,18 @@ class _Staged:
     while same-`fuse_key` neighbors waiting at the launch gate can ride
     one fused program instead (ColumnarPipeline._launch_in_order)."""
 
-    solo: "Callable"          # state -> (state, packed)
+    solo: "Optional[Callable]"  # state -> (state, packed); None = scalar
     fuse_key: object = None   # None = not fuse-eligible (fallback wire)
     wire_dev: object = None   # uploaded packed wire (dict-wire path)
     n_rounds: int = 1
     now_ms: int = 0
     wide: bool = False
+    # Express scalar slot (ops/scalar.py): a host-side closure that
+    # evaluates the single lane and writes its bucket row in place,
+    # returning the packed output array the ordinary commit closure
+    # decodes.  Runs at this ticket's launch turn under the store lock
+    # — no device program, no fusion, ticket-order commit unchanged.
+    scalar: "Optional[Callable]" = None
 
 
 @dataclass
@@ -698,6 +736,25 @@ class ColumnarPipeline:
         # pinned by COUNTING this (tests/test_observability.py), the
         # replica_commit_dispatches playbook.
         self.device_dispatches = 0
+        # Express scalar applies (ops/scalar.py): batches answered by
+        # the host-side singleton path — counted separately so the
+        # zero-extra-device-programs pins keep holding (a scalar apply
+        # is NOT a device dispatch) and /debug/status can report the
+        # express hit rate.
+        self.scalar_applies = 0
+        # Master switch for the scalar singleton path, default OFF at
+        # the store level: the SERVICE enables it from GUBER_EXPRESS
+        # (config.py), so bare-store users and every pre-express test
+        # see exactly the old dispatch behavior unless they opt in.
+        self.scalar_fast_path = False
+        # Widest batch the scalar slot serves (the service syncs this
+        # with GUBER_EXPRESS_MAX_LANES).  Lanes apply SEQUENTIALLY in
+        # submission order — the semantics the kernel's round/group
+        # machinery exists to reproduce — so the slot stays
+        # oracle-equivalent at any width; the cap keeps the host loop
+        # to the small interactive shapes where it beats a program.
+        self.scalar_max_lanes = 4
+        self._scalar_ok: "Optional[bool]" = None  # lazy capability probe
 
     # -- observability (metrics.observe_dispatch scrapes these) --------
     def _observe_stage(self, stage: str, dt: float) -> None:
@@ -766,8 +823,16 @@ class ColumnarPipeline:
         # dispatch — the earlier-layer twin of the applied-hits count at
         # commit decode (applied <= dispatched is the device invariant).
         audit.note("dispatched_hits", int(cols.hits.sum()))
+        # Express scalar slot: a singleton on a capable CPU backend
+        # skips device dispatch — planned, ticketed and committed like
+        # any batch (the wide commit decode), but its "launch" is the
+        # host-side evaluation in ops/scalar.py.  Decided BEFORE the
+        # plan so the prepare can pin the wide decode path.
+        use_scalar = force_wire is None and self._scalar_eligible(cols)
         with self._plan_lock, profiling.scope("dispatch.prepare"):
-            prep = self._prepare_columns(keys, cols, now_ms, force_wire)
+            prep = self._prepare_columns(
+                keys, cols, now_ms, "wide" if use_scalar else force_wire
+            )
             handle = ColumnsHandle(self, prep.commit, cols.limit, cols.hits)
             handle._trace = bt
             handle.ticket = self._next_ticket
@@ -785,7 +850,10 @@ class ColumnarPipeline:
         try:
             t1 = time.perf_counter()
             with profiling.scope("dispatch.stage"):
-                staged = self._stage_columns(prep)
+                staged = (
+                    self._stage_scalar(prep) if use_scalar
+                    else self._stage_columns(prep)
+                )
             dt = time.perf_counter() - t1
             self._observe_stage("stage", dt)
             tracing.stage_span("stage", dt, bt)
@@ -904,6 +972,16 @@ class ColumnarPipeline:
         device topology."""
         raise NotImplementedError
 
+    # -- express scalar hooks (ops/scalar.py; stores override) ---------
+    def _scalar_eligible(self, cols) -> bool:
+        """Whether this batch may take the host-side scalar slot
+        instead of a device program.  Default: never (stores with a
+        scalar implementation override)."""
+        return False
+
+    def _stage_scalar(self, prep) -> "_Staged":
+        raise NotImplementedError
+
     def _program_label(self, group) -> str:
         """XLA-telemetry program identity for one launch group: store
         topology (mesh twin vs single shard), solo vs fused-K, and the
@@ -918,7 +996,29 @@ class ColumnarPipeline:
         """Stage 3 (ticket order, under `_lock`): just the
         state-threading jit call.  A multi-batch group rides ONE fused
         program; each handle's fetch reads its slice of the shared
-        stacked result, transferred once."""
+        stacked result, transferred once.
+
+        A scalar-staged batch (the express singleton slot) never fuses
+        (fuse_key None) and launches as a host-side evaluation instead:
+        no device program, no XLA — the bucket row mutates in place
+        under this same lock, at this same ticket turn, so interleaved
+        scalar and device batches commit in plan order exactly like two
+        device batches would."""
+        if len(group) == 1 and group[0][0].scalar is not None:
+            staged, h = group[0]
+            # Dispatch is ASYNC on every backend (CPU included): an
+            # older ticket's program may still be executing on the XLA
+            # thread pool even though its launch returned and released
+            # the lock.  The scalar slot mutates the state buffers
+            # directly, so it must wait for the arrays to be DEFINED —
+            # a no-op when the pipeline already quiesced (the express
+            # shallow-queue case), the correctness wait otherwise.
+            jax.block_until_ready(self.state)
+            packed = staged.scalar()
+            self.scalar_applies += 1
+            saturation.note_express("scalar", len(h._limit))
+            h._launch_ok(lambda: packed)
+            return
         self._pre_launch()
         # One program per group (fused or solo) — counted, not timed:
         # the zero-extra-dispatch telemetry contract asserts on this.
@@ -931,7 +1031,7 @@ class ColumnarPipeline:
             if len(group) == 1:
                 staged, h = group[0]
                 self.state, packed = staged.solo(self.state)
-                h._launch_ok(partial(np.asarray, packed))
+                h._launch_ok(partial(host_readback, packed))
                 _prefetch_async(packed)
                 return
             fn = self._fused_launch_fn(len(group), group[0][0].wide)
@@ -1299,6 +1399,79 @@ class ShardStore(ColumnarPipeline):
         return buckets.fused_packed_jit(
             k, wide, donate_wires=_wire_donate_ok(self.device)
         )
+
+    # -- express scalar slot (ops/scalar.py) ---------------------------
+    def _scalar_eligible(self, cols) -> bool:
+        """Small batches on a CPU backend take the host scalar path
+        when the service enabled it (scalar_fast_path) and the one-time
+        writable-buffer capability probe passed.  Lanes apply
+        sequentially in submission order — exactly the semantics the
+        kernel's round/duplicate-group machinery reproduces — so width
+        is a cost cap, not a correctness bound."""
+        if not self.scalar_fast_path:
+            return False
+        if not 1 <= len(cols.hits) <= self.scalar_max_lanes:
+            return False
+        if not (self._native and self.store is None):
+            return False
+        if self._scalar_ok is None:
+            with self._lock:
+                # In-flight async programs must finish before the probe
+                # writes a spare lane of the live buffer.
+                jax.block_until_ready(self.state)
+                self._scalar_ok = scalar_ops.device_is_cpu(
+                    self.device
+                ) and scalar_ops.probe(self.state.hot, sharded=False)
+        return self._scalar_ok
+
+    def _stage_scalar(self, prep: "_ShardPrep") -> "_Staged":
+        """Express stage: capture the plan's slot rows and return the
+        host-evaluation closure.  The closure runs at the launch turn
+        under `_lock` (ColumnarPipeline._launch_group) and returns a
+        packed [4, n] wide output the ordinary commit decodes."""
+        cols = prep.cols
+        n = prep.n
+        slots = prep.slot_col[:n].copy()
+        exists = prep.ex_col[:n].copy()
+        occ = prep.occ_col[:n].copy()
+        now_ms = prep.now_ms
+
+        def run():
+            hot = scalar_ops.single_view(self.state.hot)
+            cold = scalar_ops.single_view(self.state.cold)
+            if hot is None or cold is None:
+                raise RuntimeError("scalar fast path: state view unavailable")
+            packed = np.zeros((4, n), dtype=np.int64)
+            for i in range(n):
+                slot = int(slots[i])
+                # Exists per lane: the planner's claim, EXCEPT that a
+                # later occurrence of an analytic duplicate group
+                # (occ > 0) shares the FIRST occurrence's pre-group
+                # claim — sequentially, the prior occurrence's write
+                # made the row live.  Round-1+ same-key lanes already
+                # carry exists=True from the planner, and a mid-batch
+                # slot TAKEOVER (different key, occ == 0,
+                # exists=False) must keep creating.
+                ex = bool(exists[i]) or int(occ[i]) > 0
+                st, rem, reset, n_exp, removed = scalar_ops.apply_one(
+                    hot[slot], cold[slot],
+                    exists=ex,
+                    algorithm=int(cols.algo[i]),
+                    behavior=int(cols.behavior[i]),
+                    hits=int(cols.hits[i]),
+                    limit=int(cols.limit[i]),
+                    duration=int(cols.duration[i]),
+                    greg_expire=int(cols.greg_expire[i]),
+                    greg_duration=int(cols.greg_duration[i]),
+                    now_ms=now_ms,
+                )
+                packed[0, i] = st | (int(removed) << 1)
+                packed[1, i] = rem
+                packed[2, i] = reset
+                packed[3, i] = n_exp
+            return packed
+
+        return _Staged(solo=None, scalar=run)
 
     @property
     def supports_columns(self) -> bool:

@@ -248,6 +248,7 @@ def _build() -> Optional[ctypes.CDLL]:
         c.c_int32, c.c_int32,                           # all_self, enabled
         c.c_int64, c.c_int64,                # cap_lanes, max_frame_lanes
         c.c_int32, c.c_int32,                # behavior_mask, hash_variant
+        c.c_int32,                           # express_mask
     ]
     lib.gt_ingress_submit.restype = c.c_int
     lib.gt_ingress_submit.argtypes = [c.c_void_p, c.c_void_p, c.c_uint64]
@@ -1136,7 +1137,8 @@ class IngressBatcher:
     'Native ingress service loop' for the full contract."""
 
     STAT_KEYS = ("frames", "lanes", "batches", "shedFrames", "shedLanes",
-                 "fallbacks", "pendingFrames", "pendingLanes")
+                 "fallbacks", "pendingFrames", "pendingLanes",
+                 "expressFrames", "expressLanes")
 
     def __init__(self):
         lib = _get_lib()
@@ -1148,14 +1150,15 @@ class IngressBatcher:
 
     def set_ring(self, vnode_hashes, vnode_self, *, all_self: bool,
                  enabled: bool, cap_lanes: int, max_frame_lanes: int,
-                 behavior_mask: int, hash_variant: int = 0) -> None:
+                 behavior_mask: int, hash_variant: int = 0,
+                 express_mask: int = 0) -> None:
         vh = np.ascontiguousarray(vnode_hashes, dtype=np.uint64)
         vs = np.ascontiguousarray(vnode_self, dtype=np.uint8)
         self._lib.gt_ingress_set_ring(
             self._ptr, vh.ctypes.data, vs.ctypes.data, len(vh),
             1 if all_self else 0, 1 if enabled else 0,
             int(cap_lanes), int(max_frame_lanes), int(behavior_mask),
-            int(hash_variant),
+            int(hash_variant), int(express_mask),
         )
 
     def disable(self) -> None:
@@ -1221,7 +1224,7 @@ class IngressBatcher:
         self._lib.gt_ingress_stop(self._ptr)
 
     def stats(self) -> dict:
-        out = np.zeros(8, dtype=np.int64)
+        out = np.zeros(10, dtype=np.int64)
         if self._ptr:  # freed batchers read as all-zero, never crash
             self._lib.gt_ingress_stats(self._ptr, out.ctypes.data)
         return dict(zip(self.STAT_KEYS, (int(v) for v in out)))

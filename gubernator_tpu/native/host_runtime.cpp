@@ -2536,10 +2536,14 @@ void gt_http_free(void* sv) {
 //     tell the native loop from the PR 8 path.
 //
 // Lanes that need Python semantics (GLOBAL replication, MULTI_REGION
-// queueing, Gregorian durations, NO_BATCHING, per-lane validation
-// errors, sampled traces, remote owners) make the WHOLE frame fall
-// back: correctness never depends on the fast lane, it only removes
-// interpreter time from the already-columnar common case.
+// queueing, Gregorian durations, per-lane validation errors, sampled
+// traces, remote owners) make the WHOLE frame fall back: correctness
+// never depends on the fast lane, it only removes interpreter time
+// from the already-columnar common case.  NO_BATCHING lanes are the
+// express-lane exception (PR 14): with GUBER_EXPRESS on they stay
+// native and jump the queue (express_mask / xq below) — the bit means
+// "skip coalescing waits", which is satisfiable entirely in this loop
+// — and only fall back (the PR 13 behavior) when the lane is off.
 // ======================================================================
 
 namespace {
@@ -2588,6 +2592,7 @@ struct IngressFrame {
   uint64_t token;
   int acceptor;
   bool keep_alive;
+  bool express = false;  // NO_BATCHING lane(s): rides the express queue
   std::string body;   // owns the frame bytes; columns view into it
   GtFrameInfo info;
   int64_t n;
@@ -2616,6 +2621,12 @@ struct IngressBatcher {
   std::mutex mu;
   std::condition_variable cv;
   std::deque<IngressFrame*> q;
+  // Express queue (the millisecond express lane): frames carrying a
+  // NO_BATCHING lane jump here and every take() serves it FIRST, so
+  // the lowest-latency request class never waits behind coalesced
+  // bulk frames.  Same shed bound, same batch coalescing — only the
+  // service order differs.
+  std::deque<IngressFrame*> xq;
   int64_t pending_lanes = 0;
   bool stopping = false;
   // config (gt_ingress_set_ring)
@@ -2624,10 +2635,12 @@ struct IngressBatcher {
   int64_t cap_lanes = 0;       // shed bound; 0 = unbounded
   int64_t max_frame_lanes = 16384;
   int32_t behavior_mask = 0;   // any set bit -> Python fallback
+  int32_t express_mask = 0;    // any set bit -> express queue (0 = off)
   // counters
   int64_t frames = 0, lanes = 0, batches = 0;
   int64_t shed_frames = 0, shed_lanes = 0;
   int64_t fallbacks = 0;
+  int64_t express_frames = 0, express_lanes = 0;
 };
 
 void ingress_free_frame(IngressFrame* f) { delete f; }
@@ -2669,7 +2682,8 @@ void* gt_ingress_new(void) { return new IngressBatcher; }
 void gt_ingress_set_ring(void* bv, const uint64_t* vh, const uint8_t* vself,
                          int64_t nv, int32_t all_self, int32_t enabled,
                          int64_t cap_lanes, int64_t max_frame_lanes,
-                         int32_t behavior_mask, int32_t hash_variant) {
+                         int32_t behavior_mask, int32_t hash_variant,
+                         int32_t express_mask) {
   auto* b = (IngressBatcher*)bv;
   auto snap = std::make_shared<RingSnap>();
   snap->vh.assign(vh, vh + nv);
@@ -2682,6 +2696,7 @@ void gt_ingress_set_ring(void* bv, const uint64_t* vh, const uint8_t* vself,
   b->cap_lanes = cap_lanes;
   b->max_frame_lanes = max_frame_lanes;
   b->behavior_mask = behavior_mask;
+  b->express_mask = express_mask;
 }
 
 // The fast-lane entry (see the banner for the contract).  Returns 0 =
@@ -2703,12 +2718,14 @@ int gt_ingress_submit(void* sv, void* bv, uint64_t token) {
   std::shared_ptr<const RingSnap> ring;
   int64_t max_frame_lanes;
   int32_t behavior_mask;
+  int32_t express_mask;
   {
     std::lock_guard<std::mutex> lk(b->mu);
     enabled = b->enabled && !b->stopping;
     ring = b->ring;
     max_frame_lanes = b->max_frame_lanes;
     behavior_mask = b->behavior_mask;
+    express_mask = b->express_mask;
   }
   auto bump_fallback = [&](int code) {
     std::lock_guard<std::mutex> lk(b->mu);
@@ -2725,12 +2742,16 @@ int gt_ingress_submit(void* sv, void* bv, uint64_t token) {
   int64_t n = info.n;
   if (n == 0 || n > max_frame_lanes) return bump_fallback(3);
   const char* body = p->body.data();
-  // Slow behavior bits (GLOBAL / MULTI_REGION / Gregorian /
-  // NO_BATCHING) need the Python router's semantics.
+  // Slow behavior bits (GLOBAL / MULTI_REGION / Gregorian — and
+  // NO_BATCHING when the express lane is off) need the Python
+  // router's semantics.  With the express lane on, NO_BATCHING lanes
+  // instead flag the frame for the express queue below.
+  bool xpress = false;
   for (int64_t i = 0; i < n; ++i) {
     int32_t bh;
     memcpy(&bh, body + info.beh_pos + 4 * i, 4);
     if (bh & behavior_mask) return bump_fallback(4);
+    if (bh & express_mask) xpress = true;
   }
   // Build the packed hash keys + validation codes (the gt_frame_fill
   // pass, inlined so an error lane can bail early), then the UTF-8
@@ -2814,7 +2835,14 @@ int gt_ingress_submit(void* sv, void* bv, uint64_t token) {
         // The columns keep viewing the moved body; ownership transfers
         // to the queue inside the lock so no stop() can slip between.
         frame->body = std::move(p->body);
-        b->q.push_back(frame.release());
+        frame->express = xpress;
+        if (xpress) {
+          ++b->express_frames;
+          b->express_lanes += n;
+          b->xq.push_back(frame.release());
+        } else {
+          b->q.push_back(frame.release());
+        }
       }
     }
   }
@@ -2863,15 +2891,27 @@ int gt_ingress_take(void* bv, int64_t max_lanes, int64_t timeout_ms,
   auto tb = std::make_unique<TakenBatch>();
   {
     std::unique_lock<std::mutex> lk(b->mu);
-    if (!b->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
-                        [&] { return !b->q.empty() || b->stopping; })) {
+    if (!b->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+          return !b->q.empty() || !b->xq.empty() || b->stopping;
+        })) {
       return 0;
     }
-    if (b->q.empty()) return -1;  // stopping
-    while (!b->q.empty()) {
-      IngressFrame* f = b->q.front();
+    if (b->q.empty() && b->xq.empty()) return -1;  // stopping
+    // Express frames first AND pure (the lane's whole point: a
+    // NO_BATCHING frame never waits behind coalesced bulk backlog —
+    // an express take must not keep filling from the bulk queue, or
+    // the express response would wait out a full up-to-max_lanes
+    // dispatch and outgrow the host scalar slot).  Express frames
+    // coalesce among THEMSELVES (window-free coalescing); bulk frames
+    // ride the next take — with multiple pump threads, usually a
+    // concurrent one.  NO_BATCHING callers opting out of batching pay
+    // their own dispatch, the reference's semantics.
+    bool express_take = !b->xq.empty();
+    std::deque<IngressFrame*>& src = express_take ? b->xq : b->q;
+    while (!src.empty()) {
+      IngressFrame* f = src.front();
       if (!tb->frames.empty() && tb->n + f->n > max_lanes) break;
-      b->q.pop_front();
+      src.pop_front();
       b->pending_lanes -= f->n;
       tb->n += f->n;
       tb->frames.push_back(f);
@@ -3014,6 +3054,8 @@ void gt_ingress_stop(void* bv) {
     b->stopping = true;
     b->enabled = false;
     q.swap(b->q);
+    for (IngressFrame* f : b->xq) q.push_back(f);
+    b->xq.clear();
     b->pending_lanes = 0;
   }
   b->cv.notify_all();
@@ -3026,10 +3068,10 @@ void gt_ingress_stop(void* bv) {
   }
 }
 
-// out: i64[8] = {frames, lanes, batches, shed_frames, shed_lanes,
-// fallbacks, pending_frames, pending_lanes}.  Cumulative; the Python
-// scrape keeps last-seen values and feeds deltas into the prometheus
-// counters.
+// out: i64[10] = {frames, lanes, batches, shed_frames, shed_lanes,
+// fallbacks, pending_frames, pending_lanes, express_frames,
+// express_lanes}.  Cumulative; the Python scrape keeps last-seen
+// values and feeds deltas into the prometheus counters.
 void gt_ingress_stats(void* bv, int64_t* out) {
   auto* b = (IngressBatcher*)bv;
   std::lock_guard<std::mutex> lk(b->mu);
@@ -3039,13 +3081,16 @@ void gt_ingress_stats(void* bv, int64_t* out) {
   out[3] = b->shed_frames;
   out[4] = b->shed_lanes;
   out[5] = b->fallbacks;
-  out[6] = (int64_t)b->q.size();
+  out[6] = (int64_t)(b->q.size() + b->xq.size());
   out[7] = b->pending_lanes;
+  out[8] = b->express_frames;
+  out[9] = b->express_lanes;
 }
 
 void gt_ingress_free(void* bv) {
   auto* b = (IngressBatcher*)bv;
   for (IngressFrame* f : b->q) ingress_free_frame(f);
+  for (IngressFrame* f : b->xq) ingress_free_frame(f);
   delete b;
 }
 

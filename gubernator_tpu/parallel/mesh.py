@@ -43,6 +43,7 @@ from ..models.shard import (
     _Staged,
     _wire_donate_ok,
     build_round_arrays,
+    host_readback,
     item_to_rows,
     make_columns,
     make_store_resolver,
@@ -51,6 +52,7 @@ from ..models.shard import (
     plan_grouped_python,
     prepare_requests,
 )
+from ..ops import scalar as scalar_ops
 from ..models.slot_table import SlotTable
 from ..ops import buckets, global_ops
 from ..types import (
@@ -864,6 +866,81 @@ class MeshBucketStore(ColumnarPipeline):
     def _fused_launch_fn(self, k: int, wide: bool):
         return _mesh_fused_packed_jit(k, wide, donate_wires=self._wire_donate)
 
+    # -- express scalar slot (ops/scalar.py) ---------------------------
+    def _scalar_eligible(self, cols) -> bool:
+        """Mesh twin of ShardStore._scalar_eligible: each lane of a
+        small batch lives in exactly one shard, so the host evaluates
+        them sequentially against the shards' rows through writable
+        shard views — no mesh-wide program.  Two-tier stores are
+        excluded (their plans queue tier moves that only the device
+        launch drains)."""
+        if not self.scalar_fast_path:
+            return False
+        if not 1 <= len(cols.hits) <= self.scalar_max_lanes:
+            return False
+        if not (self._native and self.store is None) or self.back is not None:
+            return False
+        if self._scalar_ok is None:
+            with self._lock:
+                # In-flight async programs must finish before the probe
+                # writes a spare lane of the live buffer.
+                jax.block_until_ready(self.state)
+                self._scalar_ok = scalar_ops.device_is_cpu(
+                    self.mesh.devices.flat[0]
+                ) and scalar_ops.probe(self.state.hot, sharded=True)
+        return self._scalar_ok
+
+    def _stage_scalar(self, prep: "_MeshPrep") -> "_Staged":
+        """Express stage: locate each lane's (shard, row) from the mesh
+        plan and return the host-evaluation closure; its packed
+        [S, 4, P] wide output feeds the unchanged mp.finish_wide commit
+        (decode + slot-table commit + original-order scatter).  Lanes
+        apply sequentially in submission order — the semantics the
+        kernel's round/duplicate-group machinery reproduces (see
+        ShardStore._stage_scalar for the exists rule)."""
+        cols, mp, padded = prep.cols, prep.mp, prep.padded
+        n = prep.n
+        pos = prep.pos[:n].copy()
+        now_ms = prep.now_ms
+        S = self.n_shards
+
+        def run():
+            views: dict = {}
+            packed = np.zeros((S, 4, padded), dtype=np.int64)
+            for i in range(n):
+                p = int(pos[i])
+                s, j = p // padded, p % padded
+                if s not in views:
+                    hot = scalar_ops.shard_view(self.state.hot, s)
+                    cold = scalar_ops.shard_view(self.state.cold, s)
+                    if hot is None or cold is None:
+                        raise RuntimeError(
+                            "scalar fast path: state view unavailable"
+                        )
+                    views[s] = (hot, cold)
+                hot, cold = views[s]
+                slot = int(mp.slot[s, j])
+                ex = bool(mp.exists[s, j]) or int(mp.occ[s, j]) > 0
+                st, rem, reset, n_exp, removed = scalar_ops.apply_one(
+                    hot[slot], cold[slot],
+                    exists=ex,
+                    algorithm=int(cols.algo[i]),
+                    behavior=int(cols.behavior[i]),
+                    hits=int(cols.hits[i]),
+                    limit=int(cols.limit[i]),
+                    duration=int(cols.duration[i]),
+                    greg_expire=int(cols.greg_expire[i]),
+                    greg_duration=int(cols.greg_duration[i]),
+                    now_ms=now_ms,
+                )
+                packed[s, 0, j] = st | (int(removed) << 1)
+                packed[s, 1, j] = rem
+                packed[s, 2, j] = reset
+                packed[s, 3, j] = n_exp
+            return packed
+
+        return _Staged(solo=None, scalar=run)
+
     # ------------------------------------------------------------------
     def _apply_fused(self, by_shard, now_ms: int, responses) -> None:
         """One dispatch for the whole batch: every shard's rounds run
@@ -925,7 +1002,7 @@ class MeshBucketStore(ColumnarPipeline):
         back into the slot tables.  `write` masks which lanes commit
         (None = every non-cached lane, the single-round case).  Returns
         the cached mask for the Store-SPI caller."""
-        packed_np = np.asarray(packed)  # the one blocking transfer
+        packed_np = host_readback(packed)  # the one blocking transfer
         row0 = packed_np[:, 0]
         out_status = (row0 & 1).astype(np.int32)
         out_removed = ((row0 >> 1) & 1).astype(bool)
@@ -1502,7 +1579,7 @@ class MeshBucketStore(ColumnarPipeline):
             self.state, self.gcols, packed = self._sync_fn(
                 self.state, self.gcols, cfg, dirty_dev, now_ms
             )
-            packed_np = np.asarray(packed)  # [S, 8, G] — the one blocking transfer
+            packed_np = host_readback(packed)  # [S, 8, G] — the one blocking transfer
         out_rm = (packed_np[:, 0] & 1).astype(bool)
         out_exp = packed_np[:, 1]
         # psum results are replicated across shards; read shard 0's copy.

@@ -80,6 +80,9 @@ def percentile(sorted_vals: Sequence[float], q: float) -> float:
 PHASES = (
     "ingress.parse",     # wire bytes -> IngressColumns (gateway)
     "batch.window",      # submit -> coalescing-window flush (batchers)
+    "express.submit",    # express bypass: submit -> dispatch staged
+                         # (replaces batch.window + queue.wait for
+                         # express lanes — the express-vs-batched split)
     "queue.wait",        # flush -> dispatch submit (backstop + concat)
     "dispatch.prepare",  # slot-table planning (pipeline stage 1)
     "dispatch.stage",    # wire pack + H2D upload start (stage 2)
@@ -259,9 +262,73 @@ class _DepthRing:
         }
 
 
+class ExpressStats:
+    """Express-vs-batched lane accounting (the PR 14 millisecond
+    express lane).  Each dispatch notes which path its lanes took:
+
+      * ``bypass``   — batcher shallow-queue bypass (direct dispatch,
+                       no coalescing window)
+      * ``scalar``   — the host-side singleton slot (ops/scalar.py;
+                       also counted as whichever submit path fed it)
+      * ``native``   — NO_BATCHING frames served by the native ingress
+                       express queue (gt_ingress_*)
+      * ``windowed`` — lanes that rode a coalesced batch: a Python
+                       window flush OR the native ring's bulk path
+                       (the pump feeds both into this denominator)
+
+    `take()` drains per-scrape deltas for the gubernator_express_*
+    counters; `snapshot()` serves cumulative counts + the hit rate at
+    /debug/latency and /debug/status."""
+
+    PATHS = ("bypass", "scalar", "native", "windowed")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._lanes = {p: 0 for p in self.PATHS}
+        self._dispatches = {p: 0 for p in self.PATHS}
+        self._delta_lanes = {p: 0 for p in self.PATHS}
+
+    def note(self, path: str, lanes: int) -> None:
+        with self._lock:
+            self._lanes[path] = self._lanes.get(path, 0) + int(lanes)
+            self._dispatches[path] = self._dispatches.get(path, 0) + 1
+            self._delta_lanes[path] = (
+                self._delta_lanes.get(path, 0) + int(lanes)
+            )
+
+    def take(self) -> Dict[str, int]:
+        with self._lock:
+            out = dict(self._delta_lanes)
+            self._delta_lanes = {p: 0 for p in self.PATHS}
+        return out
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            express = (
+                self._lanes.get("bypass", 0) + self._lanes.get("native", 0)
+            )
+            windowed = self._lanes.get("windowed", 0)
+            total = express + windowed
+            return {
+                "lanes": dict(self._lanes),
+                "dispatches": dict(self._dispatches),
+                "hitRate": round(express / total, 4) if total else 0.0,
+            }
+
+
 lane_util = LaneUtil()
 dispatcher_busy = BusyFraction()
 _queue_depths = _DepthRing()
+express = ExpressStats()
+
+
+def note_express(path: str, lanes: int) -> None:
+    """Record one express/batched dispatch (see ExpressStats)."""
+    express.note(path, lanes)
+
+
+def express_snapshot() -> dict:
+    return express.snapshot()
 
 
 def observe_queue_depth(depth: int) -> None:
@@ -513,8 +580,9 @@ class HotKeySketch:
 # ---------------------------------------------------------------------
 def reset() -> None:
     """Test hook: clear every module-global reservoir/accumulator."""
-    global _phases, lane_util, dispatcher_busy, _queue_depths
+    global _phases, lane_util, dispatcher_busy, _queue_depths, express
     _phases = {p: _PhaseStats() for p in PHASES}
     lane_util = LaneUtil()
     dispatcher_busy = BusyFraction()
     _queue_depths = _DepthRing()
+    express = ExpressStats()

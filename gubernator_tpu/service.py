@@ -94,10 +94,16 @@ class IngressShedError(ApiError):
 class _IngressGate:
     """Shared lane accounting for the bounded ingress queue
     (GUBER_INGRESS_QUEUE_LANES): admit at submit, release at flush.
-    cap <= 0 disables the bound."""
+    cap <= 0 disables the bound.  `track` keeps lane COUNTING on even
+    with the bound off — the express bypass reads `queued` as its
+    shallow-queue signal, which must work whether or not the shed
+    bound is armed; with both the cap and the express lane off
+    (`track=False`), admit/release are the pre-express no-ops."""
 
-    def __init__(self, cap: int, metrics: Optional[Metrics]):
+    def __init__(self, cap: int, metrics: Optional[Metrics],
+                 track: bool = False):
         self.cap = cap
+        self.track = track
         self.metrics = metrics
         self._queued = 0
         self._mu = threading.Lock()
@@ -108,10 +114,10 @@ class _IngressGate:
 
     def admit(self, lanes: int) -> None:
         """Reserve `lanes` or raise IngressShedError (counted)."""
-        if self.cap <= 0:
+        if self.cap <= 0 and not self.track:
             return
         with self._mu:
-            if self._queued + lanes > self.cap:
+            if self.cap > 0 and self._queued + lanes > self.cap:
                 queued = self._queued
                 shed = True
             else:
@@ -132,7 +138,7 @@ class _IngressGate:
             raise IngressShedError(queued, self.cap)
 
     def release(self, lanes: int) -> None:
-        if self.cap <= 0:
+        if self.cap <= 0 and not self.track:
             return
         with self._mu:
             self._queued = max(self._queued - lanes, 0)
@@ -187,6 +193,64 @@ class ServiceConfig:
     fault_plan: object = None
 
 
+class _ExpressPolicy:
+    """The express-lane bypass rule, shared by both batchers
+    (architecture.md "Express lane"): a submission of n lanes skips the
+    coalescing window entirely when
+
+      * the lane is enabled (GUBER_EXPRESS),
+      * n <= GUBER_EXPRESS_MAX_LANES (the small interactive shapes the
+        warm fused size-1/2/4 programs serve),
+      * the batcher queue is SHALLOW — fewer than
+        GUBER_EXPRESS_QUEUE_DEPTH lanes admitted and unflushed (a deep
+        queue means the window is coalescing real backlog; bypassing it
+        would add dispatches without helping anyone's latency), and
+      * the dispatch pipeline is shallow (<= MAX_DEPTH unresolved
+        batches — commits are FIFO, so an express dispatch behind a
+        deep pipeline would wait out every older readback anyway).
+
+    The bypass changes WHEN a dispatch launches, never what it
+    computes: results are byte-identical to the windowed path.
+
+    SAMPLED requests keep the windowed path (the callers gate on their
+    trace context): the documented span taxonomy promises a
+    batch.window span covering the coalescing wait, and the Python
+    window owns span creation — the same rule that turns the native
+    fast lane off under sampling (NativeIngressPump.active)."""
+
+    #: Unresolved-pipeline ceiling for the bypass: past two in-flight
+    #: batches the FIFO commit wait dominates whatever the window
+    #: would have cost.
+    MAX_DEPTH = 2
+
+    __slots__ = ("enabled", "queue_depth", "max_lanes")
+
+    def __init__(self, behaviors: BehaviorConfig):
+        self.enabled = bool(getattr(behaviors, "express", False))
+        self.queue_depth = int(
+            getattr(behaviors, "express_queue_depth", 64)
+        )
+        self.max_lanes = int(getattr(behaviors, "express_max_lanes", 4))
+
+    def window_cap_s(self, behaviors: BehaviorConfig) -> "Optional[float]":
+        """The latency-mode ceiling on the coalescing window: half the
+        GUBER_LATENCY_TARGET_MS budget (the other half pays for
+        dispatch + readback).  None when the lane or the target is off
+        — occupancy mode keeps the window."""
+        target_ms = float(getattr(behaviors, "latency_target_ms", 0.0) or 0.0)
+        if not self.enabled or target_ms <= 0:
+            return None
+        return target_ms / 2000.0
+
+    def bypass_ok(self, n: int, gate: "_IngressGate", store) -> bool:
+        if not self.enabled or n > self.max_lanes:
+            return False
+        if gate.queued + n > self.queue_depth:
+            return False
+        depth = getattr(store, "pipeline_depth", None)
+        return depth is None or depth() <= self.MAX_DEPTH
+
+
 class LocalBatcher:
     """Ingress batching window for owner-local evaluation.
 
@@ -198,7 +262,8 @@ class LocalBatcher:
     (batch_wait/batch_limit, config.go:107-109), same defeat-the-
     thundering-herd purpose, applied at the ingress edge.  Requests
     flagged NO_BATCHING bypass the window (proto/gubernator.proto:74-78
-    semantics)."""
+    semantics), and under the express lane (GUBER_EXPRESS) shallow-queue
+    submissions bypass it too."""
 
     def __init__(self, store, behaviors: BehaviorConfig, clock: Clock,
                  metrics: Optional[Metrics] = None):
@@ -207,11 +272,14 @@ class LocalBatcher:
         # Bounded ingress (GUBER_INGRESS_QUEUE_LANES): a queue deeper
         # than the cap sheds new submissions with a 429-style error
         # instead of stretching every queued caller's latency.
+        self._express = _ExpressPolicy(behaviors)
         self._gate = _IngressGate(
-            getattr(behaviors, "ingress_queue_lanes", 0), metrics
+            getattr(behaviors, "ingress_queue_lanes", 0), metrics,
+            track=self._express.enabled,
         )
         self._window = BatchWindow(
-            self._flush, behaviors.batch_wait_s, behaviors.batch_limit
+            self._flush, behaviors.batch_wait_s, behaviors.batch_limit,
+            cap_s=self._express.window_cap_s(behaviors),
         )
 
     def submit(self, req: RateLimitRequest) -> "Future":
@@ -219,6 +287,10 @@ class LocalBatcher:
         if self._window.stopped:
             fut.set_exception(PeerError(ERR_BATCHER_CLOSED))
             return fut
+        if tracing.current() is None and self._express.bypass_ok(
+            1, self._gate, self.store
+        ):
+            return self._submit_express(req, fut)
         try:
             self._gate.admit(1)
         except IngressShedError as e:
@@ -232,8 +304,34 @@ class LocalBatcher:
         self._window.submit((req, fut))
         return fut
 
+    def _submit_express(self, req: RateLimitRequest, fut: "Future") -> "Future":
+        """Express bypass: evaluate NOW on the caller's thread — the
+        same store.apply a one-element window flush would run, minus
+        the window.  The caller blocks on fut.result() immediately
+        after submit, so the inline evaluation moves the wait, it does
+        not add one."""
+        try:
+            self._gate.admit(1)
+        except IngressShedError as e:
+            fut.set_exception(e)
+            return fut
+        t0 = time.monotonic()
+        try:
+            resp = self.store.apply([req], self.clock.now_ms())[0]
+            if not fut.done():
+                fut.set_result(resp)
+        except Exception as e:  # noqa: BLE001
+            if not fut.done():
+                fut.set_exception(e)
+        finally:
+            self._gate.release(1)
+        saturation.note_express("bypass", 1)
+        saturation.observe_phase("express.submit", time.monotonic() - t0)
+        return fut
+
     def _flush(self, batch) -> None:
         self._gate.release(len(batch))
+        saturation.note_express("windowed", len(batch))
         t_flush = time.monotonic()
         for _, fut in batch:
             st = getattr(fut, "_submit_t", None)
@@ -834,8 +932,10 @@ class ColumnarBatcher:
         self.store = store
         self.clock = clock
         # Bounded ingress, lane-weighted (GUBER_INGRESS_QUEUE_LANES).
+        self._express = _ExpressPolicy(behaviors)
         self._gate = _IngressGate(
-            getattr(behaviors, "ingress_queue_lanes", 0), metrics
+            getattr(behaviors, "ingress_queue_lanes", 0), metrics,
+            track=self._express.enabled,
         )
         self._own_inflight: "deque" = deque()
         # _flush can run concurrently in edge cases (worker stuck past
@@ -845,6 +945,7 @@ class ColumnarBatcher:
         self._window = BatchWindow(
             self._flush, behaviors.batch_wait_s, self.MAX_LANES,
             weigh=lambda item: len(item[0][0]),
+            cap_s=self._express.window_cap_s(behaviors),
         )
 
     def submit(self, keys, algo, behavior, hits, limit, duration,
@@ -854,6 +955,13 @@ class ColumnarBatcher:
             fut.set_exception(PeerError(ERR_BATCHER_CLOSED))
             return fut
         n = len(keys)
+        if not trace_links and self._express.bypass_ok(
+            n, self._gate, self.store
+        ):
+            return self._submit_express(
+                keys, algo, behavior, hits, limit, duration,
+                greg_expire, greg_duration, fut,
+            )
         try:
             self._gate.admit(n)
         except IngressShedError as e:
@@ -875,8 +983,49 @@ class ColumnarBatcher:
         )
         return fut
 
+    def _submit_express(self, keys, algo, behavior, hits, limit, duration,
+                        greg_expire, greg_duration,
+                        fut: "Future") -> "Future":
+        """Express bypass: dispatch NOW (no coalescing window) on the
+        caller's thread — the pipelined apply the flush would have run
+        for a one-submission window, launched on the warm solo/fused
+        small-batch programs (or the host scalar slot for a capable
+        singleton).  The future resolves immediately with the handle
+        slice; the caller's readback overlaps like any other waiter's.
+        Only unsampled submissions arrive here (submit gates on
+        trace_links), so no span bookkeeping is owed."""
+        n = len(keys)
+        try:
+            self._gate.admit(n)
+        except IngressShedError as e:
+            fut.set_exception(e)
+            return fut
+        t0 = time.monotonic()
+        try:
+            ge = np.zeros(n, np.int64) if greg_expire is None else greg_expire
+            gd = (
+                np.zeros(n, np.int64) if greg_duration is None
+                else greg_duration
+            )
+            handle = self.store.apply_columns_async(
+                keys, algo, behavior, hits, limit, duration,
+                self.clock.now_ms(), ge, gd,
+            )
+            if not fut.done():
+                fut.set_result((handle, 0, n))
+        except Exception as e:  # noqa: BLE001
+            if not fut.done():
+                fut.set_exception(e)
+        finally:
+            self._gate.release(n)
+        saturation.note_express("bypass", n)
+        saturation.observe_phase("express.submit", time.monotonic() - t0)
+        return fut
+
     def _flush(self, batch) -> None:
-        self._gate.release(sum(len(item[0][0]) for item in batch))
+        lanes = sum(len(item[0][0]) for item in batch)
+        self._gate.release(lanes)
+        saturation.note_express("windowed", lanes)
         # Saturation plane: per-submission window-wait attribution and
         # the dispatcher's busy fraction (flush wall time over elapsed).
         t_flush = time.monotonic()
@@ -1122,6 +1271,20 @@ class V1Service:
         self.columnar_batcher = ColumnarBatcher(
             self.store, conf.behaviors, self.clock, metrics=self.metrics
         )
+        # Express lane (architecture.md "Express lane"): the host-side
+        # scalar singleton slot is a SERVICE policy — bare stores keep
+        # it off so their dispatch counting is unchanged; the store
+        # probes its own capability (CPU backend, writable buffers)
+        # lazily on the first eligible singleton.
+        if (
+            getattr(conf.behaviors, "express", False)
+            and getattr(conf.behaviors, "express_scalar", False)
+            and hasattr(self.store, "scalar_fast_path")
+        ):
+            self.store.scalar_fast_path = True
+            self.store.scalar_max_lanes = int(
+                getattr(conf.behaviors, "express_max_lanes", 4)
+            )
         # Saturation & SLO plane (saturation.py): the latency-SLO burn
         # engine (GUBER_LATENCY_TARGET_MS; disabled at 0) judges every
         # ingress RPC via metrics.observe_latency, and the hot-key
@@ -2805,6 +2968,23 @@ class V1Service:
                 ),
             },
             "slo": self.slo.snapshot(),
+            # Express lane: knobs + hit rate + the host scalar slot's
+            # apply count (zero device programs by construction).
+            "express": {
+                "enabled": bool(
+                    getattr(self.conf.behaviors, "express", False)
+                ),
+                "queueDepth": int(
+                    getattr(self.conf.behaviors, "express_queue_depth", 0)
+                ),
+                "maxLanes": int(
+                    getattr(self.conf.behaviors, "express_max_lanes", 0)
+                ),
+                "scalarApplies": int(
+                    getattr(store, "scalar_applies", 0)
+                ),
+                **saturation.express_snapshot(),
+            },
             "hotkeys": self.hotkeys.snapshot()["topk"][:5],
             # Cost observatory (profiling.py): top tenants by cost and
             # the host-profiler vitals — the fleet poller's per-daemon

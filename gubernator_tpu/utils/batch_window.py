@@ -21,6 +21,14 @@ Two extensions over the reference shape:
   gets the full wait_s of coalescing.  `wait_s` is the upper bound
   always.
 
+* `cap_s` — a latency-SLO HARD CEILING on the effective wait
+  (GUBER_LATENCY_TARGET_MS binding, architecture.md "Express lane"):
+  when set, occupancy mode yields to latency mode — whatever wait the
+  static/adaptive sizing picked is clamped to `cap_s`, so no
+  submission can spend more than the configured slice of its latency
+  budget coalescing.  None (the default) keeps the occupancy-driven
+  window untouched.
+
 `stop()` joins the worker FIRST and then drains + flushes anything
 still queued — including items that raced past a closing check into
 the queue — so no submitted item is ever silently dropped.
@@ -48,11 +56,13 @@ class BatchWindow:
         lazy: bool = False,
         adaptive: bool = False,
         weigh: Optional[Callable[[object], int]] = None,
+        cap_s: Optional[float] = None,
     ):
         self._flush = flush
         self.wait_s = wait_s
         self.limit = limit
         self.adaptive = adaptive
+        self.cap_s = cap_s
         self._weigh = weigh
         self._rate: float = 0.0  # EMA weighted-items/s (adaptive only)
         self._last_flush_t: Optional[float] = None
@@ -90,8 +100,12 @@ class BatchWindow:
     def effective_wait_s(self) -> float:
         """The wait the NEXT window will use (exposed for tests/metrics)."""
         if not self.adaptive or self._rate <= 0:
-            return self.wait_s
-        return min(self.wait_s, self.limit / self._rate)
+            wait = self.wait_s
+        else:
+            wait = min(self.wait_s, self.limit / self._rate)
+        if self.cap_s is not None:
+            wait = min(wait, self.cap_s)
+        return wait
 
     def _run(self) -> None:
         while not self._stopped.is_set():

@@ -119,6 +119,11 @@ EXTENSIONS = frozenset(
         "gubernator_tenant_total",
         "gubernator_profile_samples",
         "gubernator_profile_hz",
+        # PR 14: millisecond express lane (architecture.md "Express
+        # lane") + the jax readback-flake quarantine counter.
+        "gubernator_express_lanes",
+        "gubernator_express_hit_ratio",
+        "gubernator_readback_retries",
     }
 )
 

@@ -251,7 +251,11 @@ def test_ingress_queue_sheds_with_429_error():
     from gubernator_tpu.types import RateLimitRequest
     from gubernator_tpu.utils.clock import DEFAULT_CLOCK
 
-    beh = BehaviorConfig(batch_wait_s=5.0, ingress_queue_lanes=100)
+    # express=False: this test pins the WINDOWED queue's shed semantics
+    # (express bypass lanes never queue, so they only shed when
+    # concurrent in-flight lanes exceed the cap).
+    beh = BehaviorConfig(batch_wait_s=5.0, ingress_queue_lanes=100,
+                         express=False)
     metrics = Metrics()
     cb = ColumnarBatcher(object(), beh, DEFAULT_CLOCK, metrics=metrics)
     try:
@@ -274,8 +278,8 @@ def test_ingress_queue_sheds_with_429_error():
         cb.stop()
 
     lb = LocalBatcher(object(), BehaviorConfig(
-        batch_wait_s=5.0, ingress_queue_lanes=2), DEFAULT_CLOCK,
-        metrics=metrics)
+        batch_wait_s=5.0, ingress_queue_lanes=2, express=False),
+        DEFAULT_CLOCK, metrics=metrics)
     try:
         r = RateLimitRequest(name="a", unique_key="b", hits=1, limit=5,
                              duration=60_000)

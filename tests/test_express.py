@@ -1,0 +1,565 @@
+"""Millisecond express lane (PR 14): shallow-queue bypass equivalence,
+the host scalar slot's oracle equivalence against the device kernel,
+audit-ledger balance with express and batched dispatches interleaving,
+the chaos DELAY-on-batched-path isolation, the GUBER_EXPRESS knobs, and
+NO_BATCHING on the native hot path."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gubernator_tpu import audit as audit_mod
+from gubernator_tpu import faults, native, saturation
+from gubernator_tpu.client import V1Client
+from gubernator_tpu.cluster import Cluster, fast_test_behaviors
+from gubernator_tpu.config import BehaviorConfig, setup_daemon_config
+from gubernator_tpu.faults import FaultPlan
+from gubernator_tpu.models.shard import ShardStore, host_readback
+from gubernator_tpu.parallel.mesh import MeshBucketStore
+from gubernator_tpu.service import IngressColumns, ServiceConfig, V1Service
+from gubernator_tpu.types import (
+    Behavior,
+    GetRateLimitsRequest,
+    PeerInfo,
+    RateLimitRequest,
+)
+from gubernator_tpu.utils.batch_window import BatchWindow
+
+
+# ---------------------------------------------------------------------
+# Window cap: GUBER_LATENCY_TARGET_MS binds
+# ---------------------------------------------------------------------
+
+def test_window_cap_clamps_effective_wait():
+    w = BatchWindow(lambda b: None, wait_s=0.5, limit=1000, lazy=True,
+                    cap_s=0.005)
+    assert w.effective_wait_s() == 0.005
+    # Adaptive sizing also yields to the cap (occupancy -> latency).
+    w2 = BatchWindow(lambda b: None, wait_s=0.5, limit=1000, lazy=True,
+                     adaptive=True, cap_s=0.002)
+    w2._rate = 10.0  # adaptive would pick limit/rate = 100s
+    assert w2.effective_wait_s() == 0.002
+    # No cap = the pre-express window, untouched.
+    w3 = BatchWindow(lambda b: None, wait_s=0.5, limit=1000, lazy=True)
+    assert w3.effective_wait_s() == 0.5
+
+
+def test_latency_target_caps_batcher_windows():
+    # A deliberately wide window (500 ms) with a 10 ms target: the cap
+    # (target/2 — half the budget coalesces, half pays dispatch) must
+    # bind on both batchers.
+    beh = BehaviorConfig(latency_target_ms=10.0, batch_wait_s=0.5)
+    svc = _service(beh)
+    try:
+        assert svc.columnar_batcher._window.effective_wait_s() == 0.005
+        assert svc.local_batcher._window.effective_wait_s() == 0.005
+    finally:
+        svc.close()
+    # Knob off (express=0): occupancy mode keeps the window.
+    svc = _service(BehaviorConfig(
+        latency_target_ms=10.0, batch_wait_s=0.5, express=False
+    ))
+    try:
+        assert svc.columnar_batcher._window.effective_wait_s() == 0.5
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------
+# Bypass-vs-windowed byte identity (2 seeds, ShardStore + mesh)
+# ---------------------------------------------------------------------
+
+class _FixedClock:
+    """Deterministic clock: byte-identity across two services needs
+    identical now_ms at every dispatch (reset_time derives from it)."""
+
+    def __init__(self, t0: int = 1_700_000_000_000):
+        self.t = t0
+
+    def now_ms(self) -> int:
+        return self.t
+
+
+def _service(behaviors: BehaviorConfig, store=None, clock=None) -> V1Service:
+    svc = V1Service(ServiceConfig(
+        store=store, cache_size=2048, global_cache_size=256,
+        behaviors=behaviors, advertise_address="127.0.0.1:9991",
+        **({"clock": clock} if clock is not None else {}),
+    ))
+    svc.set_peers([PeerInfo(grpc_address="127.0.0.1:9991", is_owner=True)])
+    return svc
+
+
+def _drive_stream(svc: V1Service, seed: int):
+    """One seeded request stream — singles and small column batches,
+    token + leaky, occasional RESET_REMAINING and duplicate keys —
+    returning every response triple in order."""
+    rng = random.Random(seed)
+    out = []
+    for step in range(60):
+        if rng.random() < 0.5:
+            r = RateLimitRequest(
+                name="xt", unique_key=f"k{rng.randrange(8)}", hits=1,
+                limit=20, duration=60_000,
+                algorithm=rng.choice([0, 1]),
+            )
+            resp = svc.get_rate_limits(
+                GetRateLimitsRequest(requests=[r])
+            ).responses[0]
+            out.append((resp.status, resp.remaining, resp.reset_time))
+        else:
+            n = rng.choice([2, 3, 4, 8])
+            ks = [f"k{rng.randrange(8)}" for _ in range(n)]
+            cols = IngressColumns(
+                names=["xt"] * n, unique_keys=ks,
+                algorithm=np.array(
+                    [rng.choice([0, 1]) for _ in range(n)], np.int32
+                ),
+                behavior=np.array(
+                    [rng.choice([0, 0, 0, 8]) for _ in range(n)], np.int32
+                ),
+                hits=np.ones(n, np.int64),
+                limit=np.full(n, 20, np.int64),
+                duration=np.full(n, 60_000, np.int64),
+            )
+            rc = svc.get_rate_limits_columns(cols)
+            for i in range(n):
+                resp = rc.response_at(i)
+                out.append((resp.status, resp.remaining, resp.reset_time))
+    return out
+
+
+@pytest.mark.parametrize("store_kind", ["shard", "mesh"])
+@pytest.mark.parametrize("seed", [21, 22])
+def test_bypass_vs_windowed_byte_identical(store_kind, seed):
+    """The express bypass changes WHEN a dispatch launches, never what
+    it computes: the same seeded request stream through an express-on
+    and an express-off service answers identically."""
+    def mk(express: bool):
+        store = (
+            ShardStore(capacity=512) if store_kind == "shard"
+            else MeshBucketStore(capacity_per_shard=128)
+        )
+        return _service(BehaviorConfig(express=express), store=store,
+                        clock=_FixedClock())
+
+    on, off = mk(True), mk(False)
+    try:
+        got_on = _drive_stream(on, seed)
+        got_off = _drive_stream(off, seed)
+        assert got_on == got_off
+        # The on-service actually exercised the lane (bypass + the
+        # host scalar slot) while the off-service stayed fully classic.
+        assert on.store.scalar_applies > 0
+        assert off.store.scalar_applies == 0
+        assert off.store.scalar_fast_path is False
+    finally:
+        on.close()
+        off.close()
+
+
+# ---------------------------------------------------------------------
+# Scalar fast path vs the device kernel (the oracle pin)
+# ---------------------------------------------------------------------
+
+def _drive_store(store, seed: int, steps: int = 150):
+    """Randomized small batches against the bulk columnar API: expiry
+    edges (clock jumps past short durations), duplicate-heavy batches,
+    token + leaky, RESET_REMAINING."""
+    rng = random.Random(seed)
+    out = []
+    now = 1_000_000
+    for step in range(steps):
+        n = rng.choice([1, 1, 2, 3, 4])
+        ks = [f"k{rng.randrange(6)}" for _ in range(n)]
+        if rng.random() < 0.35:
+            ks = [ks[0]] * n  # duplicate group
+        algo = np.array([rng.choice([0, 1]) for _ in range(n)], np.int32)
+        beh = np.array([rng.choice([0, 0, 0, 8]) for _ in range(n)], np.int32)
+        hits = np.array([rng.choice([0, 1, 1, 2, 5, 11]) for _ in range(n)],
+                        np.int64)
+        limit = np.full(n, rng.choice([1, 3, 10, 30]), np.int64)
+        dur = np.full(n, rng.choice([7, 50, 100, 1000]), np.int64)
+        now += rng.choice([0, 0, 1, 3, 60, 120, 1500])  # expiry edges
+        r = store.apply_columns(ks, algo, beh, hits, limit, dur, now)
+        out.append(tuple(
+            (int(r["status"][i]), int(r["remaining"][i]),
+             int(r["reset_time"][i]))
+            for i in range(n)
+        ))
+    return out
+
+
+@pytest.mark.parametrize("seed", [31, 32])
+def test_scalar_oracle_shard(seed):
+    a = ShardStore(capacity=64)
+    b = ShardStore(capacity=64)
+    b.scalar_fast_path = True
+    ra, rb = _drive_store(a, seed), _drive_store(b, seed)
+    if not b.scalar_applies:
+        pytest.skip("scalar fast path unavailable on this backend")
+    assert b.device_dispatches == 0  # zero programs: the whole point
+    assert ra == rb
+
+
+@pytest.mark.parametrize("seed", [31, 32])
+def test_scalar_oracle_mesh(seed):
+    a = MeshBucketStore(capacity_per_shard=32)
+    b = MeshBucketStore(capacity_per_shard=32)
+    b.scalar_fast_path = True
+    ra, rb = _drive_store(a, seed), _drive_store(b, seed)
+    if not b.scalar_applies:
+        pytest.skip("scalar fast path unavailable on this backend")
+    assert b.device_dispatches == 0
+    assert ra == rb
+
+
+def test_scalar_oracle_eviction_pressure():
+    """A tiny table forces mid-batch slot takeovers (a different key's
+    create evicting into a just-written slot) — the case the
+    sequential-exists rule must not confuse with a duplicate group."""
+    a, b = ShardStore(capacity=4), ShardStore(capacity=4)
+    b.scalar_fast_path = True
+    ra, rb = _drive_store(a, 41, steps=120), _drive_store(b, 41, steps=120)
+    if not b.scalar_applies:
+        pytest.skip("scalar fast path unavailable on this backend")
+    assert ra == rb
+
+
+def test_scalar_gregorian_lane():
+    """DURATION_IS_GREGORIAN lanes carry host-precomputed expiry; the
+    scalar slot must select them exactly like the kernel."""
+    now = 1_700_000_000_000
+    ge = np.array([now + 3_600_000], np.int64)
+    gd = np.array([3_600_000], np.int64)
+
+    def drive(store):
+        out = []
+        for i in range(4):
+            r = store.apply_columns(
+                ["gk"], np.zeros(1, np.int32),
+                np.full(1, int(Behavior.DURATION_IS_GREGORIAN), np.int32),
+                np.ones(1, np.int64), np.full(1, 10, np.int64),
+                np.full(1, 4, np.int64),  # calendar enum, not ms
+                now + i, greg_expire=ge, greg_duration=gd,
+            )
+            out.append((int(r["status"][0]), int(r["remaining"][0]),
+                        int(r["reset_time"][0])))
+        return out
+
+    a, b = ShardStore(capacity=16), ShardStore(capacity=16)
+    b.scalar_fast_path = True
+    ra, rb = drive(a), drive(b)
+    if not b.scalar_applies:
+        pytest.skip("scalar fast path unavailable on this backend")
+    assert ra == rb
+    assert rb[0] == (0, 9, now + 3_600_000)
+
+
+# ---------------------------------------------------------------------
+# Audit ledger balanced with express interleaving batched dispatches
+# ---------------------------------------------------------------------
+
+def test_audit_balanced_with_express_interleaving():
+    svc = _service(BehaviorConfig())
+    try:
+        rng = random.Random(7)
+        for step in range(40):
+            n = rng.choice([1, 1, 2, 24])  # express singles + batched
+            ks = [f"ak{rng.randrange(12)}" for _ in range(n)]
+            cols = IngressColumns(
+                names=["at"] * n, unique_keys=ks,
+                algorithm=np.zeros(n, np.int32),
+                behavior=np.zeros(n, np.int32),
+                hits=np.ones(n, np.int64),
+                limit=np.full(n, 1000, np.int64),
+                duration=np.full(n, 60_000, np.int64),
+            )
+            svc.get_rate_limits_columns(cols)
+        assert svc.store.scalar_applies > 0  # the lane really ran
+        violations = svc.auditor.check_now()
+        assert violations == [], violations
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------
+# Chaos: DELAY on the batched (forwarded) path must not stall express
+# ---------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_chaos_delay_on_batched_path_does_not_stall_express():
+    """A FaultPlan DELAY on every peer forward (the batched remote leg)
+    slows remote-owned keys to ~delay_s; locally-owned express singles
+    riding the bypass must keep answering orders of magnitude faster —
+    the lanes are independent by construction."""
+    cluster = Cluster().start(2)
+    try:
+        d0 = cluster.daemon_at(0)
+        svc = d0.service
+        # One locally-owned and one remotely-owned key, seen from d0.
+        # Index-FIRST keys: FNV-1 clusters suffix-varying keys into one
+        # vnode gap (the documented test_hash_ring finding), which can
+        # land all 64 on a single owner.
+        local_key = remote_key = None
+        for i in range(64):
+            k = f"{i}ck"
+            peer = svc.get_peer(f"ct_{k}")
+            if peer.info.is_owner and local_key is None:
+                local_key = k
+            if not peer.info.is_owner and remote_key is None:
+                remote_key = k
+            if local_key and remote_key:
+                break
+        assert local_key and remote_key
+
+        plan = FaultPlan(seed=3)
+        plan.delay("*", 1.5, op="GetPeerRateLimits")
+        with faults.injected(plan):
+            def one(k):
+                return svc.get_rate_limits(GetRateLimitsRequest(requests=[
+                    RateLimitRequest(name="ct", unique_key=k, hits=1,
+                                     limit=100, duration=60_000)
+                ])).responses[0]
+
+            t0 = time.monotonic()
+            slow_done = threading.Event()
+            threading.Thread(
+                target=lambda: (one(remote_key), slow_done.set()),
+                daemon=True,
+            ).start()
+            fast = [one(local_key) for _ in range(5)]
+            fast_elapsed = time.monotonic() - t0
+            assert all(r.error == "" for r in fast)
+            # 5 express rounds complete well inside ONE delayed
+            # forward (the bound is HALF the injected delay: isolation
+            # is the claim, with headroom for 2-core suite weather —
+            # express rounds are ~2-30 ms each).
+            assert fast_elapsed < 0.75, fast_elapsed
+            assert not slow_done.is_set()  # the delayed leg still parked
+            assert slow_done.wait(timeout=10.0)
+    finally:
+        cluster.stop()
+
+
+# ---------------------------------------------------------------------
+# Config plumbing + the GUBER_EXPRESS=0 interop switch
+# ---------------------------------------------------------------------
+
+def test_express_knobs_env_plumbing():
+    conf = setup_daemon_config(env={
+        "GUBER_EXPRESS": "0",
+        "GUBER_EXPRESS_QUEUE_DEPTH": "128",
+        "GUBER_EXPRESS_MAX_LANES": "8",
+        "GUBER_EXPRESS_SCALAR": "0",
+    })
+    b = conf.behaviors
+    assert b.express is False
+    assert b.express_queue_depth == 128
+    assert b.express_max_lanes == 8
+    assert b.express_scalar is False
+    # Defaults: the lane ships ON.
+    d = setup_daemon_config(env={})
+    assert d.behaviors.express is True
+    assert d.behaviors.express_queue_depth == 64
+    assert d.behaviors.express_max_lanes == 4
+    assert d.behaviors.express_scalar is True
+
+
+@pytest.mark.parametrize("env", [
+    {"GUBER_EXPRESS_QUEUE_DEPTH": "0"},
+    {"GUBER_EXPRESS_QUEUE_DEPTH": "2000000"},
+    {"GUBER_EXPRESS_MAX_LANES": "0"},
+    {"GUBER_EXPRESS_MAX_LANES": "65"},
+])
+def test_express_knobs_loud_validation(env):
+    with pytest.raises(ValueError):
+        setup_daemon_config(env=env)
+
+
+def test_express_off_is_pre_express_behavior():
+    """GUBER_EXPRESS=0: no bypass, no scalar slot, windows uncapped —
+    every submission waits out the coalescing window exactly as before
+    the lane existed."""
+    saturation.reset()
+    svc = _service(BehaviorConfig(express=False, latency_target_ms=5.0))
+    try:
+        assert svc.store.scalar_fast_path is False
+        assert svc.columnar_batcher._express.enabled is False
+        assert svc.columnar_batcher._window.cap_s is None
+        for i in range(4):
+            svc.get_rate_limits(GetRateLimitsRequest(requests=[
+                RateLimitRequest(name="off", unique_key=f"k{i}", hits=1,
+                                 limit=10, duration=60_000)
+            ]))
+        snap = saturation.express_snapshot()
+        assert snap["lanes"]["bypass"] == 0
+        assert snap["lanes"]["scalar"] == 0
+        assert snap["lanes"]["windowed"] > 0
+        assert svc.store.scalar_applies == 0
+    finally:
+        svc.close()
+        saturation.reset()
+
+
+# ---------------------------------------------------------------------
+# Native hot path: NO_BATCHING rides the express queue, not Python
+# ---------------------------------------------------------------------
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native runtime unavailable")
+@pytest.mark.parametrize("express", [True, False])
+def test_native_no_batching_express_vs_fallback(express):
+    """With the lane on, a NO_BATCHING kind-5 frame is served natively
+    through the express queue (expressFrames counted, zero fallbacks);
+    with GUBER_EXPRESS=0 it falls back to the Python path — exactly the
+    PR 13 behavior — and both answer correct bytes."""
+    from tests.test_native_loop import _frame, _post, _standalone
+    from gubernator_tpu import wire
+    from gubernator_tpu.utils.clock import Clock
+
+    import tests.test_native_loop as tnl
+
+    d = tnl._standalone(Clock(), native_ingress=True)
+    try:
+        if not express:
+            d.service.conf.behaviors.express = False
+            d.gateway.pump.update_ring()  # re-push the masks
+        pump = d.gateway.pump
+        before = pump.stats()
+        frame = _frame("nb", ["k1"], behavior=int(Behavior.NO_BATCHING))
+        raw, body = _post(d.gateway._edge.port, frame)
+        assert raw.startswith(b"HTTP/1.1 200 OK")
+        rc = wire.decode_ingress_result_frame(body)
+        assert rc.n == 1 and int(rc.status[0]) == 0
+        after = pump.stats()
+        if express:
+            assert after["expressFrames"] == before["expressFrames"] + 1
+            assert after["fallbacks"] == before["fallbacks"]
+        else:
+            assert after["expressFrames"] == before["expressFrames"]
+            assert after["fallbacks"] > before["fallbacks"]
+            assert after["frames"] == before["frames"]  # never in the ring
+    finally:
+        d.close()
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native runtime unavailable")
+def test_debug_surfaces_report_express():
+    import json
+    import urllib.request
+
+    from tests.test_native_loop import _frame, _post, _standalone
+    from gubernator_tpu.utils.clock import Clock
+
+    d = _standalone(Clock(), native_ingress=True)
+    try:
+        frame = _frame("dbg", ["k1"], behavior=int(Behavior.NO_BATCHING))
+        _post(d.gateway._edge.port, frame)
+        # Give the pump's stats poll a beat to fold the express delta.
+        deadline = time.time() + 5.0
+        port = d.gateway._edge.port
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/status", timeout=5
+            ) as f:
+                status = json.loads(f.read())
+            if status["express"]["lanes"].get("native", 0) > 0:
+                break
+            time.sleep(0.05)
+        assert status["express"]["enabled"] is True
+        assert status["express"]["lanes"]["native"] >= 1
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/latency", timeout=5
+        ) as f:
+            lat = json.loads(f.read())
+        assert "express" in lat and "hitRate" in lat["express"]
+    finally:
+        d.close()
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native runtime unavailable")
+def test_native_take_is_express_pure():
+    """An express frame queued behind bulk backlog jumps the queue AND
+    its take never keeps filling from the bulk queue — otherwise the
+    express response would wait out a full coalesced dispatch and
+    outgrow the scalar slot."""
+    from tests.test_native_loop import (
+        _connect, _edge_with_batcher, _frame, _http_post,
+    )
+
+    edge, b, _ring = _edge_with_batcher(["me"], "me")
+    # Re-push with the express mask on (the pump's GUBER_EXPRESS shape).
+    b.set_ring(
+        np.zeros(0, np.uint64), np.zeros(0, np.uint8), all_self=True,
+        enabled=True, cap_lanes=0, max_frame_lanes=16384,
+        behavior_mask=2 | 4 | 16, express_mask=1,
+    )
+    socks = []
+    try:
+        # Two bulk frames, then one NO_BATCHING express frame — one
+        # connection each (response plumbing is not what this pins).
+        for i in range(2):
+            s = _connect(edge.port)
+            socks.append(s)
+            s.sendall(_http_post(_frame("xp", [f"b{i}a", f"b{i}b"])))
+            assert edge.next(timeout_ms=2000, ingress=b) is native.FAST_LANE
+        s = _connect(edge.port)
+        socks.append(s)
+        s.sendall(_http_post(_frame(
+            "xp", ["xk"], behavior=int(Behavior.NO_BATCHING)
+        )))
+        assert edge.next(timeout_ms=2000, ingress=b) is native.FAST_LANE
+        # First take: the express frame ALONE (jumped 4 bulk lanes).
+        tb = b.take(65536, timeout_ms=2000)
+        assert tb is not None and tb.n == 1 and tb.n_frames == 1
+        b.fail(tb, 500, "Error", "application/json", b"{}")
+        # Second take: the bulk frames, coalesced.
+        tb2 = b.take(65536, timeout_ms=2000)
+        assert tb2 is not None and tb2.n == 4 and tb2.n_frames == 2
+        b.fail(tb2, 500, "Error", "application/json", b"{}")
+        assert b.stats()["expressLanes"] == 1
+    finally:
+        for s in socks:
+            s.close()
+        b.free()
+        edge.shutdown()
+
+
+# ---------------------------------------------------------------------
+# Readback-flake quarantine (the counted single retry)
+# ---------------------------------------------------------------------
+
+def test_host_readback_retries_indexerror_once():
+    from gubernator_tpu.models import shard as shard_mod
+
+    class Flaky:
+        def __init__(self, fail_times):
+            self.fails = fail_times
+
+        def __array__(self, dtype=None, copy=None):
+            if self.fails:
+                self.fails -= 1
+                raise IndexError("list index out of range")
+            return np.arange(3)
+
+    before = shard_mod.readback_retries_total()
+    out = host_readback(Flaky(1))
+    assert list(out) == [0, 1, 2]
+    assert shard_mod.readback_retries_total() == before + 1
+    # A second consecutive failure propagates (one retry, not a loop).
+    with pytest.raises(IndexError):
+        host_readback(Flaky(2))
+    # Non-IndexError failures propagate untouched.
+    class Broken:
+        def __array__(self, dtype=None, copy=None):
+            raise ValueError("boom")
+    with pytest.raises(ValueError):
+        host_readback(Broken())

@@ -463,7 +463,12 @@ def test_ingress_batching_coalesces_concurrent_requests():
         DaemonConfig(
             listen_address="127.0.0.1:0",
             cache_size=1024,
-            behaviors=BehaviorConfig(batch_wait_s=0.05),  # wide window
+            # express=False: this test pins the WINDOWED coalescing
+            # mechanism itself (with the express lane on, a shallow
+            # herd of singles bypasses the window by design and rides
+            # solo/fused dispatches instead — tests/test_express.py
+            # covers that path).
+            behaviors=BehaviorConfig(batch_wait_s=0.05, express=False),
         )
     )
     try:
