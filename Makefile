@@ -1,5 +1,5 @@
 # Reference Makefile:1-35 equivalents for the TPU build.
-.PHONY: test tier1 chaos bench bench-gate bench-trend soak soak-smoke soak-regions proto certs docker release clean native
+.PHONY: test tier1 chaos bench bench-gate bench-trend soak soak-smoke soak-regions replay-smoke proto certs docker release clean native
 
 # Compile the C++ host runtime for the CURRENT source of
 # gubernator_tpu/native/host_runtime.cpp.  Flags are pinned in ONE
@@ -91,6 +91,14 @@ soak:
 # plus the region ledger must have moved (the plane demonstrably ran).
 soak-regions:
 	env JAX_PLATFORMS=cpu python scripts/soak.py --minutes 3 --regions 2x2
+
+# Incident black box end-to-end in one command (architecture.md
+# "Incident black box"): synthesize a capture with a duplicated
+# forward frame, write a bundle, replay it TWICE against fresh
+# daemons, and require byte-identical reports reproducing the
+# forward_conservation violation.  Exits nonzero on any divergence.
+replay-smoke:
+	env JAX_PLATFORMS=cpu python scripts/replay.py --smoke
 
 proto:
 	bash scripts/proto.sh

@@ -761,6 +761,79 @@ def measure_profiling_overhead(n_threads: int = 8, iters: int = 8,
         profiling.set_enabled(prev)
 
 
+def measure_blackbox_overhead(n_threads: int = 8, iters: int = 8,
+                              pairs: int = 10):
+    """Incident-black-box tap overhead (the PR 4/9/12 playbook applied
+    to blackbox.py): headline ingress checks/s with the always-on wire
+    tap recording every gateway frame into the byte-budgeted rings
+    (the shipped default) over the same path force-disabled (every tap
+    = one branch), ABBA interval quads on one continuously loaded
+    warmed service, median quad ratio (_overhead_pairs).  Gated at
+    floor 0.95.  Also counts audit-violation flight-recorder events
+    seen during the run — the ratio only counts if conservation held
+    at it.  Returns (ratio, off_cps, on_cps, noise, violations)."""
+    from gubernator_tpu import blackbox, tracing
+
+    def _violation_events() -> int:
+        return sum(
+            1 for e in tracing.events_snapshot(
+                recorders=tracing.all_recorders()
+            )
+            if e.get("kind") == "audit-violation"
+        )
+
+    before = _violation_events()
+    try:
+        ratio, off_cps, on_cps, r_noise = _overhead_pairs(
+            lambda: blackbox.force_disable(True),
+            lambda: blackbox.force_disable(False),
+            n_threads, iters, pairs,
+        )
+    finally:
+        # One restore covering every leg (the telemetry-gate rule).
+        blackbox.force_disable(False)
+    return ratio, off_cps, on_cps, r_noise, _violation_events() - before
+
+
+def measure_blackbox_bundle_write(budget_mb: int = 16):
+    """Wall time of ONE incident bundle write at full rings (the
+    freeze -> frame-log encode -> per-file fsync -> atomic rename
+    path, blackbox.write_bundle): the cost a trigger pays off-thread
+    while the hot path keeps running.  Rings are pre-filled to their
+    byte budget with realistic 64-lane frames on every wire.  Returns
+    (ms, ring_bytes)."""
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from gubernator_tpu import blackbox, wire
+
+    d = _tempfile.mkdtemp(prefix="gubernator-bench-blackbox-")
+    bb = blackbox.BlackBox(None, path=d, budget_mb=budget_mb)
+    lanes = 64
+    cols = (
+        ["bench"] * lanes,
+        [f"key-{i:06d}" for i in range(lanes)],
+        [1] * lanes, [0] * lanes, [2] * lanes,
+        [1000] * lanes, [60_000] * lanes,
+    )
+    try:
+        for kind in (1, 3, 4, 5, 7):
+            frame = wire.encode_columns_frame(cols, kind=kind)
+            ring = bb.rings[blackbox._KIND_WIRE[kind]]
+            per_rec = len(frame) + 32
+            for _ in range(ring.budget // per_rec + 1):
+                bb.tap("in", "10.0.0.9:1051", frame)
+        ring_bytes = sum(bb.rings[w].stats()[1] for w in blackbox.WIRES)
+        t0 = time.perf_counter()
+        bb.write_bundle([{"kind": "bench", "wallNs": 0, "monoNs": 0,
+                          "fields": {}}])
+        ms = (time.perf_counter() - t0) * 1000.0
+        return ms, ring_bytes
+    finally:
+        bb.close()
+        _shutil.rmtree(d, ignore_errors=True)
+
+
 def _git_sha() -> str:
     import subprocess
 
@@ -2061,6 +2134,31 @@ def gate() -> int:
         )
     except Exception as e:  # noqa: BLE001 — service spawn can fail
         print(f"gate profiling_overhead_ratio: SKIP (measure failed: {e})")
+    # Same rule for the incident-black-box tap (blackbox.py), plus the
+    # off-thread bundle-write ceiling and the conservation rider: the
+    # ratio only counts if zero audit violations fired during the run.
+    try:
+        ratio, off_cps, on_cps, r_noise, bb_viol = (
+            measure_blackbox_overhead()
+        )
+        rows["blackbox_overhead_ratio"] = ratio
+        noise["blackbox_overhead_ratio"] = r_noise
+        rows["blackbox_audit_violations"] = bb_viol
+        print(
+            f"gate blackbox rows: compiled-out {off_cps:.0f} checks/s, "
+            f"on {on_cps:.0f} checks/s, violations {bb_viol}"
+        )
+    except Exception as e:  # noqa: BLE001 — service spawn can fail
+        print(f"gate blackbox_overhead_ratio: SKIP (measure failed: {e})")
+    try:
+        ms, ring_bytes = measure_blackbox_bundle_write()
+        rows["blackbox_bundle_write_ms"] = ms
+        print(
+            f"gate blackbox bundle write: {ms:.0f}ms for "
+            f"{ring_bytes / 1e6:.1f}MB of rings"
+        )
+    except Exception as e:  # noqa: BLE001 — disk can fail
+        print(f"gate blackbox_bundle_write_ms: SKIP (measure failed: {e})")
     failed = []
     for name, spec in thresholds.items():
         if name.startswith("_"):

@@ -248,11 +248,17 @@ class Auditor:
     is excluded from its verdicts."""
 
     def __init__(self, metrics=None, interval_s: float = 5.0,
-                 enabled: bool = True, time_fn=time.monotonic):
+                 enabled: bool = True, time_fn=time.monotonic,
+                 recorder=None):
         self.metrics = metrics
         self.interval_s = max(float(interval_s), 0.05)
         self.enabled = bool(enabled)
         self._time = time_fn
+        # The owning service's flight recorder: bound in the audit
+        # thread so violation events (and the incident bundles they
+        # trigger) attribute to THIS daemon, not the process default —
+        # co-resident soak daemons each get their own black box.
+        self.recorder = recorder
         self._baseline: Dict[str, int] = {}
         self._violation_extents: Dict[str, int] = {}
         self.violations: Dict[str, int] = {}
@@ -299,6 +305,8 @@ class Auditor:
             self._thread = None
 
     def _run(self) -> None:
+        if self.recorder is not None:
+            tracing.bind_recorder(self.recorder)
         while not self._stop.wait(self.interval_s):
             try:
                 self.check_now()

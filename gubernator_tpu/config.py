@@ -298,6 +298,25 @@ class BehaviorConfig:
     # GUBER_SNAPSHOT_INTERVAL (a Go duration string; bare number = ms).
     snapshot_interval_s: float = 60.0
 
+    # -- incident black box (blackbox.py) ------------------------------
+    # Always-on bounded traffic tap at every GUBC wire choke point:
+    # per-wire byte-budgeted rings of raw frames, frozen into a
+    # crash-safe on-disk bundle whenever a flight-recorder auto-dump
+    # trigger fires (breaker-open, audit-violation, slo-fast-burn, ...)
+    # or an operator POSTs /debug/incident — replayable with
+    # scripts/replay.py.  False = one branch per frame (the tap and
+    # trigger hooks go dark; bench-gated blackbox_overhead_ratio).
+    # Env: GUBER_BLACKBOX.
+    blackbox: bool = True
+    # Total in-memory capture budget in MiB, split across the five wire
+    # rings (public/peer/global/transfer/region).  Env:
+    # GUBER_BLACKBOX_MB (loud reject outside [1, 4096]).
+    blackbox_mb: int = 64
+    # Bundle retention: oldest incident-* dirs beyond this count are
+    # pruned after each write.  Env: GUBER_BLACKBOX_RETAIN (loud reject
+    # outside [1, 1024]).
+    blackbox_retain: int = 8
+
 
 @dataclass
 class DaemonConfig:
@@ -359,6 +378,12 @@ class DaemonConfig:
     # behaviors.snapshot_interval_s; restored at boot with ONE monotone
     # merge-commit.  Env: GUBER_SNAPSHOT.
     snapshot_path: str = ""
+    # Incident black box (blackbox.py): directory incident bundles are
+    # written into.  "" (and the boolean-flavored opt-outs in the env
+    # var) = no bundles — the in-memory rings still run (and feed
+    # /debug/status), there's just nowhere to freeze them to.
+    # Env: GUBER_BLACKBOX_DIR.
+    blackbox_dir: str = ""
     data_center: str = ""
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
     # Static peer list (the zero-dependency discovery mode; etcd/
@@ -722,6 +747,29 @@ def setup_daemon_config(
     )
     if b.snapshot_interval_s < 0:
         raise ValueError("GUBER_SNAPSHOT_INTERVAL must be >= 0")
+    b.blackbox = _env_bool(merged, "GUBER_BLACKBOX", b.blackbox)
+    b.blackbox_mb = _env_int(merged, "GUBER_BLACKBOX_MB", b.blackbox_mb)
+    if not 1 <= b.blackbox_mb <= 4096:
+        # Loud, not clamped: a 0 budget silently capturing nothing
+        # while the tap reads enabled would surface as an empty bundle
+        # at the worst possible moment (mid-incident).
+        raise ValueError(
+            f"GUBER_BLACKBOX_MB must be in [1, 4096], got '{b.blackbox_mb}'"
+        )
+    b.blackbox_retain = _env_int(
+        merged, "GUBER_BLACKBOX_RETAIN", b.blackbox_retain
+    )
+    if not 1 <= b.blackbox_retain <= 1024:
+        raise ValueError(
+            f"GUBER_BLACKBOX_RETAIN must be in [1, 1024], "
+            f"got '{b.blackbox_retain}'"
+        )
+    v = merged.get("GUBER_BLACKBOX_DIR", "").strip()
+    # Same boolean-flavored opt-outs as GUBER_SNAPSHOT: "0" reads as
+    # "no bundle dir", not as a directory named 0.
+    conf.blackbox_dir = (
+        "" if v.lower() in ("", "0", "false", "off", "no") else v
+    )
     v = merged.get("GUBER_TRACE_SAMPLE", "")
     if v:
         try:

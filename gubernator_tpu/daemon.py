@@ -51,9 +51,15 @@ class Daemon:
         # the module's import-time env default — unconditionally, so a
         # config that says 0 also DISABLES tracing a stale environment
         # variable turned on.
-        from . import profiling, telemetry, tracing
+        from . import blackbox, profiling, telemetry, tracing
 
         tracing.set_sample_rate(self.conf.behaviors.trace_sample)
+        # The incident black box's master switch is process-wide like
+        # tracing; the parsed GUBER_BLACKBOX wins over the module's
+        # import-time env default, in both directions.  (The rings,
+        # bundle dir and budgets are per-service — V1Service builds
+        # them from the behaviors below.)
+        blackbox.set_enabled(self.conf.behaviors.blackbox)
         # XLA telemetry is process-wide like tracing; the parsed
         # GUBER_XLA_TELEMETRY wins over the module's import-time env
         # default, in both directions.
@@ -92,6 +98,7 @@ class Daemon:
             persist_store=self.conf.store,
             loader=self.conf.loader,
             snapshot_path=getattr(self.conf, "snapshot_path", ""),
+            blackbox_dir=getattr(self.conf, "blackbox_dir", ""),
             clock=self.clock,
             metrics=metrics,
             devices=self.conf.devices,

@@ -225,6 +225,23 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
     mapping with .get, or None) feeds traceparent extraction and
     /metrics content negotiation; the native edge passes None — its
     requests root fresh traces."""
+    # Per-service flight recorder + incident black box: bind this
+    # daemon's recorder for the handler's duration (co-resident daemons
+    # stop interleaving their rings), and tap every GUBC frame at the
+    # gateway edge, both directions (bb.tap sniffs the frame magic, so
+    # JSON bodies cost one length/prefix check each way).
+    tracing.bind_recorder(getattr(service, "recorder", None))
+    bb = getattr(service, "blackbox", None)
+    if bb is not None and raw:
+        bb.tap("in", "", raw)
+    status, ctype, body = _handle_request(service, method, path, raw, headers)
+    if bb is not None and body:
+        bb.tap("out", "", body)
+    return status, ctype, body
+
+
+def _handle_request(service: V1Service, method: str, path: str, raw: bytes,
+                    headers=None):
     try:
         if method == "GET":
             # /healthz is an alias so stock k8s liveness/readiness
@@ -253,6 +270,7 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
                     service.metrics.observe_audit(service)
                     service.metrics.observe_cost(service)
                     service.metrics.observe_native_ingress(service)
+                    service.metrics.observe_blackbox(service)
                     service.metrics.observe_peers(
                         service.get_peer_list()
                         + list(service.get_region_picker().peers())
@@ -263,7 +281,7 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
                 return 200, ctype, payload
             qpath = urlsplit(path).path
             if qpath in ("/debug/traces", "/debug/events"):
-                return _debug_dump(path)
+                return _debug_dump(service, path)
             if qpath == "/debug/status":
                 # The cluster-status surface: one JSON doc per daemon
                 # (scripts/cluster_status.py polls these).
@@ -397,6 +415,8 @@ def handle_request(service: V1Service, method: str, path: str, raw: bytes,
             )
         if path == "/debug/profile":
             return _debug_profile(raw)
+        if path == "/debug/incident":
+            return _debug_incident(service, raw)
         if (path == "/v1/peer.UpdateRegionColumns"
                 and service.serves_region_columns):
             # Cross-region federation receive (federation.py): GUBC
@@ -485,7 +505,7 @@ def _json_bytes(payload) -> bytes:
     return json.dumps(payload).encode("utf-8")
 
 
-def _debug_dump(path: str):
+def _debug_dump(service, path: str):
     """GET /debug/traces[?trace_id=<32-hex>][&since=<wall-ns>]
     [&limit=<n>] and GET /debug/events: dump the flight recorder
     (tracing.py).  The trace filter matches a span's own trace id OR
@@ -495,11 +515,17 @@ def _debug_dump(path: str):
     (scripts/trace_collect.py) can poll incrementally instead of
     re-reading the whole ring; `limit` keeps the OLDEST N after the
     filter (pagination order — the poller's next `since` cursor picks
-    up exactly where this page ended)."""
+    up exactly where this page ended).  Reads across EVERY live
+    recorder: per-service recorders exist so incident bundles stay
+    attributable per daemon (blackbox.py snapshots only its service's
+    ring), but the debug READ surface keeps the one-ring view — a
+    cross-daemon trace in a co-resident cluster must be visible from
+    ANY daemon's debug port (the two-daemon trace-stitching contract)."""
+    recorders = None
     parts = urlsplit(path)
     if parts.path == "/debug/events":
         return 200, "application/json", _json_bytes(
-            {"events": tracing.events_snapshot()}
+            {"events": tracing.events_snapshot(recorders=recorders)}
         )
     q = parse_qs(parts.query)
     trace_id = (q.get("trace_id") or [""])[0]
@@ -514,7 +540,8 @@ def _debug_dump(path: str):
         {
             "sampleRate": tracing.sample_rate(),
             "spans": tracing.spans_snapshot(
-                trace_id, since_ns=_int_q("since"), limit=_int_q("limit")
+                trace_id, since_ns=_int_q("since"), limit=_int_q("limit"),
+                recorders=recorders,
             ),
         }
     )
@@ -652,6 +679,41 @@ def _debug_profile(raw: bytes):
     )
 
 
+def _debug_incident(service, raw: bytes):
+    """POST /debug/incident [{"reason": "..."}]: operator-requested
+    incident bundle (blackbox.py) — freeze the wire rings + debug
+    surfaces into an on-disk bundle exactly as an auto-dump trigger
+    would, but exempt from the writer's rate limit.  403 when the
+    black box is disabled (GUBER_BLACKBOX=0 must not let callers
+    re-arm capture), 409 when no bundle directory is configured (the
+    rings run but there is nowhere to freeze them), 202 otherwise —
+    the write happens off-thread (the /debug/profile shape: evidence
+    collection must not park a gateway worker)."""
+    from . import blackbox as blackbox_mod
+
+    bb = getattr(service, "blackbox", None)
+    if bb is None or not (blackbox_mod.enabled() and bb._on):  # noqa: SLF001
+        raise ApiError(
+            "InvalidArgument",
+            "incident capture requires the black box enabled "
+            "(GUBER_BLACKBOX=1)",
+            http_status=403,
+        )
+    if not bb.path:
+        return 409, "application/json", _json_bytes(
+            {
+                "code": 9,
+                "message": "no bundle directory configured "
+                           "(GUBER_BLACKBOX_DIR)",
+            }
+        )
+    body = json.loads(raw) if raw else {}
+    if not isinstance(body, dict):
+        raise ApiError("InvalidArgument", "body must be a JSON object")
+    doc = bb.trigger_manual(str(body.get("reason", "")))
+    return 202, "application/json", _json_bytes(doc)
+
+
 def _decode_frame_or_400(raw: bytes):
     """Frame decode for the peer endpoint: a malformed/truncated frame
     is the CLIENT's fault — surface it as a 400 (ApiError), not a 500,
@@ -706,6 +768,14 @@ def handle_request_async(service: V1Service, method: str, path: str,
     ):
         respond(*handle_request(service, method, path, raw, headers))
         return
+    # Recorder binding + black-box edge taps, the handle_request
+    # discipline (the early branch above already taps inside
+    # handle_request): request on the submitting worker here, response
+    # in finish() where the rendered triplet exists.
+    tracing.bind_recorder(getattr(service, "recorder", None))
+    bb = getattr(service, "blackbox", None)
+    if bb is not None and raw:
+        bb.tap("in", "", raw)
     rpc = (
         "/pb.gubernator.V1/GetRateLimits"
         if path == "/v1/GetRateLimits"
@@ -743,6 +813,8 @@ def handle_request_async(service: V1Service, method: str, path: str,
         metrics.request_duration.labels(method=rpc).observe(dt)
         metrics.observe_latency(rpc, dt, ctx=span.ctx if span else None)
         span.end(status=status_label)
+        if bb is not None and triplet[2]:
+            bb.tap("out", "", triplet[2])
         respond(*triplet)
 
     try:
@@ -915,7 +987,9 @@ class NativeIngressPump:
         self._stopped = threading.Event()
         self._threads: list = []
         self._done_pool = ThreadPoolExecutor(
-            max_workers=2, thread_name_prefix="native-ingress-done"
+            max_workers=2, thread_name_prefix="native-ingress-done",
+            initializer=tracing.bind_recorder,
+            initargs=(getattr(service, "recorder", None),),
         )
         self._ring_lock = threading.Lock()
         self._ring = None
@@ -1025,6 +1099,8 @@ class NativeIngressPump:
 
     def _run(self) -> None:
         batcher = self.batcher
+        tracing.bind_recorder(getattr(self.service, "recorder", None))
+        bb = getattr(self.service, "blackbox", None)
         while not self._stopped.is_set():
             with self._ring_lock:
                 # Check-and-push under ONE lock hold: a set_peers that
@@ -1075,6 +1151,14 @@ class NativeIngressPump:
                 if batcher.stopped:
                     return
                 continue
+            if bb is not None:
+                # Black-box native tap, BEFORE _submit: the batch's
+                # zero-copy views die at complete()/fail(), and this is
+                # the only point where the coalesced frames' bytes can
+                # still be reconstructed (express-lane singles answered
+                # entirely in C++ never surface here — documented
+                # capture slack, architecture.md "Incident black box").
+                bb.tap_taken(tb)
             self._sem.acquire()
             try:
                 args = self._submit(tb)

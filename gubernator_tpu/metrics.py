@@ -592,6 +592,34 @@ class Metrics:
             ["entry"],
             registry=self.registry,
         )
+        # -- incident black box (blackbox.py) --------------------------
+        self.blackbox_frames = Counter(
+            "gubernator_blackbox_frames",
+            "Wire frames captured by the incident black box's traffic "
+            "tap, by wire plane (ring eviction does not decrement — "
+            "this counts everything that passed the tap).",
+            ["wire"],
+            registry=self.registry,
+        )
+        self.blackbox_ring_bytes = Gauge(
+            "gubernator_blackbox_ring_bytes",
+            "Current bytes held in each black-box capture ring "
+            "(byte-budgeted: GUBER_BLACKBOX_MB split across wires).",
+            ["wire"],
+            registry=self.registry,
+        )
+        self.blackbox_bundles = Counter(
+            "gubernator_blackbox_bundles",
+            "Incident bundles written (trigger-coalesced and "
+            "rate-limited; retention-pruned bundles still count).",
+            registry=self.registry,
+        )
+        self.blackbox_last_trigger_age = Gauge(
+            "gubernator_blackbox_last_trigger_age_seconds",
+            "Seconds since the last black-box trigger (auto-dump event "
+            "or POST /debug/incident); -1 = never triggered.",
+            registry=self.registry,
+        )
         # SloEngine (saturation.py), attached by the owning V1Service;
         # observe_latency judges GetRateLimits requests against it.
         self.slo = None
@@ -922,6 +950,24 @@ class Metrics:
             self.audit_ledger.labels(entry=entry).set(value)
         for entry, value in audit_mod.gauges_snapshot().items():
             self.audit_ledger.labels(entry=entry).set(value)
+
+    def observe_blackbox(self, service) -> None:
+        """Refresh the incident-black-box families from the service's
+        BlackBox (collect-on-scrape: the tap itself never touches
+        prometheus — one branch + ring append per frame)."""
+        bb = getattr(service, "blackbox", None)
+        if bb is None:
+            return
+        for wire_name, ring in bb.rings.items():
+            _n, nbytes, frames_total = ring.stats()
+            self._bump(self.blackbox_frames.labels(wire=wire_name),
+                       frames_total)
+            self.blackbox_ring_bytes.labels(wire=wire_name).set(nbytes)
+        self._bump(self.blackbox_bundles, bb.bundles_written)
+        snap_age = bb.snapshot().get("lastTriggerAgeS")
+        self.blackbox_last_trigger_age.set(
+            -1 if snap_age is None else snap_age
+        )
 
     def _bump(self, counter, absolute: float) -> None:
         current = counter._value.get()  # noqa: SLF001

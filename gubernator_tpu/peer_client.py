@@ -111,6 +111,7 @@ class PeerClient:
         transport: str = "",  # "" = auto, "grpc", "http"
         metrics: object = None,  # Optional[Metrics]: breaker transition counts
         faults: Optional[FaultPlan] = None,  # None = honor faults.install()
+        blackbox: object = None,  # Optional[BlackBox]: wire traffic tap
     ):
         self.info = info
         self.behaviors = behaviors or BehaviorConfig()
@@ -118,6 +119,11 @@ class PeerClient:
         self.channel_credentials = channel_credentials
         self.faults = faults
         self._metrics = metrics
+        # Incident black box (blackbox.py): _http_roundtrip taps every
+        # outbound GUBC frame + its response here — the one choke point
+        # ALL HTTP peer traffic (forward, globals, transfer, region,
+        # and fault-injected redeliveries) flows through.
+        self.blackbox = blackbox
         self.breaker = CircuitBreaker(
             failure_threshold=self.behaviors.circuit_threshold,
             open_interval_s=self.behaviors.circuit_open_interval_s,
@@ -536,6 +542,12 @@ class PeerClient:
         timeout = (
             timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
         )
+        bb = self.blackbox
+        if bb is not None and bb.live():
+            # Canonical kind-7 frame of the proto send (see the
+            # _grpc_columns_inner tap): per delivery, so a DUPLICATE
+            # re-delivery records twice.
+            bb.tap("out", self.info.grpc_address, batch.frame())
         try:
             get_rl, _upd, _get_cols, _upd_cols = self._ensure_channel()
             with self._conn_lock:
@@ -1096,6 +1108,14 @@ class PeerClient:
         timeout = (
             timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
         )
+        bb = self.blackbox
+        if bb is not None and bb.live():
+            # gRPC carries proto columns, not GUBC bytes — capture the
+            # canonical frame encoding of the same columns so the ring
+            # stays replayable.  Tapped here (per delivery, inside the
+            # guarded call) so a DUPLICATE re-delivery records twice.
+            bb.tap("out", self.info.grpc_address,
+                   wire.encode_columns_frame(cols, trace=trace))
         try:
             get_rl, _upd, get_cols, _ = self._ensure_channel()
             if self._columnar is not False:
@@ -1284,6 +1304,12 @@ class PeerClient:
         status (the columns negotiation reads it)."""
         timeout = timeout_s if timeout_s is not None else self.behaviors.batch_timeout_s
         host = self.info.http_address or self.info.grpc_address
+        bb = self.blackbox
+        if bb is not None:
+            # Outbound tap BEFORE the send: a frame that times out or
+            # double-delivers (FaultPlan DUPLICATE re-invokes this) is
+            # exactly the evidence an incident bundle needs.
+            bb.tap("out", host, data)
         with self._conn_lock:
             # not_ready marks a failure as provably-unapplied (safe to
             # retry/requeue).  That holds only until the request body
@@ -1321,6 +1347,8 @@ class PeerClient:
                         f"peer returned HTTP {r.status}: {body[:200]!r}",
                         http_status=r.status,
                     )
+                if bb is not None:
+                    bb.tap("in", host, body)
                 return body
             except PeerError as e:
                 self._set_last_err(str(e))

@@ -24,6 +24,7 @@ from .utils.logging import category_logger
 import numpy as np
 
 from . import audit as audit_mod
+from . import blackbox as blackbox_mod
 from . import profiling
 from . import saturation
 from . import snapshot as snapshot_mod
@@ -191,6 +192,10 @@ class ServiceConfig:
     # PeerClient this service creates (None = PeerClients honor the
     # process-wide faults.install() plan instead).
     fault_plan: object = None
+    # Incident black box (blackbox.py): directory incident bundles are
+    # written into ("" = rings only, no bundles).  Env:
+    # GUBER_BLACKBOX_DIR.
+    blackbox_dir: str = ""
 
 
 class _ExpressPolicy:
@@ -1203,7 +1208,35 @@ class V1Service:
         self._handoff_deadline = 0.0  # monotonic; 0 = no window
         self.reshard = ReshardManager(self)
         self._health = HealthCheckResponse(status=HEALTHY)
-        self._forward_pool = ThreadPoolExecutor(max_workers=64)
+        # Per-service flight recorder (the PR 9 shared-ring fix):
+        # co-resident daemons each get their own span/event rings, so
+        # soak-cluster incidents are attributable.  Threads this service
+        # owns bind it (pool initializers below, auditor/pump threads);
+        # bare-store users who never bind still land on tracing's
+        # process default — module-level behavior is unchanged.
+        self.recorder = tracing.Recorder(
+            name=conf.advertise_address or f"service-{id(self):x}"
+        )
+        # Incident black box (blackbox.py): the per-wire traffic rings
+        # + triggered bundle writer.  Hooked into BOTH this service's
+        # recorder and the process-default recorder: events recorded by
+        # unbound threads (library embedders, module-level fallbacks)
+        # still trigger bundles.
+        self.blackbox = blackbox_mod.BlackBox(
+            self,
+            path=getattr(conf, "blackbox_dir", "") or "",
+            budget_mb=getattr(conf.behaviors, "blackbox_mb", 64),
+            retain=getattr(conf.behaviors, "blackbox_retain", 8),
+            enabled=getattr(conf.behaviors, "blackbox", True),
+        )
+        self.recorder.dump_hooks.append(self.blackbox.on_trigger)
+        tracing.default_recorder().dump_hooks.append(
+            self.blackbox.on_trigger
+        )
+        self._forward_pool = ThreadPoolExecutor(
+            max_workers=64,
+            initializer=tracing.bind_recorder, initargs=(self.recorder,),
+        )
         # Async slow-lane / dataclass-fallback work runs on its OWN pool:
         # those tasks run _route, which submits leaf forwards to
         # _forward_pool and BLOCKS — putting them on _forward_pool too
@@ -1217,7 +1250,8 @@ class V1Service:
         # handler pool — keep the two caps equal (both cover the
         # reference's 100-way bench shape).
         self._slow_pool = ThreadPoolExecutor(
-            max_workers=128, thread_name_prefix="columns-slow"
+            max_workers=128, thread_name_prefix="columns-slow",
+            initializer=tracing.bind_recorder, initargs=(self.recorder,),
         )
         self._drainer: "Optional[_HandleDrainer]" = None
         self._drainer_lock = threading.Lock()
@@ -1314,6 +1348,7 @@ class V1Service:
             metrics=self.metrics,
             interval_s=getattr(conf.behaviors, "audit_interval_s", 5.0),
             enabled=getattr(conf.behaviors, "audit", True),
+            recorder=self.recorder,
         )
         self.auditor.start()
         self._started_monotonic = time.monotonic()
@@ -3008,6 +3043,10 @@ class V1Service:
                 "steadyRecompiles": telemetry.steady_recompile_count(),
             },
             "snapshot": self.snapshots.snapshot(),
+            # Incident black box (blackbox.py): ring fill, bundle
+            # counts, last-trigger age — scripts/cluster_status.py's
+            # blackbox column reads this.
+            "blackbox": self.blackbox.snapshot(),
             # Multi-region federation plane (federation.py): this
             # daemon's data center, the accumulator/carry state, and
             # per-remote-region peer + breaker counts — what the soak's
@@ -3059,6 +3098,7 @@ class V1Service:
                         channel_credentials=self.conf.peer_channel_credentials,
                         metrics=self.metrics,
                         faults=self.conf.fault_plan,
+                        blackbox=self.blackbox,
                     )
                 client.info = info
                 new_local.add(info.grpc_address, client)
@@ -3072,6 +3112,7 @@ class V1Service:
                         channel_credentials=self.conf.peer_channel_credentials,
                         metrics=self.metrics,
                         faults=self.conf.fault_plan,
+                        blackbox=self.blackbox,
                     )
                 client.info = info
                 new_region.add(client)
@@ -3175,6 +3216,17 @@ class V1Service:
         # (cmd/server.py routes SIGTERM through Daemon.close to here).
         self.snapshots.stop()
         self.snapshots.save_now("close")
+        # Black box last among the observability planes: the final
+        # snapshot above is still capturable evidence, and the default
+        # recorder's hook must be unhooked or a dead service would keep
+        # writing bundles on other daemons' triggers.
+        try:
+            tracing.default_recorder().dump_hooks.remove(
+                self.blackbox.on_trigger
+            )
+        except ValueError:
+            pass
+        self.blackbox.close()
         if self.conf.loader is not None:
             self.conf.loader.save(self.store.snapshot_items())
         for peer in self.get_peer_list() + list(self.region_picker.peers()):

@@ -42,6 +42,7 @@ import os
 import random
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .utils.logging import category_logger
@@ -226,9 +227,6 @@ class _Ring:
         self._buf = [None] * self._cap
 
 
-_spans = _Ring(SPAN_RING_CAPACITY)
-_events = _Ring(EVENT_RING_CAPACITY)
-
 # Event kinds that trigger an automatic flight-recorder dump to the
 # structured log (rate-limited so an open breaker can't storm it).
 # global-send-failed: a GLOBAL broadcast/hit-forward send exhausted its
@@ -244,8 +242,107 @@ _DUMP_KINDS = frozenset({"breaker-open", "shed", "fault",
                          "reshard-aborted", "recompile-storm",
                          "audit-violation", "snapshot-rejected"})
 _DUMP_MIN_INTERVAL_S = 5.0
-_last_dump = [0.0]
-_dump_lock = threading.Lock()
+
+# Every live Recorder (weakly — a closed service's recorder must not be
+# pinned by this registry).  Module-level snapshots/reset operate on
+# the union, which preserves the one-global-ring semantics bare-store
+# users had before per-service recorders existed.
+_recorders: "weakref.WeakSet[Recorder]" = weakref.WeakSet()
+
+
+class Recorder:
+    """One flight recorder: a span ring + event ring + the auto-dump
+    rate limiter, keyed per daemon/service instance so co-resident
+    daemons' incidents no longer interleave (the PR 9 shared-ring
+    wart).  Threads owned by a service bind its recorder via
+    `bind_recorder`; unbound threads fall back to the module default,
+    and readers MERGE (spans_snapshot/events_snapshot take an explicit
+    recorder list), so spans recorded off an unbound helper thread are
+    never lost to a per-service view.
+
+    `dump_hooks` is the incident trigger surface: callables
+    `(trigger_kind, fields) -> None` invoked on EVERY _DUMP_KINDS event
+    BEFORE the log dump's rate limit — the black box (blackbox.py) does
+    its own coalescing/rate limiting and must see every trigger."""
+
+    __slots__ = ("name", "_spans", "_events", "dump_hooks", "_last_dump",
+                 "_dump_lock", "__weakref__")
+
+    def __init__(self, span_capacity: int = 0, event_capacity: int = 0,
+                 name: str = ""):
+        self.name = name
+        self._spans = _Ring(span_capacity or SPAN_RING_CAPACITY)
+        self._events = _Ring(event_capacity or EVENT_RING_CAPACITY)
+        self.dump_hooks: List = []
+        self._last_dump = 0.0
+        self._dump_lock = threading.Lock()
+        _recorders.add(self)
+
+    def spans(self) -> List[dict]:
+        return self._spans.snapshot()
+
+    def events(self) -> List[dict]:
+        return self._events.snapshot()
+
+    def clear(self) -> None:
+        self._spans.clear()
+        self._events.clear()
+
+    def _auto_dump(self, trigger: str, fields: dict) -> None:
+        # Hooks BEFORE the rate limit: the black box coalesces trigger
+        # storms itself and must count every one; each hook is fenced —
+        # diagnostics must never fail the path that fired the event.
+        for hook in list(self.dump_hooks):
+            try:
+                hook(trigger, fields)
+            except Exception:  # noqa: BLE001
+                logger.exception("flight-recorder dump hook failed")
+        now = time.monotonic()
+        with self._dump_lock:
+            if now - self._last_dump < _DUMP_MIN_INTERVAL_S:
+                return
+            self._last_dump = now
+        try:
+            payload = {
+                "trigger": trigger,
+                "events": self._events.snapshot()[-20:],
+                "spans": self._spans.snapshot()[-50:],
+            }
+            logger.warning(
+                "flight-recorder dump trigger=%s %s",
+                trigger,
+                json.dumps(payload, separators=(",", ":"), default=str),
+            )
+        except Exception:  # noqa: BLE001 — diagnostics must never fail the path
+            logger.exception("flight-recorder dump failed")
+
+
+_DEFAULT = Recorder(name="process")
+# Back-compat aliases: library code and tests reach for the module
+# rings directly (tracing._spans.record(...)); they are the DEFAULT
+# recorder's rings.
+_spans = _DEFAULT._spans
+_events = _DEFAULT._events
+
+
+def default_recorder() -> Recorder:
+    return _DEFAULT
+
+
+def bind_recorder(rec: Optional[Recorder]) -> None:
+    """Bind `rec` as this thread's flight recorder (None = back to the
+    module default).  Service-owned threads (gateway workers, pools,
+    the auditor, the native pump) bind their service's recorder so
+    incidents are attributable per daemon."""
+    _tls.recorder = rec
+
+
+def current_recorder() -> Recorder:
+    return getattr(_tls, "recorder", None) or _DEFAULT
+
+
+def all_recorders() -> List[Recorder]:
+    return list(_recorders)
 
 
 def record_span(
@@ -263,7 +360,7 @@ def record_span(
     the wall stamp is what lets scripts/trace_collect.py order one
     trace's spans from several processes and measure hop latencies
     (NTP-grade skew applies, which is fine for hop-scale deltas)."""
-    _spans.record(
+    current_recorder()._spans.record(
         {
             "name": name,
             "trace_id": ctx.trace_hex,
@@ -289,34 +386,16 @@ def record_event(kind: str, **fields) -> None:
     tracing is sampled out, since failures are rare by definition."""
     fields["kind"] = kind
     fields["ts_ns"] = time.monotonic_ns()
-    _events.record(fields)
+    rec = current_recorder()
+    rec._events.record(fields)
     if kind in _DUMP_KINDS:
-        _auto_dump(kind)
-
-
-def _auto_dump(trigger: str) -> None:
-    now = time.monotonic()
-    with _dump_lock:
-        if now - _last_dump[0] < _DUMP_MIN_INTERVAL_S:
-            return
-        _last_dump[0] = now
-    try:
-        payload = {
-            "trigger": trigger,
-            "events": _events.snapshot()[-20:],
-            "spans": _spans.snapshot()[-50:],
-        }
-        logger.warning(
-            "flight-recorder dump trigger=%s %s",
-            trigger,
-            json.dumps(payload, separators=(",", ":"), default=str),
-        )
-    except Exception:  # noqa: BLE001 — diagnostics must never fail the path
-        logger.exception("flight-recorder dump failed")
+        rec._auto_dump(kind, fields)
 
 
 def spans_snapshot(trace_id_hex: str = "", since_ns: int = 0,
-                   limit: int = 0) -> List[dict]:
+                   limit: int = 0,
+                   recorders: "Optional[Sequence[Recorder]]" = None
+                   ) -> List[dict]:
     """Recorded spans, optionally filtered to one trace: a span matches
     when its own trace_id is the target OR it links the target (the
     batch span-link rule — a coalesced dispatch's stage spans belong to
@@ -326,8 +405,15 @@ def spans_snapshot(trace_id_hex: str = "", since_ns: int = 0,
     OLDEST N after filtering — the pagination order: a poller whose
     cursor tracks the max wall_ns it received gets the NEXT window on
     its next poll instead of skipping everything between its cursor
-    and a newest-N slice."""
-    spans = _spans.snapshot()
+    and a newest-N slice.
+
+    `recorders` restricts the read to an explicit recorder list (the
+    gateway passes [service recorder, default] so a daemon's view is
+    its own work plus unbound-thread spillover); None reads the union
+    of every live recorder — the pre-refactor whole-process view."""
+    spans: List[dict] = []
+    for rec in (recorders if recorders is not None else all_recorders()):
+        spans.extend(rec._spans.snapshot())
     if trace_id_hex:
         want = trace_id_hex.lower().lstrip("0x")
         want = want.zfill(32)
@@ -355,17 +441,31 @@ def spans_snapshot(trace_id_hex: str = "", since_ns: int = 0,
     return spans
 
 
-def events_snapshot() -> List[dict]:
-    return _events.snapshot()
+def events_snapshot(
+    recorders: "Optional[Sequence[Recorder]]" = None,
+) -> List[dict]:
+    """Recorded events, merged across `recorders` (None = every live
+    recorder) in monotonic-stamp order — ts_ns is process-monotonic, so
+    cross-recorder merge order is exact."""
+    recs = recorders if recorders is not None else all_recorders()
+    if len(recs) == 1:
+        return recs[0]._events.snapshot()
+    events: List[dict] = []
+    for rec in recs:
+        events.extend(rec._events.snapshot())
+    events.sort(key=lambda e: e.get("ts_ns", 0))
+    return events
 
 
 def reset() -> None:
-    """Test hook: clear rings and per-thread context."""
-    _spans.clear()
-    _events.clear()
+    """Test hook: clear every live recorder's rings and this thread's
+    context/binding."""
+    for rec in all_recorders():
+        rec.clear()
     _tls.ctx = None
     _tls.staged = None
     _tls.emitted = None
+    _tls.recorder = None
 
 
 # ---------------------------------------------------------------------

@@ -124,6 +124,12 @@ EXTENSIONS = frozenset(
         "gubernator_express_lanes",
         "gubernator_express_hit_ratio",
         "gubernator_readback_retries",
+        # PR 15: incident black box (blackbox.py) — always-on wire
+        # capture rings + triggered bundle writes.
+        "gubernator_blackbox_frames",
+        "gubernator_blackbox_ring_bytes",
+        "gubernator_blackbox_bundles",
+        "gubernator_blackbox_last_trigger_age_seconds",
     }
 )
 

@@ -33,7 +33,7 @@ import urllib.request
 COLUMNS = ("daemon", "health", "peers", "brk-open", "ring", "handoff",
            "occupancy", "evict", "queue", "shed", "burn-5m", "burn-1h",
            "audit", "recompiles", "dc", "regions", "carry", "flush-age",
-           "hot-key", "hot-tenant")
+           "hot-key", "hot-tenant", "blackbox")
 
 TENANT_COLUMNS = ("tenant", "hits", "lanes", "over-limit", "shed",
                   "ingress-MB", "lane-time-s", "queue-s", "daemons")
@@ -76,6 +76,17 @@ def summarize(addr: str, doc: dict) -> dict:
         regions_cell = "-"
     flush_age = region.get("lastFlushAgeS")
     top_tenants = doc.get("tenants", {}).get("topk") or []
+    # Incident black box (PR 15): bundles written this run / bundles on
+    # disk, with the last-trigger age when one fired — "bb 2/2 31s ago"
+    # answers "did the incident leave evidence" at a glance.
+    bb = doc.get("blackbox", {})
+    if bb.get("enabled"):
+        bb_cell = f"{bb.get('bundles', 0)}/{bb.get('bundlesOnDisk', 0)}"
+        age = bb.get("lastTriggerAgeS")
+        if age is not None:
+            bb_cell += f" {int(age)}s ago"
+    else:
+        bb_cell = "-"
     return {
         "daemon": addr,
         "health": doc.get("health", {}).get("status", "?"),
@@ -115,6 +126,7 @@ def summarize(addr: str, doc: dict) -> dict:
             f"{top_tenants[0]['tenant']}:{top_tenants[0]['hits']}"
             if top_tenants else "-"
         ),
+        "blackbox": bb_cell,
     }
 
 
